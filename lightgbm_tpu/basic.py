@@ -1,0 +1,15 @@
+"""Public ``Dataset`` / ``Booster`` API (reference: python-package/lightgbm/basic.py).
+
+Placeholder — filled in as the training engine lands.
+"""
+from __future__ import annotations
+
+
+class Dataset:  # pragma: no cover - placeholder
+    def __init__(self, *a, **kw):
+        raise NotImplementedError("Dataset lands with the training engine")
+
+
+class Booster:  # pragma: no cover - placeholder
+    def __init__(self, *a, **kw):
+        raise NotImplementedError("Booster lands with the training engine")

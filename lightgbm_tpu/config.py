@@ -1,0 +1,442 @@
+"""Configuration system.
+
+TPU-native rebuild of the reference's single-source-of-truth parameter struct
+(reference: include/LightGBM/config.h:31-872 and the generated alias table in
+src/io/config_auto.cpp:10). Every public LightGBM v2.3.2 parameter name and
+alias is accepted, so configs and ``train.conf`` files written for the
+reference work unchanged. New here: ``device_type`` gains ``"tpu"`` (the
+default), and TPU-specific knobs live in the ``tpu_*`` namespace.
+
+Parsing follows the reference's pipeline: raw strings → alias resolution →
+typed ``Config`` fields → inter-parameter consistency checks
+(reference: src/io/config.cpp Config::Set).
+"""
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from .utils import log
+
+# ---------------------------------------------------------------------------
+# Alias table (reference: src/io/config_auto.cpp:10-200). Maps alias → canonical.
+# ---------------------------------------------------------------------------
+_ALIASES: Dict[str, str] = {
+    "config_file": "config",
+    "task_type": "task",
+    "objective_type": "objective", "app": "objective", "application": "objective",
+    "boosting_type": "boosting", "boost": "boosting",
+    "train": "data", "train_data": "data", "train_data_file": "data", "data_filename": "data",
+    "test": "valid", "valid_data": "valid", "valid_data_file": "valid",
+    "test_data": "valid", "test_data_file": "valid", "valid_filenames": "valid",
+    "num_iteration": "num_iterations", "n_iter": "num_iterations",
+    "num_tree": "num_iterations", "num_trees": "num_iterations",
+    "num_round": "num_iterations", "num_rounds": "num_iterations",
+    "num_boost_round": "num_iterations", "n_estimators": "num_iterations",
+    "shrinkage_rate": "learning_rate", "eta": "learning_rate",
+    "num_leaf": "num_leaves", "max_leaves": "num_leaves", "max_leaf": "num_leaves",
+    "tree": "tree_learner", "tree_type": "tree_learner", "tree_learner_type": "tree_learner",
+    "num_thread": "num_threads", "nthread": "num_threads", "nthreads": "num_threads",
+    "n_jobs": "num_threads",
+    "device": "device_type",
+    "random_seed": "seed", "random_state": "seed",
+    "min_data_per_leaf": "min_data_in_leaf", "min_data": "min_data_in_leaf",
+    "min_child_samples": "min_data_in_leaf",
+    "min_sum_hessian_per_leaf": "min_sum_hessian_in_leaf",
+    "min_sum_hessian": "min_sum_hessian_in_leaf", "min_hessian": "min_sum_hessian_in_leaf",
+    "min_child_weight": "min_sum_hessian_in_leaf",
+    "sub_row": "bagging_fraction", "subsample": "bagging_fraction", "bagging": "bagging_fraction",
+    "pos_sub_row": "pos_bagging_fraction", "pos_subsample": "pos_bagging_fraction",
+    "pos_bagging": "pos_bagging_fraction",
+    "neg_sub_row": "neg_bagging_fraction", "neg_subsample": "neg_bagging_fraction",
+    "neg_bagging": "neg_bagging_fraction",
+    "subsample_freq": "bagging_freq",
+    "bagging_fraction_seed": "bagging_seed",
+    "sub_feature": "feature_fraction", "colsample_bytree": "feature_fraction",
+    "sub_feature_bynode": "feature_fraction_bynode", "colsample_bynode": "feature_fraction_bynode",
+    "early_stopping_rounds": "early_stopping_round", "early_stopping": "early_stopping_round",
+    "n_iter_no_change": "early_stopping_round",
+    "max_tree_output": "max_delta_step", "max_leaf_output": "max_delta_step",
+    "reg_alpha": "lambda_l1",
+    "reg_lambda": "lambda_l2", "lambda": "lambda_l2",
+    "min_split_gain": "min_gain_to_split",
+    "rate_drop": "drop_rate",
+    "topk": "top_k",
+    "mc": "monotone_constraints", "monotone_constraint": "monotone_constraints",
+    "feature_contrib": "feature_contri", "fc": "feature_contri", "fp": "feature_contri",
+    "feature_penalty": "feature_contri",
+    "fs": "forcedsplits_filename", "forced_splits_filename": "forcedsplits_filename",
+    "forced_splits_file": "forcedsplits_filename", "forced_splits": "forcedsplits_filename",
+    "verbose": "verbosity",
+    "max_bins": "max_bin",
+    "subsample_for_bin": "bin_construct_sample_cnt",
+    "hist_pool_size": "histogram_pool_size",
+    "data_seed": "data_random_seed",
+    "model_output": "output_model", "model_out": "output_model",
+    "save_period": "snapshot_freq",
+    "model_input": "input_model", "model_in": "input_model",
+    "predict_result": "output_result", "prediction_result": "output_result",
+    "predict_name": "output_result", "prediction_name": "output_result",
+    "pred_name": "output_result", "name_pred": "output_result",
+    "init_score_filename": "initscore_filename", "init_score_file": "initscore_filename",
+    "init_score": "initscore_filename", "input_init_score": "initscore_filename",
+    "is_pre_partition": "pre_partition",
+    "is_enable_bundle": "enable_bundle", "bundle": "enable_bundle",
+    "is_sparse": "is_enable_sparse", "enable_sparse": "is_enable_sparse", "sparse": "is_enable_sparse",
+    "two_round_loading": "two_round", "use_two_round_loading": "two_round",
+    "is_save_binary": "save_binary", "is_save_binary_file": "save_binary",
+    "has_header": "header",
+    "label": "label_column",
+    "weight": "weight_column",
+    "group": "group_column", "group_id": "group_column",
+    "query_column": "group_column", "query": "group_column", "query_id": "group_column",
+    "ignore_feature": "ignore_column", "blacklist": "ignore_column",
+    "cat_feature": "categorical_feature", "categorical_column": "categorical_feature",
+    "cat_column": "categorical_feature",
+    "is_predict_raw_score": "predict_raw_score", "predict_rawscore": "predict_raw_score",
+    "raw_score": "predict_raw_score",
+    "is_predict_leaf_index": "predict_leaf_index", "leaf_index": "predict_leaf_index",
+    "is_predict_contrib": "predict_contrib", "contrib": "predict_contrib",
+    "convert_model_file": "convert_model",
+    "num_classes": "num_class",
+    "unbalance": "is_unbalance", "unbalanced_sets": "is_unbalance",
+    "metrics": "metric", "metric_types": "metric",
+    "output_freq": "metric_freq",
+    "training_metric": "is_provide_training_metric", "is_training_metric": "is_provide_training_metric",
+    "train_metric": "is_provide_training_metric",
+    "ndcg_eval_at": "eval_at", "ndcg_at": "eval_at", "map_eval_at": "eval_at", "map_at": "eval_at",
+    "num_machine": "num_machines",
+    "local_port": "local_listen_port", "port": "local_listen_port",
+    "machine_list_file": "machine_list_filename", "machine_list": "machine_list_filename",
+    "mlist": "machine_list_filename",
+    "workers": "machines", "nodes": "machines",
+}
+
+# Parameters whose value is a comma-separated list.
+_MULTI_VALUE = {
+    "valid", "metric", "monotone_constraints", "feature_contri", "label_gain",
+    "eval_at", "auc_mu_weights", "cegb_penalty_feature_lazy", "cegb_penalty_feature_coupled",
+    "ignore_column", "categorical_feature", "interaction_constraints",
+}
+
+_OBJECTIVE_ALIASES = {
+    "regression": "regression", "regression_l2": "regression", "l2": "regression",
+    "mean_squared_error": "regression", "mse": "regression", "l2_root": "regression",
+    "root_mean_squared_error": "regression", "rmse": "regression",
+    "regression_l1": "regression_l1", "l1": "regression_l1",
+    "mean_absolute_error": "regression_l1", "mae": "regression_l1",
+    "huber": "huber", "fair": "fair", "poisson": "poisson", "quantile": "quantile",
+    "mape": "mape", "mean_absolute_percentage_error": "mape",
+    "gamma": "gamma", "tweedie": "tweedie",
+    "binary": "binary",
+    "multiclass": "multiclass", "softmax": "multiclass",
+    "multiclassova": "multiclassova", "multiclass_ova": "multiclassova",
+    "ova": "multiclassova", "ovr": "multiclassova",
+    "cross_entropy": "cross_entropy", "xentropy": "cross_entropy",
+    "cross_entropy_lambda": "cross_entropy_lambda", "xentlambda": "cross_entropy_lambda",
+    "lambdarank": "lambdarank",
+    "none": "none", "null": "none", "custom": "none", "na": "none",
+}
+
+
+def parse_objective_alias(name: str) -> str:
+    name = name.strip().lower()
+    if name in _OBJECTIVE_ALIASES:
+        return _OBJECTIVE_ALIASES[name]
+    return name
+
+
+@dataclass
+class Config:
+    """Typed parameter set. Field names match reference parameter names.
+
+    Groups follow the reference layout: Core, Learning Control, IO, Objective,
+    Metric, Network, Device (reference: include/LightGBM/config.h regions).
+    """
+    # ---- Core ----
+    config: str = ""
+    task: str = "train"                 # train, predict, convert_model, refit
+    objective: str = "regression"
+    boosting: str = "gbdt"              # gbdt, rf, dart, goss
+    data: str = ""
+    valid: List[str] = field(default_factory=list)
+    num_iterations: int = 100
+    learning_rate: float = 0.1
+    num_leaves: int = 31
+    tree_learner: str = "serial"        # serial, feature, data, voting
+    num_threads: int = 0
+    device_type: str = "tpu"            # cpu, tpu (reference: cpu, gpu)
+    seed: int = 0
+
+    # ---- Learning control ----
+    max_depth: int = -1
+    min_data_in_leaf: int = 20
+    min_sum_hessian_in_leaf: float = 1e-3
+    bagging_fraction: float = 1.0
+    pos_bagging_fraction: float = 1.0
+    neg_bagging_fraction: float = 1.0
+    bagging_freq: int = 0
+    bagging_seed: int = 3
+    feature_fraction: float = 1.0
+    feature_fraction_bynode: float = 1.0
+    feature_fraction_seed: int = 2
+    early_stopping_round: int = 0
+    first_metric_only: bool = False
+    max_delta_step: float = 0.0
+    lambda_l1: float = 0.0
+    lambda_l2: float = 0.0
+    min_gain_to_split: float = 0.0
+    drop_rate: float = 0.1
+    max_drop: int = 50
+    skip_drop: float = 0.5
+    xgboost_dart_mode: bool = False
+    uniform_drop: bool = False
+    drop_seed: int = 4
+    top_rate: float = 0.2
+    other_rate: float = 0.1
+    min_data_per_group: int = 100
+    max_cat_threshold: int = 32
+    cat_l2: float = 10.0
+    cat_smooth: float = 10.0
+    max_cat_to_onehot: int = 4
+    top_k: int = 20
+    monotone_constraints: List[int] = field(default_factory=list)
+    feature_contri: List[float] = field(default_factory=list)
+    forcedsplits_filename: str = ""
+    forcedbins_filename: str = ""
+    refit_decay_rate: float = 0.9
+    cegb_tradeoff: float = 1.0
+    cegb_penalty_split: float = 0.0
+    cegb_penalty_feature_lazy: List[float] = field(default_factory=list)
+    cegb_penalty_feature_coupled: List[float] = field(default_factory=list)
+    verbosity: int = 1
+
+    # ---- IO ----
+    max_bin: int = 255
+    min_data_in_bin: int = 3
+    bin_construct_sample_cnt: int = 200000
+    histogram_pool_size: float = -1.0
+    data_random_seed: int = 1
+    output_model: str = "LightGBM_model.txt"
+    snapshot_freq: int = -1
+    input_model: str = ""
+    output_result: str = "LightGBM_predict_result.txt"
+    initscore_filename: str = ""
+    valid_data_initscores: List[str] = field(default_factory=list)
+    pre_partition: bool = False
+    enable_bundle: bool = True
+    max_conflict_rate: float = 0.0
+    is_enable_sparse: bool = True
+    sparse_threshold: float = 0.8
+    use_missing: bool = True
+    zero_as_missing: bool = False
+    two_round: bool = False
+    save_binary: bool = False
+    header: bool = False
+    label_column: str = ""
+    weight_column: str = ""
+    group_column: str = ""
+    ignore_column: str = ""
+    categorical_feature: str = ""
+    predict_raw_score: bool = False
+    predict_leaf_index: bool = False
+    predict_contrib: bool = False
+    num_iteration_predict: int = -1
+    pred_early_stop: bool = False
+    pred_early_stop_freq: int = 10
+    pred_early_stop_margin: float = 10.0
+    predict_disable_shape_check: bool = False
+    convert_model_language: str = ""
+    convert_model: str = "gbdt_prediction.cpp"
+
+    # ---- Objective ----
+    num_class: int = 1
+    is_unbalance: bool = False
+    scale_pos_weight: float = 1.0
+    sigmoid: float = 1.0
+    boost_from_average: bool = True
+    reg_sqrt: bool = False
+    alpha: float = 0.9
+    fair_c: float = 1.0
+    poisson_max_delta_step: float = 0.7
+    tweedie_variance_power: float = 1.5
+    max_position: int = 20
+    lambdamart_norm: bool = True
+    label_gain: List[float] = field(default_factory=list)
+
+    # ---- Metric ----
+    metric: List[str] = field(default_factory=list)
+    metric_freq: int = 1
+    is_provide_training_metric: bool = False
+    eval_at: List[int] = field(default_factory=lambda: [1, 2, 3, 4, 5])
+    multi_error_top_k: int = 1
+    auc_mu_weights: List[float] = field(default_factory=list)
+
+    # ---- Network ----
+    num_machines: int = 1
+    local_listen_port: int = 12400
+    time_out: int = 120
+    machine_list_filename: str = ""
+    machines: str = ""
+
+    # ---- Device (reference gpu_* kept for compat; tpu_* are new) ----
+    gpu_platform_id: int = -1
+    gpu_device_id: int = -1
+    gpu_use_dp: bool = False
+    tpu_hist_dtype: str = "float32"     # accumulator dtype for histograms
+    tpu_block_rows: int = 1024          # Pallas histogram kernel row-block
+    tpu_donate_buffers: bool = True
+    tpu_mesh_shape: str = ""            # e.g. "data:8" or "data:4,feature:2"
+
+    # ---- derived (not user-settable) ----
+    is_parallel: bool = dataclasses.field(default=False, repr=False)
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def str2map(params_str: str) -> Dict[str, str]:
+        """Parse a CLI/conf style ``key=value`` string list separated by
+        whitespace (reference: Config::Str2Map, config.h:78)."""
+        out: Dict[str, str] = {}
+        for tok in params_str.split():
+            Config.kv2map(out, tok)
+        return out
+
+    @staticmethod
+    def kv2map(out: Dict[str, str], kv: str) -> None:
+        if "=" not in kv:
+            if kv.strip():
+                log.warning("Unknown token '%s' ignored", kv)
+            return
+        k, v = kv.split("=", 1)
+        k, v = k.strip(), v.strip()
+        if k and not k.startswith("#"):
+            out[k] = v
+
+    @classmethod
+    def from_params(cls, params: Optional[Dict[str, Any]]) -> "Config":
+        cfg = cls()
+        cfg.update(params or {})
+        return cfg
+
+    # ------------------------------------------------------------------
+    def update(self, params: Dict[str, Any]) -> None:
+        resolved: Dict[str, Any] = {}
+        for key, value in params.items():
+            canon = _ALIASES.get(key, key)
+            if canon in resolved and key != canon:
+                continue  # explicit canonical name wins over alias
+            resolved[canon] = value
+        fields = {f.name: f for f in dataclasses.fields(self)}
+        for key, value in resolved.items():
+            if key not in fields:
+                log.warning("Unknown parameter: %s", key)
+                continue
+            setattr(self, key, _coerce(fields[key], value))
+        self._post_process()
+
+    def _post_process(self) -> None:
+        log.set_verbosity(self.verbosity)
+        self.objective = parse_objective_alias(self.objective)
+        self.boosting = {"gbrt": "gbdt", "random_forest": "rf"}.get(self.boosting, self.boosting)
+        self.tree_learner = {
+            "serial_tree_learner": "serial", "feature_parallel": "feature",
+            "feature_parallel_tree_learner": "feature", "data_parallel": "data",
+            "data_parallel_tree_learner": "data", "voting_parallel": "voting",
+            "voting_parallel_tree_learner": "voting", "voting_tree_learner": "voting",
+        }.get(self.tree_learner, self.tree_learner)
+        if self.tree_learner not in ("serial", "feature", "data", "voting"):
+            log.fatal(f"Unknown tree learner type {self.tree_learner}")
+        if self.device_type not in ("cpu", "tpu", "gpu"):
+            log.fatal(f"Unknown device type {self.device_type}")
+        if self.device_type == "gpu":
+            # The reference's OpenCL device does not exist here; the TPU path is
+            # its replacement (reference: src/treelearner/gpu_tree_learner.h).
+            log.warning("device_type=gpu mapped to tpu in lightgbm_tpu")
+            self.device_type = "tpu"
+        self.is_parallel = self.tree_learner != "serial" or self.num_machines > 1
+        # consistency checks (reference: Config::CheckParamConflict, config.cpp)
+        if self.is_parallel and self.monotone_constraints:
+            log.fatal("Cannot use monotone constraints in parallel learning")
+        if not (0.0 < self.bagging_fraction <= 1.0):
+            log.fatal("bagging_fraction should be in (0.0, 1.0]")
+        if not (0.0 < self.feature_fraction <= 1.0):
+            log.fatal("feature_fraction should be in (0.0, 1.0]")
+        if not (0.0 < self.feature_fraction_bynode <= 1.0):
+            log.fatal("feature_fraction_bynode should be in (0.0, 1.0]")
+        if self.num_leaves < 2:
+            log.fatal("num_leaves should be >= 2")
+        if not (1 < self.max_bin <= 65535):
+            log.fatal("max_bin should be in (1, 65535]")
+        if self.boosting == "goss" and self.top_rate + self.other_rate > 1.0:
+            log.fatal("top_rate + other_rate should be <= 1.0 for GOSS")
+        if self.objective in ("multiclass", "multiclassova") and self.num_class < 2:
+            log.fatal(f"num_class must be >= 2 for objective {self.objective}")
+        if self.objective not in ("multiclass", "multiclassova", "none") and self.num_class != 1:
+            log.fatal(f"num_class must be 1 for objective {self.objective}")
+        if self.boosting == "rf":
+            if self.bagging_freq <= 0 or not (0.0 < self.bagging_fraction < 1.0):
+                log.fatal("bagging_freq and bagging_fraction (in (0,1)) are required for rf")
+
+    # ------------------------------------------------------------------
+    def num_model_per_iteration(self) -> int:
+        if self.objective in ("multiclass", "multiclassova"):
+            return self.num_class
+        return 1
+
+    def to_params(self) -> Dict[str, Any]:
+        out = {}
+        for f in dataclasses.fields(self):
+            if f.name == "is_parallel":
+                continue
+            v = getattr(self, f.name)
+            if v != (f.default if f.default is not dataclasses.MISSING else None):
+                out[f.name] = v
+        return out
+
+
+def _coerce(fld: dataclasses.Field, value: Any):
+    """Coerce a raw parameter value (possibly a string from a conf file) to
+    the field's declared type."""
+    name = fld.name
+    ftype = fld.type if isinstance(fld.type, str) else getattr(fld.type, "__name__", str(fld.type))
+    is_list = "List" in ftype
+    if is_list:
+        if value is None:
+            return []
+        if isinstance(value, str):
+            items = [x for x in value.replace(",", " ").split() if x]
+        elif isinstance(value, (list, tuple)):
+            items = list(value)
+        else:
+            items = [value]
+        if "int" in ftype:
+            return [int(float(x)) for x in items]
+        if "float" in ftype:
+            return [float(x) for x in items]
+        return [str(x) for x in items]
+    if "bool" in ftype:
+        if isinstance(value, str):
+            return value.strip().lower() in ("true", "1", "yes", "+", "t")
+        return bool(value)
+    if ftype.startswith("int"):
+        return int(float(value))
+    if ftype.startswith("float"):
+        return float(value)
+    if name == "valid":  # declared List[str] but handled above
+        return value
+    return str(value)
+
+
+def read_config_file(path: str) -> Dict[str, str]:
+    """Parse a LightGBM ``train.conf``-style file: one ``key = value`` per
+    line, ``#`` comments (reference: Application::LoadParameters)."""
+    out: Dict[str, str] = {}
+    with open(path, "r") as fh:
+        for line in fh:
+            line = line.split("#", 1)[0].strip()
+            if not line or "=" not in line:
+                continue
+            k, v = line.split("=", 1)
+            out[k.strip()] = v.strip()
+    return out

@@ -1,0 +1,13 @@
+"""``train`` / ``cv`` (reference: python-package/lightgbm/engine.py).
+
+Placeholder — filled in as the training engine lands.
+"""
+from __future__ import annotations
+
+
+def train(*a, **kw):  # pragma: no cover - placeholder
+    raise NotImplementedError("train lands with the training engine")
+
+
+def cv(*a, **kw):  # pragma: no cover - placeholder
+    raise NotImplementedError("cv lands with the training engine")

@@ -1,0 +1,248 @@
+"""Binned dataset: the HBM-resident training representation.
+
+TPU-native rebuild of the reference's ``Dataset``/``Metadata``/``DatasetLoader``
+(reference: include/LightGBM/dataset.h:41-669, src/io/dataset_loader.cpp).
+Instead of per-feature-group ``Bin`` columns with sparse/dense variants and
+most-frequent-bin elision, the TPU representation is a single dense
+``uint8``/``uint16`` matrix ``X_bin[num_data, num_features]`` laid out for
+streaming into the Pallas histogram kernel, plus a flat bin-offset table so
+all features share one histogram address space (the analog of the reference's
+``NumTotalBin`` flat layout). Sparse storage is intentionally dropped: EFB
+densifies exclusive sparse features into shared columns instead
+(SURVEY.md §7 "hard parts" #5 documents the deviation).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from ..config import Config
+from ..utils import log
+from ..utils.random import Random
+from .binning import (BIN_CATEGORICAL, BIN_NUMERICAL, MISSING_NAN, MISSING_NONE,
+                      MISSING_ZERO, BinMapper)
+
+
+class Metadata:
+    """Labels, weights, query boundaries and init scores
+    (reference: Metadata, dataset.h:41-250)."""
+
+    def __init__(self, num_data: int = 0):
+        self.num_data = num_data
+        self.label: Optional[np.ndarray] = None          # float32 [num_data]
+        self.weights: Optional[np.ndarray] = None        # float32 [num_data]
+        self.query_boundaries: Optional[np.ndarray] = None  # int32 [num_queries+1]
+        self.query_weights: Optional[np.ndarray] = None
+        self.init_score: Optional[np.ndarray] = None     # float64 [num_data * k]
+
+    def set_label(self, label) -> None:
+        label = np.ascontiguousarray(label, dtype=np.float32).ravel()
+        log.check(len(label) == self.num_data, "label length != num_data")
+        self.label = label
+
+    def set_weights(self, weights) -> None:
+        if weights is None:
+            self.weights = None
+            return
+        weights = np.ascontiguousarray(weights, dtype=np.float32).ravel()
+        log.check(len(weights) == self.num_data, "weights length != num_data")
+        log.check(bool((weights >= 0).all()), "weights should be non-negative")
+        self.weights = weights
+        self._update_query_weights()
+
+    def set_query(self, group) -> None:
+        """``group`` is per-query sizes (LightGBM convention) or boundaries."""
+        if group is None:
+            self.query_boundaries = None
+            self.query_weights = None
+            return
+        group = np.ascontiguousarray(group, dtype=np.int64).ravel()
+        if group.sum() == self.num_data:  # sizes
+            self.query_boundaries = np.concatenate(
+                [[0], np.cumsum(group)]).astype(np.int32)
+        elif len(group) >= 1 and group[0] == 0 and group[-1] == self.num_data:
+            self.query_boundaries = group.astype(np.int32)
+        else:
+            log.fatal("Initial sizes of queries do not sum to num_data")
+        self._update_query_weights()
+
+    def _update_query_weights(self) -> None:
+        if self.query_boundaries is None or self.weights is None:
+            self.query_weights = None
+            return
+        b = self.query_boundaries
+        sums = np.add.reduceat(self.weights, b[:-1])
+        cnts = np.diff(b)
+        self.query_weights = (sums / np.maximum(cnts, 1)).astype(np.float32)
+
+    def set_init_score(self, init_score) -> None:
+        if init_score is None:
+            self.init_score = None
+            return
+        init_score = np.ascontiguousarray(init_score, dtype=np.float64).ravel()
+        log.check(len(init_score) % self.num_data == 0,
+                  "init_score length must be a multiple of num_data")
+        self.init_score = init_score
+
+    @property
+    def num_queries(self) -> int:
+        return 0 if self.query_boundaries is None else len(self.query_boundaries) - 1
+
+
+class BinnedDataset:
+    """The constructed training dataset (reference: Dataset, dataset.h:283).
+
+    Attributes
+    ----------
+    X_bin : np.ndarray  uint8/uint16 [num_data, num_features]
+        Binned feature matrix (only non-trivial features).
+    bin_mappers : list[BinMapper]
+        One per *original* feature column (trivial ones included).
+    used_feature_map : np.ndarray int32 [num_total_features]
+        original feature → inner column index, -1 if unused
+        (reference: used_feature_map_, dataset.h:629).
+    bin_offsets : np.ndarray int32 [num_features+1]
+        flat histogram offsets per inner column.
+    """
+
+    def __init__(self):
+        self.num_data: int = 0
+        self.num_total_features: int = 0
+        self.X_bin: Optional[np.ndarray] = None
+        self.bin_mappers: List[BinMapper] = []
+        self.used_feature_map: Optional[np.ndarray] = None
+        self.real_feature_idx: Optional[np.ndarray] = None  # inner → original
+        self.bin_offsets: Optional[np.ndarray] = None
+        self.metadata: Metadata = Metadata()
+        self.feature_names: List[str] = []
+        self.max_bin: int = 255
+        # EFB bundle info (filled by io.bundling when enabled)
+        self.group_of_feature: Optional[np.ndarray] = None
+
+    # ------------------------------------------------------------------
+    @property
+    def num_features(self) -> int:
+        return 0 if self.X_bin is None else self.X_bin.shape[1]
+
+    @property
+    def num_total_bin(self) -> int:
+        return 0 if self.bin_offsets is None else int(self.bin_offsets[-1])
+
+    def num_bin(self, inner_feature: int) -> int:
+        return int(self.bin_offsets[inner_feature + 1] - self.bin_offsets[inner_feature])
+
+    def inner_to_mapper(self, inner_feature: int) -> BinMapper:
+        return self.bin_mappers[int(self.real_feature_idx[inner_feature])]
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_matrix(cls, data: np.ndarray, config: Config,
+                    categorical_features: Sequence[int] = (),
+                    feature_names: Optional[List[str]] = None,
+                    reference: Optional["BinnedDataset"] = None,
+                    sample_indices: Optional[np.ndarray] = None) -> "BinnedDataset":
+        """Construct from a dense float matrix.
+
+        Mirrors the reference path DatasetLoader::CostructFromSampleData →
+        BinMapper::FindBin → Dataset::Construct (dataset_loader.cpp:574,
+        bin.cpp:325, dataset.cpp:265): sample rows, find per-feature bin
+        bounds, then binarize every row. With ``reference`` given, bin mappers
+        are shared so validation data aligns with the training bin space
+        (reference: LoadFromFileAlignWithOtherDataset, dataset_loader.cpp:230).
+        """
+        data = np.asarray(data)
+        if data.dtype not in (np.float32, np.float64):
+            data = data.astype(np.float64)
+        n, p = data.shape
+        ds = cls()
+        ds.num_data = n
+        ds.num_total_features = p
+        ds.metadata = Metadata(n)
+        ds.max_bin = config.max_bin
+        ds.feature_names = (list(feature_names) if feature_names
+                            else [f"Column_{i}" for i in range(p)])
+
+        if reference is not None:
+            log.check(p == reference.num_total_features,
+                      "validation data has a different number of features")
+            ds.bin_mappers = reference.bin_mappers
+            ds.used_feature_map = reference.used_feature_map
+            ds.real_feature_idx = reference.real_feature_idx
+            ds.bin_offsets = reference.bin_offsets
+            ds.feature_names = reference.feature_names
+            ds.max_bin = reference.max_bin
+            ds._binarize(data)
+            return ds
+
+        # ---- sample rows for bin finding ----
+        sample_cnt = min(config.bin_construct_sample_cnt, n)
+        if sample_indices is None:
+            rng = Random(config.data_random_seed)
+            sample_indices = (np.arange(n, dtype=np.int64) if sample_cnt >= n
+                              else rng.sample(n, sample_cnt).astype(np.int64))
+        sample = data[sample_indices]
+
+        cat_set = set(int(c) for c in categorical_features)
+        ds.bin_mappers = []
+        forced = _load_forced_bins(config.forcedbins_filename, p, config.max_bin)
+        for j in range(p):
+            col = sample[:, j]
+            # drop "zero" values (|v| <= kZeroThreshold); NaN compares False so
+            # NaNs are kept for the missing-type decision
+            non_zero = col[~((col > -1e-35) & (col <= 1e-35))]
+            mapper = BinMapper()
+            bt = BIN_CATEGORICAL if j in cat_set else BIN_NUMERICAL
+            mapper.find_bin(non_zero, len(sample), config.max_bin,
+                            config.min_data_in_bin, config.min_data_in_leaf,
+                            bt, config.use_missing, config.zero_as_missing,
+                            forced.get(j))
+            ds.bin_mappers.append(mapper)
+        ds._finalize_features()
+        ds._binarize(data)
+        return ds
+
+    def _finalize_features(self) -> None:
+        used = [j for j, m in enumerate(self.bin_mappers) if not m.is_trivial]
+        self.used_feature_map = np.full(self.num_total_features, -1, dtype=np.int32)
+        for inner, j in enumerate(used):
+            self.used_feature_map[j] = inner
+        self.real_feature_idx = np.asarray(used, dtype=np.int32)
+        nbins = [self.bin_mappers[j].num_bin for j in used]
+        self.bin_offsets = np.concatenate([[0], np.cumsum(nbins)]).astype(np.int32)
+        if not used:
+            log.warning("There are no meaningful features, as all feature values are constant.")
+
+    def _binarize(self, data: np.ndarray) -> None:
+        used = self.real_feature_idx
+        dtype = np.uint8 if self.max_bin <= 256 else np.uint16
+        X = np.empty((self.num_data, len(used)), dtype=dtype)
+        for inner, j in enumerate(used):
+            X[:, inner] = self.bin_mappers[int(j)].value_to_bin(data[:, int(j)]).astype(dtype)
+        self.X_bin = X
+
+    # ------------------------------------------------------------------
+    def create_valid(self, data: np.ndarray) -> "BinnedDataset":
+        """Bin a validation matrix in this dataset's bin space."""
+        return BinnedDataset.from_matrix(data, Config(), reference=self)
+
+    def feature_max_bins(self) -> np.ndarray:
+        """num_bin per inner feature, int32 [num_features]."""
+        return np.diff(self.bin_offsets).astype(np.int32)
+
+
+def _load_forced_bins(path: str, num_features: int, max_bin: int) -> Dict[int, List[float]]:
+    """Read forced bin bounds from JSON: [{"feature": i, "bin_upper_bound":
+    [...]}] (reference: DatasetLoader::GetForcedBins, dataset_loader.cpp:1246)."""
+    if not path:
+        return {}
+    import json
+    with open(path) as fh:
+        entries = json.load(fh)
+    out: Dict[int, List[float]] = {}
+    for e in entries:
+        j = int(e["feature"])
+        if 0 <= j < num_features:
+            bounds = sorted(float(x) for x in e["bin_upper_bound"])[: max_bin]
+            out[j] = bounds
+    return out

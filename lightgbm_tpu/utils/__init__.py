@@ -1,0 +1,4 @@
+from . import log
+from .random import Random
+
+__all__ = ["log", "Random"]

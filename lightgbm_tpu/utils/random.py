@@ -1,0 +1,41 @@
+"""Deterministic RNG for sampling decisions.
+
+Plays the role of the reference's lightweight ``Random`` helper
+(reference: include/LightGBM/utils/random.h) whose seeds drive bagging,
+feature-fraction and EFB shuffling. We do not reproduce the reference's LCG
+bit-for-bit; we only guarantee determinism for a given seed, which is the
+property the framework (and its tests) rely on. Host-side sampling uses
+NumPy's PCG64; device-side sampling (bagging under jit) uses
+``jax.random`` keys derived from the same seeds.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+class Random:
+    def __init__(self, seed: int = 0):
+        self._gen = np.random.Generator(np.random.PCG64(seed))
+
+    def next_int(self, lo: int, hi: int) -> int:
+        """Uniform int in [lo, hi)."""
+        return int(self._gen.integers(lo, hi))
+
+    def next_float(self) -> float:
+        return float(self._gen.random())
+
+    def sample(self, n: int, k: int) -> np.ndarray:
+        """k distinct indices from range(n), sorted ascending.
+
+        Mirrors the contract of the reference ``Random::Sample`` (used for
+        feature_fraction and bin-sample selection).
+        """
+        k = min(k, n)
+        if k <= 0:
+            return np.empty(0, dtype=np.int32)
+        idx = self._gen.choice(n, size=k, replace=False)
+        idx.sort()
+        return idx.astype(np.int32)
+
+    def permutation(self, n: int) -> np.ndarray:
+        return self._gen.permutation(n).astype(np.int32)

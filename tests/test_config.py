@@ -1,0 +1,78 @@
+"""Config system tests (reference behavior: src/io/config.cpp, config_auto.cpp)."""
+import pytest
+
+from lightgbm_tpu.config import Config, read_config_file
+from lightgbm_tpu.utils.log import LightGBMError
+
+
+def test_defaults():
+    cfg = Config()
+    assert cfg.num_leaves == 31
+    assert cfg.learning_rate == 0.1
+    assert cfg.max_bin == 255
+    assert cfg.objective == "regression"
+    assert cfg.device_type == "tpu"
+
+
+def test_aliases():
+    cfg = Config.from_params({"n_estimators": 50, "eta": 0.3, "num_leaf": 63,
+                              "min_child_samples": 5, "subsample": 0.5,
+                              "colsample_bytree": 0.8, "reg_alpha": 1.0,
+                              "reg_lambda": 2.0, "random_state": 7})
+    assert cfg.num_iterations == 50
+    assert cfg.learning_rate == 0.3
+    assert cfg.num_leaves == 63
+    assert cfg.min_data_in_leaf == 5
+    assert cfg.bagging_fraction == 0.5
+    assert cfg.feature_fraction == 0.8
+    assert cfg.lambda_l1 == 1.0
+    assert cfg.lambda_l2 == 2.0
+    assert cfg.seed == 7
+
+
+def test_objective_aliases():
+    assert Config.from_params({"objective": "mse"}).objective == "regression"
+    assert Config.from_params({"objective": "mae"}).objective == "regression_l1"
+    assert Config.from_params({"application": "xentropy"}).objective == "cross_entropy"
+    cfg = Config.from_params({"objective": "multiclass", "num_class": 3})
+    assert cfg.num_model_per_iteration() == 3
+
+
+def test_string_coercion():
+    cfg = Config.from_params({"num_iterations": "25", "learning_rate": "0.05",
+                              "is_unbalance": "true", "metric": "auc,binary_logloss"})
+    assert cfg.num_iterations == 25
+    assert cfg.learning_rate == 0.05
+    assert cfg.is_unbalance is True
+    assert cfg.metric == ["auc", "binary_logloss"]
+
+
+def test_str2map():
+    m = Config.str2map("task=train data=a.txt num_trees=10")
+    assert m == {"task": "train", "data": "a.txt", "num_trees": "10"}
+
+
+def test_validation_errors():
+    with pytest.raises(LightGBMError):
+        Config.from_params({"num_leaves": 1})
+    with pytest.raises(LightGBMError):
+        Config.from_params({"bagging_fraction": 0.0})
+    with pytest.raises(LightGBMError):
+        Config.from_params({"objective": "multiclass"})  # num_class missing
+    with pytest.raises(LightGBMError):
+        Config.from_params({"tree_learner": "bogus"})
+
+
+def test_parallel_derivation():
+    assert Config.from_params({"tree_learner": "data"}).is_parallel
+    assert not Config.from_params({}).is_parallel
+
+
+def test_config_file(tmp_path):
+    p = tmp_path / "train.conf"
+    p.write_text("# comment\ntask = train\nnum_trees = 7\n\nlearning_rate=0.2 # inline\n")
+    params = read_config_file(str(p))
+    cfg = Config.from_params(params)
+    assert cfg.task == "train"
+    assert cfg.num_iterations == 7
+    assert cfg.learning_rate == 0.2
