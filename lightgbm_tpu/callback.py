@@ -148,6 +148,7 @@ def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
             if first_metric_only and first_metric[0] != eval_name_splitted[-1]:
                 continue
             if eval_ret[0] == "cv_agg" and eval_name_splitted[0] == "train":
+                _final_iteration_check(env, eval_name_splitted, i)
                 continue
             elif env.model is not None and eval_ret[0] == getattr(
                     env.model, "_train_data_name", "training"):

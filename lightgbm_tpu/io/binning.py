@@ -5,7 +5,7 @@ TPU-native rebuild of the reference's ``BinMapper``
 *algorithm* is the same — greedy near-equal-count bin boundaries over a value
 sample, with zero isolated in its own bin, the three missing modes
 {None, Zero, NaN}, and count-ordered categorical mapping — but the
-implementation is host-side NumPy producing a dense ``uint8/uint16`` binned
+implementation is host-side NumPy producing a dense unsigned-int binned
 matrix for the device, instead of per-feature-group ``Bin`` objects.
 
 All bin construction happens once on the host; the device only ever sees the
@@ -372,15 +372,22 @@ class BinMapper:
                 cnt_in_bin[-1] += total_sample_cnt - used_cnt
         return np.asarray(cnt_in_bin, dtype=np.int64)
 
-    @staticmethod
-    def _need_filter(cnt_in_bin: np.ndarray, total_cnt: int, filter_cnt: int) -> bool:
+    def _need_filter(self, cnt_in_bin: np.ndarray, total_cnt: int,
+                     filter_cnt: int) -> bool:
         """True if no split on this feature could satisfy min_data_in_leaf on
-        both sides (reference: NeedFilter, bin.cpp:40-76). Conservative for
-        categoricals: only filters 1-2 bin features."""
-        if len(cnt_in_bin) <= 2:
+        both sides (reference: NeedFilter, bin.cpp:54-76). Numerical features
+        use the cumulative left/right check over every boundary; categoricals
+        are only filtered when they have <= 2 bins (per-bin check)."""
+        if self.bin_type == BIN_NUMERICAL:
             left = 0
             for i in range(len(cnt_in_bin) - 1):
                 left += int(cnt_in_bin[i])
+                if left >= filter_cnt and total_cnt - left >= filter_cnt:
+                    return False
+            return True
+        if len(cnt_in_bin) <= 2:
+            for i in range(len(cnt_in_bin) - 1):
+                left = int(cnt_in_bin[i])
                 if left >= filter_cnt and total_cnt - left >= filter_cnt:
                     return False
             return True
@@ -403,7 +410,10 @@ class BinMapper:
             res = out.astype(np.int32)
         else:
             res = np.full(v.shape, self.num_bin - 1, dtype=np.int32)
-            iv = np.where(np.isnan(v), -1, v).astype(np.int64)
+            # NaN is converted to 0.0 before categorical lookup unless this
+            # feature's missing type is NaN (reference: bin.h:473-478)
+            nan_cat = -1 if self.missing_type == MISSING_NAN else 0
+            iv = np.where(np.isnan(v), nan_cat, v).astype(np.int64)
             for cat, b in self.categorical_2_bin.items():
                 res = np.where(iv == cat, b, res)
         return int(res[0]) if scalar else res

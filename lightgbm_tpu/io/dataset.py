@@ -4,7 +4,8 @@ TPU-native rebuild of the reference's ``Dataset``/``Metadata``/``DatasetLoader``
 (reference: include/LightGBM/dataset.h:41-669, src/io/dataset_loader.cpp).
 Instead of per-feature-group ``Bin`` columns with sparse/dense variants and
 most-frequent-bin elision, the TPU representation is a single dense
-``uint8``/``uint16`` matrix ``X_bin[num_data, num_features]`` laid out for
+unsigned-int matrix ``X_bin[num_data, num_features]`` (uint8 normally; widened
+to uint16/uint32 when a categorical feature exceeds 256 bins) laid out for
 streaming into the Pallas histogram kernel, plus a flat bin-offset table so
 all features share one histogram address space (the analog of the reference's
 ``NumTotalBin`` flat layout). Sparse storage is intentionally dropped: EFB
@@ -95,7 +96,7 @@ class BinnedDataset:
 
     Attributes
     ----------
-    X_bin : np.ndarray  uint8/uint16 [num_data, num_features]
+    X_bin : np.ndarray  uint8/uint16/uint32 [num_data, num_features]
         Binned feature matrix (only non-trivial features).
     bin_mappers : list[BinMapper]
         One per *original* feature column (trivial ones included).
@@ -155,6 +156,8 @@ class BinnedDataset:
         if data.dtype not in (np.float32, np.float64):
             data = data.astype(np.float64)
         n, p = data.shape
+        if n == 0:
+            log.fatal("Cannot construct a Dataset from an empty matrix (0 rows)")
         ds = cls()
         ds.num_data = n
         ds.num_total_features = p
@@ -186,6 +189,9 @@ class BinnedDataset:
         cat_set = set(int(c) for c in categorical_features)
         ds.bin_mappers = []
         forced = _load_forced_bins(config.forcedbins_filename, p, config.max_bin)
+        # min-data filter threshold scaled to the bin-finding sample
+        # (reference: dataset_loader.cpp:599 filter_cnt)
+        filter_cnt = int(config.min_data_in_leaf * len(sample) / n)
         for j in range(p):
             col = sample[:, j]
             # drop "zero" values (|v| <= kZeroThreshold); NaN compares False so
@@ -194,7 +200,7 @@ class BinnedDataset:
             mapper = BinMapper()
             bt = BIN_CATEGORICAL if j in cat_set else BIN_NUMERICAL
             mapper.find_bin(non_zero, len(sample), config.max_bin,
-                            config.min_data_in_bin, config.min_data_in_leaf,
+                            config.min_data_in_bin, filter_cnt,
                             bt, config.use_missing, config.zero_as_missing,
                             forced.get(j))
             ds.bin_mappers.append(mapper)
@@ -215,7 +221,16 @@ class BinnedDataset:
 
     def _binarize(self, data: np.ndarray) -> None:
         used = self.real_feature_idx
-        dtype = np.uint8 if self.max_bin <= 256 else np.uint16
+        # size storage by the ACTUAL bin counts: categorical bin finding can
+        # exceed max_bin (reference sizes by num_bin, bin.cpp CreateBin)
+        widest = int(self.feature_max_bins().max(initial=0))
+        dtype = (np.uint8 if widest <= 256
+                 else np.uint16 if widest <= 65536 else np.uint32)
+        if dtype != np.uint8 and self.max_bin <= 256:
+            log.warning(
+                "A feature has %d bins (> 256, from a high-cardinality "
+                "categorical); the whole binned matrix is widened to %s",
+                widest, np.dtype(dtype).name)
         X = np.empty((self.num_data, len(used)), dtype=dtype)
         for inner, j in enumerate(used):
             X[:, inner] = self.bin_mappers[int(j)].value_to_bin(data[:, int(j)]).astype(dtype)
