@@ -1,12 +1,10 @@
 """lightgbm_tpu — a TPU-native gradient boosting framework.
 
-A ground-up rebuild of LightGBM v2.3.2's capabilities (leaf-wise histogram
-GBDT with GOSS and EFB, the full objective/metric set, gbdt/dart/rf/goss
-boosting, categorical features, distributed feature-/data-/voting-parallel
-training) with the compute plane designed for TPU: an HBM-resident binned
-feature matrix, Pallas histogram kernels, fixed-shape leaf-wise growth under
-``jit``, and collectives expressed as ``jax.lax`` primitives over a device
-mesh.
+A ground-up rebuild of LightGBM v2.3.2's capabilities with the compute plane
+designed for TPU: an HBM-resident binned feature matrix, fixed-shape
+leaf-wise tree growth under ``jit``, histogram construction as one-hot MXU
+matmuls, and distributed modes expressed as ``jax.lax`` collectives over a
+``jax.sharding.Mesh``.
 
 The public API mirrors the reference Python package
 (reference: python-package/lightgbm/__init__.py).

@@ -1,15 +1,397 @@
-"""Public ``Dataset`` / ``Booster`` API (reference: python-package/lightgbm/basic.py).
+"""Public ``Dataset`` / ``Booster`` API
+(reference: python-package/lightgbm/basic.py:712,1666).
 
-Placeholder — filled in as the training engine lands.
+The reference wraps the C library through ctypes; here ``Dataset`` wraps the
+host-side ``BinnedDataset`` construction and ``Booster`` drives the
+device-resident boosting engine directly.
 """
 from __future__ import annotations
 
+from typing import Any, Dict, List, Optional, Sequence
 
-class Dataset:  # pragma: no cover - placeholder
-    def __init__(self, *a, **kw):
-        raise NotImplementedError("Dataset lands with the training engine")
+import numpy as np
+
+from .config import Config
+from .io.dataset import BinnedDataset
+from .utils import log
+from .utils.log import LightGBMError
 
 
-class Booster:  # pragma: no cover - placeholder
-    def __init__(self, *a, **kw):
-        raise NotImplementedError("Booster lands with the training engine")
+def _to_matrix(data) -> np.ndarray:
+    """Accept numpy arrays, lists, pandas DataFrames, scipy sparse."""
+    if hasattr(data, "values") and hasattr(data, "columns"):  # pandas
+        return np.ascontiguousarray(data.values, dtype=np.float64)
+    if hasattr(data, "toarray"):  # scipy sparse
+        return np.ascontiguousarray(data.toarray(), dtype=np.float64)
+    arr = np.asarray(data)
+    if arr.ndim != 2:
+        raise LightGBMError("Data should be 2-D")
+    return np.ascontiguousarray(arr, dtype=np.float64)
+
+
+def _feature_names_of(data) -> Optional[List[str]]:
+    if hasattr(data, "columns"):
+        return [str(c) for c in data.columns]
+    return None
+
+
+class Dataset:
+    """Training/validation dataset with lazy construction
+    (reference: basic.py:712-1664)."""
+
+    def __init__(self, data, label=None, reference: Optional["Dataset"] = None,
+                 weight=None, group=None, init_score=None,
+                 feature_name="auto", categorical_feature="auto",
+                 params: Optional[Dict[str, Any]] = None,
+                 free_raw_data: bool = True, silent: bool = False):
+        self.data = data
+        self.label = label
+        self.reference = reference
+        self.weight = weight
+        self.group = group
+        self.init_score = init_score
+        self.feature_name = feature_name
+        self.categorical_feature = categorical_feature
+        self.params = dict(params) if params else {}
+        self.free_raw_data = free_raw_data
+        self._handle: Optional[BinnedDataset] = None
+        self.used_indices: Optional[np.ndarray] = None
+        self._matrix_cache: Optional[np.ndarray] = None
+
+    # ------------------------------------------------------------------
+    def _resolve_categorical(self, names: List[str]) -> List[int]:
+        cats = self.categorical_feature
+        if cats == "auto" or cats is None:
+            cats = self.params.get("categorical_feature", [])
+        out = []
+        for c in cats or []:
+            if isinstance(c, str):
+                if c in names:
+                    out.append(names.index(c))
+            else:
+                out.append(int(c))
+        return sorted(set(out))
+
+    def construct(self) -> "Dataset":
+        """Build the binned representation (reference: _lazy_init,
+        basic.py:819)."""
+        if self._handle is not None:
+            return self
+        if self.data is None:
+            raise LightGBMError("Cannot construct Dataset: raw data was freed")
+        mat = _to_matrix(self.data)
+        names = _feature_names_of(self.data)
+        if isinstance(self.feature_name, (list, tuple)):
+            names = list(self.feature_name)
+        if names is None:
+            names = [f"Column_{i}" for i in range(mat.shape[1])]
+        config = Config.from_params(self.params)
+        ref_handle = None
+        if self.reference is not None:
+            ref_handle = self.reference.construct()._handle
+        self._handle = BinnedDataset.from_matrix(
+            mat, config,
+            categorical_features=self._resolve_categorical(names),
+            feature_names=names, reference=ref_handle)
+        if self.label is not None:
+            self.set_label(self.label)
+        if self.weight is not None:
+            self.set_weight(self.weight)
+        if self.group is not None:
+            self.set_group(self.group)
+        if self.init_score is not None:
+            self.set_init_score(self.init_score)
+        if self.free_raw_data:
+            self.data = None
+        return self
+
+    # ------------------------------------------------------------------
+    def create_valid(self, data, label=None, weight=None, group=None,
+                     init_score=None, params=None) -> "Dataset":
+        return Dataset(data, label=label, reference=self, weight=weight,
+                       group=group, init_score=init_score,
+                       params=params or self.params,
+                       free_raw_data=self.free_raw_data)
+
+    # -- field setters/getters (reference: set_field/get_field) --------
+    def set_label(self, label) -> None:
+        self.label = label
+        if self._handle is not None:
+            arr = np.asarray(
+                label.values if hasattr(label, "values") else label)
+            self._handle.metadata.set_label(arr.ravel())
+
+    def set_weight(self, weight) -> None:
+        self.weight = weight
+        if self._handle is not None and weight is not None:
+            self._handle.metadata.set_weights(np.asarray(weight).ravel())
+
+    def set_group(self, group) -> None:
+        self.group = group
+        if self._handle is not None and group is not None:
+            self._handle.metadata.set_query(np.asarray(group).ravel())
+
+    def set_init_score(self, init_score) -> None:
+        self.init_score = init_score
+        if self._handle is not None and init_score is not None:
+            self._handle.metadata.set_init_score(np.asarray(init_score).ravel())
+
+    def get_label(self):
+        if self._handle is not None and self._handle.metadata.label is not None:
+            return self._handle.metadata.label
+        return self.label
+
+    def get_weight(self):
+        return self.weight
+
+    def get_group(self):
+        return self.group
+
+    def get_init_score(self):
+        return self.init_score
+
+    def get_data(self):
+        return self.data
+
+    def num_data(self) -> int:
+        if self._handle is not None:
+            return self._handle.num_data
+        return _to_matrix(self.data).shape[0]
+
+    def num_feature(self) -> int:
+        if self._handle is not None:
+            return self._handle.num_total_features
+        return _to_matrix(self.data).shape[1]
+
+    def get_feature_name(self) -> List[str]:
+        self.construct()
+        return list(self._handle.feature_names)
+
+    def subset(self, used_indices: Sequence[int], params=None) -> "Dataset":
+        """Row-subset view constructed in this dataset's bin space."""
+        self.construct()
+        if self.data is None:
+            raise LightGBMError("Cannot subset: raw data was freed; "
+                                "use free_raw_data=False")
+        idx = np.asarray(used_indices)
+        if self._matrix_cache is None:
+            self._matrix_cache = _to_matrix(self.data)
+        sub = Dataset(self._matrix_cache[idx], reference=self,
+                      params=params or self.params,
+                      free_raw_data=self.free_raw_data)
+        if self.label is not None:
+            sub.label = np.asarray(self.label)[idx]
+        if self.weight is not None:
+            sub.weight = np.asarray(self.weight)[idx]
+        if self.init_score is not None:
+            sub.init_score = np.asarray(self.init_score)[idx]
+        if self.group is not None:
+            # group sizes of the selected rows: count consecutive query ids
+            sizes = np.asarray(self.group).ravel()
+            qid = np.repeat(np.arange(len(sizes)), sizes)[idx]
+            _, counts = np.unique(qid, return_counts=True)
+            sub.group = counts
+        sub.used_indices = idx
+        return sub
+
+    def save_binary(self, filename: str) -> "Dataset":
+        """Serialize the constructed dataset (numpy archive rather than the
+        reference's custom binary format; reference: dataset.h:416)."""
+        self.construct()
+        from .io.dataset_io import save_dataset
+        save_dataset(self._handle, filename)
+        return self
+
+
+class Booster:
+    """Trained model handle + training driver (reference: basic.py:1666+)."""
+
+    def __init__(self, params: Optional[Dict[str, Any]] = None,
+                 train_set: Optional[Dataset] = None,
+                 model_file: Optional[str] = None,
+                 model_str: Optional[str] = None, silent: bool = False):
+        self.params = dict(params) if params else {}
+        self.best_iteration = -1
+        self.best_score: Dict = {}
+        self._train_data_name = "training"
+        self.train_set = None
+        self.valid_sets: List[Dataset] = []
+        self._gbdt = None
+
+        if train_set is not None:
+            if not isinstance(train_set, Dataset):
+                raise TypeError(f"Training data should be Dataset instance, "
+                                f"met {type(train_set).__name__}")
+            self._init_train(train_set)
+        elif model_file is not None:
+            from .io.model_io import load_model_file
+            self._gbdt, self.config = load_model_file(model_file)
+        elif model_str is not None:
+            from .io.model_io import load_model_string
+            self._gbdt, self.config = load_model_string(model_str)
+        else:
+            raise TypeError("Need at least one training dataset or model "
+                            "file or model string to create Booster instance")
+
+    # ------------------------------------------------------------------
+    def _init_train(self, train_set: Dataset) -> None:
+        from .boosting import create_boosting
+        from .metric import create_metrics
+        from .objective import create_objective
+
+        self.config = Config.from_params(self.params)
+        train_set.params = {**train_set.params, **self.params}
+        train_set.construct()
+        self.train_set = train_set
+        objective = create_objective(self.config)
+        metrics = create_metrics(self.config)
+        self._gbdt = create_boosting(self.config)
+        self._gbdt.init(self.config, train_set._handle, objective, metrics)
+
+    def add_valid(self, data: Dataset, name: str) -> "Booster":
+        if not isinstance(data, Dataset):
+            raise TypeError(f"Validation data should be Dataset instance, "
+                            f"met {type(data).__name__}")
+        data.construct()
+        self._gbdt.add_valid(data._handle, name)
+        self.valid_sets.append(data)
+        return self
+
+    # ------------------------------------------------------------------
+    def update(self, train_set: Optional[Dataset] = None, fobj=None) -> bool:
+        """One boosting iteration; True = no further splits possible
+        (reference: basic.py:2050, c_api LGBM_BoosterUpdateOneIter)."""
+        if train_set is not None and train_set is not self.train_set:
+            raise LightGBMError("Resetting the training set is not supported; "
+                                "create a new Booster instead")
+        if fobj is None:
+            return self._gbdt.train_one_iter()
+        grad, hess = fobj(self._raw_train_score(), self.train_set)
+        return self._gbdt.train_one_iter(np.asarray(grad), np.asarray(hess))
+
+    def _raw_train_score(self) -> np.ndarray:
+        s = np.asarray(self._gbdt._train_score, dtype=np.float64)
+        return s[:, 0] if self._gbdt.num_tpi == 1 else s
+
+    def rollback_one_iter(self) -> "Booster":
+        self._gbdt.rollback_one_iter()
+        return self
+
+    def current_iteration(self) -> int:
+        return self._gbdt.current_iteration()
+
+    def num_trees(self) -> int:
+        return self._gbdt.num_trees
+
+    def num_model_per_iteration(self) -> int:
+        return self._gbdt.num_tpi
+
+    # ------------------------------------------------------------------
+    def eval_train(self, feval=None) -> List:
+        return [e for e in self._eval_all(feval)
+                if e[0] == self._train_data_name]
+
+    def eval_valid(self, feval=None) -> List:
+        return [e for e in self._eval_all(feval)
+                if e[0] != self._train_data_name]
+
+    def eval(self, data=None, name=None, feval=None) -> List:
+        if data is None:
+            return self._eval_all(feval)
+        if data is self.train_set:
+            return self.eval_train(feval)
+        for i, vds in enumerate(self.valid_sets):
+            if data is vds:
+                want = self._gbdt.valid_names[i]
+                return [e for e in self._eval_all(feval) if e[0] == want]
+        raise LightGBMError("Can only evaluate the training set or a dataset "
+                            "previously attached with add_valid")
+
+    def _eval_all(self, feval=None) -> List:
+        out = []
+        for ds_name, mname, value, hib in self._gbdt.eval_results():
+            if ds_name == "training":
+                ds_name = self._train_data_name
+            out.append((ds_name, mname, value, hib))
+        if feval is not None:
+            def run_feval(score, dataset, tag):
+                res = feval(score, dataset)
+                if res is None:
+                    return
+                entries = res if isinstance(res, list) else [res]
+                for (n, v, hb) in entries:
+                    out.append((tag, n, v, hb))
+            run_feval(self._raw_train_score(), self.train_set,
+                      self._train_data_name)
+            for i, vds in enumerate(self.valid_sets):
+                s = np.asarray(self._gbdt._valid_scores[i], dtype=np.float64)
+                s = s[:, 0] if self._gbdt.num_tpi == 1 else s
+                run_feval(s, vds, self._gbdt.valid_names[i])
+        return out
+
+    # ------------------------------------------------------------------
+    def predict(self, data, num_iteration: Optional[int] = None,
+                raw_score: bool = False, pred_leaf: bool = False,
+                pred_contrib: bool = False, start_iteration: int = 0,
+                **kwargs) -> np.ndarray:
+        mat = _to_matrix(data)
+        if num_iteration is None:
+            num_iteration = self.best_iteration if self.best_iteration > 0 else -1
+        if pred_leaf:
+            return self._gbdt.predict_leaf(mat, num_iteration, start_iteration)
+        if pred_contrib:
+            from .core.shap import predict_contrib
+            return predict_contrib(self._gbdt, mat, num_iteration)
+        return self._gbdt.predict(mat, num_iteration, raw_score,
+                                  start_iteration)
+
+    # ------------------------------------------------------------------
+    def save_model(self, filename: str, num_iteration: Optional[int] = None,
+                   start_iteration: int = 0) -> "Booster":
+        with open(filename, "w") as fh:
+            fh.write(self.model_to_string(num_iteration, start_iteration))
+        return self
+
+    def model_to_string(self, num_iteration: Optional[int] = None,
+                        start_iteration: int = 0) -> str:
+        from .io.model_io import model_to_string
+        if num_iteration is None:
+            num_iteration = self.best_iteration if self.best_iteration > 0 else -1
+        return model_to_string(self._gbdt, num_iteration, start_iteration)
+
+    def feature_importance(self, importance_type: str = "split",
+                           iteration=None) -> np.ndarray:
+        return self._gbdt.feature_importance(importance_type)
+
+    def feature_name(self) -> List[str]:
+        if self._gbdt.train_ds is not None:
+            return list(self._gbdt.train_ds.feature_names)
+        return list(getattr(self._gbdt, "feature_names", []))
+
+    def num_feature(self) -> int:
+        if self._gbdt.train_ds is not None:
+            return self._gbdt.train_ds.num_total_features
+        return len(self.feature_name())
+
+    def reset_parameter(self, params: Dict[str, Any]) -> "Booster":
+        self.params.update(params)
+        self.config.update(params)
+        if self._gbdt is not None and self._gbdt.config is not None:
+            self._gbdt.config = self.config
+            self._gbdt.shrinkage_rate = float(self.config.learning_rate)
+        return self
+
+    def free_dataset(self) -> "Booster":
+        self.train_set = None
+        return self
+
+    def free_network(self) -> "Booster":
+        return self
+
+    def set_network(self, machines, local_listen_port: int = 12400,
+                    listen_time_out: int = 120, num_machines: int = 1) -> "Booster":
+        """Multi-host training is configured through JAX distributed
+        initialization (parallel/), not TCP machine lists."""
+        log.warning("set_network is a no-op: use jax.distributed / the "
+                    "parallel module for multi-host training")
+        return self

@@ -1,0 +1,16 @@
+"""Boosting strategies (reference: src/boosting/boosting.cpp:35-69)."""
+from __future__ import annotations
+
+from ..utils import log
+from .gbdt import GBDT
+
+
+def create_boosting(config):
+    from .dart import DART
+    from .goss import GOSS
+    from .rf import RF
+    types = {"gbdt": GBDT, "gbrt": GBDT, "dart": DART, "goss": GOSS,
+             "rf": RF, "random_forest": RF}
+    if config.boosting not in types:
+        log.fatal(f"Unknown boosting type {config.boosting}")
+    return types[config.boosting]()

@@ -1,0 +1,114 @@
+"""DART — dropout trees (reference: src/boosting/dart.hpp:30-258).
+
+Per iteration: select dropped trees, remove their contribution from the
+train score, train the new tree at shrinkage lr/(k+1), then renormalize the
+dropped trees to k/(k+1) of their weight and patch both train and valid
+scores — following the 3-step shrinkage dance documented at dart.hpp:142-156.
+"""
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from ..utils import log
+from .gbdt import GBDT
+
+
+class DART(GBDT):
+    def init(self, config, train_ds, objective, metrics) -> None:
+        super().init(config, train_ds, objective, metrics)
+        self._drop_rng = np.random.default_rng(config.drop_seed)
+        self.tree_weight: List[float] = []
+        self.sum_weight = 0.0
+        self.drop_index: List[int] = []
+        log.info("Using DART")
+
+    def train_one_iter(self, gradients=None, hessians=None) -> bool:
+        self._dropping_trees()
+        ret = super().train_one_iter(gradients, hessians)
+        if ret:
+            return ret
+        self._normalize()
+        if not self.config.uniform_drop:
+            self.tree_weight.append(self.shrinkage_rate)
+            self.sum_weight += self.shrinkage_rate
+        return False
+
+    # ------------------------------------------------------------------
+    def _add_tree_to_scores(self, tree, k: int, train=True, valid=True) -> None:
+        arrs = self._tree_to_device(tree)
+        if train:
+            from ..core.predict import predict_leaf_bins
+            lid = predict_leaf_bins(arrs, self._bins, self.meta)
+            self._train_score = self._train_score.at[:, k].set(
+                self._apply_leaf(self._train_score[:, k], lid, arrs.leaf_value))
+        if valid:
+            for i in range(len(self._valid_scores)):
+                self._valid_scores[i] = self._valid_scores[i].at[:, k].set(
+                    self._traverse_add(self._valid_scores[i][:, k], arrs,
+                                       self._valid_bins[i]))
+
+    def _dropping_trees(self) -> None:
+        """(reference: dart.hpp:97-140)."""
+        c = self.config
+        self.drop_index = []
+        if self._drop_rng.random() >= c.skip_drop:
+            drop_rate = c.drop_rate
+            if not c.uniform_drop:
+                if self.sum_weight > 0:
+                    inv_avg = len(self.tree_weight) / self.sum_weight
+                    if c.max_drop > 0:
+                        drop_rate = min(drop_rate,
+                                        c.max_drop * inv_avg / self.sum_weight)
+                    for i in range(self.iter_):
+                        if self._drop_rng.random() < drop_rate * self.tree_weight[i] * inv_avg:
+                            self.drop_index.append(self.num_init_iteration + i)
+                            if c.max_drop > 0 and len(self.drop_index) >= c.max_drop:
+                                break
+            else:
+                if c.max_drop > 0 and self.iter_ > 0:
+                    drop_rate = min(drop_rate, c.max_drop / self.iter_)
+                for i in range(self.iter_):
+                    if self._drop_rng.random() < drop_rate:
+                        self.drop_index.append(self.num_init_iteration + i)
+                        if c.max_drop > 0 and len(self.drop_index) >= c.max_drop:
+                            break
+        # remove dropped trees from the training score
+        for i in self.drop_index:
+            for k in range(self.num_tpi):
+                tree = self.models[i * self.num_tpi + k]
+                tree.apply_shrinkage(-1.0)
+                self._add_tree_to_scores(tree, k, train=True, valid=False)
+        kdrop = len(self.drop_index)
+        if not c.xgboost_dart_mode:
+            self.shrinkage_rate = c.learning_rate / (1.0 + kdrop)
+        else:
+            self.shrinkage_rate = (c.learning_rate if kdrop == 0 else
+                                   c.learning_rate / (c.learning_rate + kdrop))
+
+    def _normalize(self) -> None:
+        """(reference: dart.hpp:142-200)."""
+        c = self.config
+        k = float(len(self.drop_index))
+        for i in self.drop_index:
+            for cid in range(self.num_tpi):
+                tree = self.models[i * self.num_tpi + cid]
+                if not c.xgboost_dart_mode:
+                    tree.apply_shrinkage(1.0 / (k + 1.0))
+                    self._add_tree_to_scores(tree, cid, train=False, valid=True)
+                    tree.apply_shrinkage(-k)
+                    self._add_tree_to_scores(tree, cid, train=True, valid=False)
+                else:
+                    tree.apply_shrinkage(self.shrinkage_rate)
+                    self._add_tree_to_scores(tree, cid, train=False, valid=True)
+                    tree.apply_shrinkage(-k / c.learning_rate)
+                    self._add_tree_to_scores(tree, cid, train=True, valid=False)
+            if not c.uniform_drop:
+                j = i - self.num_init_iteration
+                if not c.xgboost_dart_mode:
+                    self.sum_weight -= self.tree_weight[j] * (1.0 / (k + 1.0))
+                    self.tree_weight[j] *= k / (k + 1.0)
+                else:
+                    self.sum_weight -= self.tree_weight[j] * (1.0 / (k + c.learning_rate))
+                    self.tree_weight[j] *= k / (k + c.learning_rate)

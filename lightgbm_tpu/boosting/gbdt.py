@@ -1,0 +1,429 @@
+"""GBDT training loop (reference: src/boosting/gbdt.cpp, gbdt.h).
+
+The compute plane is device-resident: binned matrix, scores, gradients and
+tree growth live on the TPU; per-iteration host work is limited to small
+scalar bookkeeping and the completed tree's arrays (a few KB) for the model.
+
+Correspondence to the reference:
+- ``TrainOneIter`` (gbdt.cpp:368-449): boost-from-average, gradients,
+  bagging, per-class tree growth, leaf renewal, shrinkage, score update.
+- ``ScoreUpdater`` (score_updater.hpp): ``self._scores[name]`` device arrays
+  updated by leaf gather (train) or bin-space traversal (valid sets).
+- Bagging (gbdt.cpp:160-276): per-``bagging_freq`` random row masks.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..config import Config
+from ..core.grower import TreeArrays, make_grower
+from ..core.meta import SplitConfig, build_device_meta
+from ..core.predict import predict_leaf_bins
+from ..core.tree import Tree
+from ..utils import log
+
+K_EPSILON = 1e-15
+
+
+class GBDT:
+    """Gradient Boosting Decision Tree trainer."""
+
+    def __init__(self):
+        self.models: List[Tree] = []
+        self.iter_ = 0
+        self.config: Optional[Config] = None
+        self.objective = None
+        self.train_ds = None
+        self.metrics = []
+        self.valid_ds: List = []
+        self.valid_names: List[str] = []
+        self.valid_metrics: List[List] = []
+        self.num_tpi = 1  # trees per iteration (num_class for multiclass)
+        self.shrinkage_rate = 0.1
+        self.num_init_iteration = 0
+        self._train_score = None      # [N, K] device
+        self._valid_scores: List = []  # [Ni, K] device
+        self.best_iteration = -1
+
+    # ------------------------------------------------------------------
+    def init(self, config: Config, train_ds, objective, metrics) -> None:
+        import jax.numpy as jnp
+
+        self.config = config
+        self.train_ds = train_ds
+        self.objective = objective
+        self.metrics = list(metrics)
+        self.shrinkage_rate = float(config.learning_rate)
+        self.num_tpi = (objective.num_tree_per_iteration
+                        if objective is not None else max(1, config.num_class))
+        if objective is not None:
+            objective.init(train_ds.metadata, train_ds.num_data)
+        for m in self.metrics:
+            m.init(train_ds.metadata, train_ds.num_data)
+
+        self.meta, self.B = build_device_meta(train_ds, config)
+        self.split_cfg = SplitConfig.from_config(config)
+        self._grow = make_grower(self.meta, self.split_cfg, self.B)
+        self._bins = jnp.asarray(train_ds.X_bin)
+        N = train_ds.num_data
+        K = self.num_tpi
+        self._train_score = jnp.zeros((N, K), jnp.float32)
+        if train_ds.metadata.init_score is not None:
+            init = train_ds.metadata.init_score.reshape(K, N).T
+            self._train_score = jnp.asarray(init.astype(np.float32))
+        self._has_init_score = train_ds.metadata.init_score is not None
+        self._rng = np.random.default_rng(config.bagging_seed)
+        self._feat_rng = np.random.default_rng(config.feature_fraction_seed)
+        self._bag_mask = jnp.ones((N,), jnp.float32)
+        self._bag_mask_host = np.ones(N, dtype=bool)
+        self.class_need_train = [
+            objective.class_need_train(k) if objective is not None else True
+            for k in range(K)]
+        self._jit_helpers()
+
+    def _jit_helpers(self) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def apply_leaf(score_col, leaf_id, leaf_values):
+            return score_col + leaf_values[leaf_id]
+
+        @jax.jit
+        def traverse_add(score_col, tree: TreeArrays, bins):
+            leaf = predict_leaf_bins(tree, bins, self.meta)
+            return score_col + tree.leaf_value[leaf]
+
+        self._apply_leaf = apply_leaf
+        self._traverse_add = traverse_add
+
+    # ------------------------------------------------------------------
+    def add_valid(self, valid_ds, name: str) -> None:
+        import jax.numpy as jnp
+        ms = []
+        for proto in self.metrics:
+            m = type(proto)(self.config)
+            m.init(valid_ds.metadata, valid_ds.num_data)
+            ms.append(m)
+        score = jnp.zeros((valid_ds.num_data, self.num_tpi), jnp.float32)
+        if valid_ds.metadata.init_score is not None:
+            init = valid_ds.metadata.init_score.reshape(
+                self.num_tpi, valid_ds.num_data).T
+            score = jnp.asarray(init.astype(np.float32))
+        # replay existing model onto the new valid set
+        bins = jnp.asarray(valid_ds.X_bin)
+        for i, tree in enumerate(self.models):
+            k = i % self.num_tpi
+            arrs = self._tree_to_device(tree)
+            score = score.at[:, k].set(self._traverse_add(score[:, k], arrs, bins))
+        self.valid_ds.append(valid_ds)
+        self.valid_names.append(name)
+        self.valid_metrics.append(ms)
+        self._valid_scores.append(score)
+        self._valid_bins = getattr(self, "_valid_bins", [])
+        self._valid_bins.append(bins)
+
+    def _tree_to_device(self, tree: Tree) -> TreeArrays:
+        """Host Tree -> device arrays (bin space) for score replay."""
+        import jax.numpy as jnp
+        L = self.split_cfg.num_leaves
+        n = max(L - 1, 1)
+        nl = tree.num_leaves
+        nn = max(nl - 1, 0)
+
+        def pad(a, size, fill=0, dtype=None):
+            out = np.full(size, fill, dtype=dtype or a.dtype)
+            out[:len(a)] = a
+            return jnp.asarray(out)
+
+        dl = np.array([(tree.decision_type[i] & 2) != 0 for i in range(nn)], bool)
+        return TreeArrays(
+            split_feature=pad(self._inner_features(tree), n, -1, np.int32),
+            threshold_bin=pad(tree.threshold_bin[:nn], n, 0, np.int32),
+            default_left=pad(dl, n, False, np.bool_),
+            left_child=pad(tree.left_child[:nn], n, 0, np.int32),
+            right_child=pad(tree.right_child[:nn], n, 0, np.int32),
+            split_gain=pad(tree.split_gain[:nn], n, 0, np.float32),
+            internal_value=pad(tree.internal_value[:nn], n, 0, np.float32),
+            internal_count=pad(tree.internal_count[:nn], n, 0, np.int32),
+            internal_weight=pad(tree.internal_weight[:nn], n, 0, np.float32),
+            leaf_value=pad(tree.leaf_value[:nl].astype(np.float32), L, 0.0,
+                           np.float32),
+            leaf_count=pad(tree.leaf_count[:nl], L, 0, np.int32),
+            leaf_weight=pad(tree.leaf_weight[:nl].astype(np.float32), L, 0.0,
+                            np.float32),
+            num_leaves=np.int32(nl),
+        )
+
+    def _inner_features(self, tree: Tree) -> np.ndarray:
+        nn = max(tree.num_leaves - 1, 0)
+        inner = np.zeros(nn, dtype=np.int32)
+        for i in range(nn):
+            inner[i] = int(self.train_ds.used_feature_map[tree.split_feature[i]])
+        return inner
+
+    # ------------------------------------------------------------------
+    def _boost_from_average(self, class_id: int) -> float:
+        """(reference: gbdt.cpp:344-367)."""
+        if (self.models or self._has_init_score or self.objective is None):
+            return 0.0
+        if not (self.config.boost_from_average
+                or self.train_ds.num_features == 0):
+            if self.objective.name in ("regression_l1", "quantile", "mape"):
+                log.warning("Disabling boost_from_average in %s may cause the "
+                            "slow convergence", self.objective.name)
+            return 0.0
+        init = float(self.objective.boost_from_score(class_id))
+        if abs(init) > K_EPSILON:
+            self._train_score = self._train_score.at[:, class_id].add(init)
+            for i in range(len(self._valid_scores)):
+                self._valid_scores[i] = self._valid_scores[i].at[:, class_id].add(init)
+            log.info("Start training from score %f", init)
+            return init
+        return 0.0
+
+    def _bagging(self, it: int, g, h):
+        """Row-subsample mask refresh (reference: gbdt.cpp:160-276). May
+        return modified gradients (GOSS amplification)."""
+        import jax.numpy as jnp
+        c = self.config
+        N = self.train_ds.num_data
+        if c.bagging_freq <= 0 or c.bagging_fraction >= 1.0:
+            return g, h
+        if it % c.bagging_freq != 0:
+            return g, h
+        cnt = int(c.bagging_fraction * N)
+        idx = self._rng.permutation(N)[:cnt]
+        mask = np.zeros(N, dtype=bool)
+        mask[idx] = True
+        self._bag_mask_host = mask
+        self._bag_mask = jnp.asarray(mask.astype(np.float32))
+        return g, h
+
+    def _feature_mask(self):
+        import jax.numpy as jnp
+        F = self.train_ds.num_features
+        frac = float(self.config.feature_fraction)
+        if frac >= 1.0:
+            return jnp.ones((F,), bool)
+        cnt = max(1, int(round(frac * F)))
+        idx = self._feat_rng.permutation(F)[:cnt]
+        mask = np.zeros(F, dtype=bool)
+        mask[idx] = True
+        return jnp.asarray(mask)
+
+    # ------------------------------------------------------------------
+    def train_one_iter(self, gradients=None, hessians=None) -> bool:
+        """Returns True when training should stop (no splittable leaf)
+        (reference: GBDT::TrainOneIter, gbdt.cpp:368-449)."""
+        import jax.numpy as jnp
+        K = self.num_tpi
+        N = self.train_ds.num_data
+
+        init_scores = [0.0] * K
+        if gradients is None or hessians is None:
+            for k in range(K):
+                init_scores[k] = self._boost_from_average(k)
+            score = (self._train_score[:, 0] if K == 1 else self._train_score)
+            g, h = self.objective.get_gradients(score)
+        else:
+            g = jnp.asarray(np.asarray(gradients, dtype=np.float32).reshape(K, N).T)
+            h = jnp.asarray(np.asarray(hessians, dtype=np.float32).reshape(K, N).T)
+        if g.ndim == 1:
+            g = g[:, None]
+            h = h[:, None]
+
+        g, h = self._bagging(self.iter_, g, h)
+        feature_mask = self._feature_mask()
+
+        should_continue = False
+        for k in range(K):
+            tree = None
+            if self.class_need_train[k] and self.train_ds.num_features > 0:
+                arrs, leaf_id = self._grow(self._bins, g[:, k], h[:, k],
+                                           self._bag_mask, feature_mask)
+                nl = int(arrs.num_leaves)
+            else:
+                arrs, leaf_id, nl = None, None, 1
+
+            if nl > 1:
+                should_continue = True
+                arrs = self._renew_tree_output(arrs, leaf_id, k)
+                # shrinkage + score updates in device space
+                lv = arrs.leaf_value * self.shrinkage_rate
+                arrs = arrs._replace(
+                    leaf_value=lv,
+                    internal_value=arrs.internal_value * self.shrinkage_rate)
+                self._train_score = self._train_score.at[:, k].set(
+                    self._apply_leaf(self._train_score[:, k], leaf_id, lv))
+                for i in range(len(self._valid_scores)):
+                    self._valid_scores[i] = self._valid_scores[i].at[:, k].set(
+                        self._traverse_add(self._valid_scores[i][:, k], arrs,
+                                           self._valid_bins[i]))
+                tree = Tree.from_device(arrs, self.train_ds,
+                                        shrinkage=self.shrinkage_rate)
+                if abs(init_scores[k]) > K_EPSILON:
+                    tree.leaf_value = tree.leaf_value + init_scores[k]
+            else:
+                # constant tree, only for the first iteration
+                # (reference: gbdt.cpp:418-436)
+                output = 0.0
+                if len(self.models) < K:
+                    if not self.class_need_train[k] and self.objective is not None:
+                        output = float(self.objective.boost_from_score(k))
+                    else:
+                        output = init_scores[k]
+                    if abs(output) > K_EPSILON:
+                        self._train_score = self._train_score.at[:, k].add(output)
+                        for i in range(len(self._valid_scores)):
+                            self._valid_scores[i] = self._valid_scores[i].at[:, k].add(output)
+                tree = _constant_tree(output)
+            self.models.append(tree)
+
+        if not should_continue:
+            log.warning("Stopped training because there are no more leaves "
+                        "that meet the split requirements")
+            if len(self.models) > K:
+                del self.models[-K:]
+            return True
+        self.iter_ += 1
+        return False
+
+    def _renew_tree_output(self, arrs: TreeArrays, leaf_id, class_id: int):
+        """Percentile leaf refit for L1-family objectives
+        (reference: serial_tree_learner.cpp:855-893)."""
+        if self.objective is None or not self.objective.is_renew_tree_output:
+            return arrs
+        import jax.numpy as jnp
+        nl = int(arrs.num_leaves)
+        score = np.asarray(self._train_score[:, class_id], dtype=np.float64)
+        residual = self.train_ds.metadata.label.astype(np.float64) - score
+        lid = np.asarray(leaf_id)
+        new_vals = self.objective.renew_leaf_values(
+            residual, lid, nl, self._bag_mask_host)
+        lv = np.asarray(arrs.leaf_value).copy()
+        ok = ~np.isnan(new_vals)
+        lv[:nl][ok] = new_vals[ok]
+        return arrs._replace(leaf_value=jnp.asarray(lv))
+
+    # ------------------------------------------------------------------
+    def rollback_one_iter(self) -> None:
+        """(reference: gbdt.cpp:451-467)."""
+        import jax.numpy as jnp
+        if self.iter_ <= 0:
+            return
+        K = self.num_tpi
+        for k in range(K):
+            tree = self.models[len(self.models) - K + k]
+            arrs = self._tree_to_device(tree)
+            neg = arrs._replace(leaf_value=-arrs.leaf_value)
+            lid = predict_leaf_bins(neg, self._bins, self.meta)
+            self._train_score = self._train_score.at[:, k].set(
+                self._apply_leaf(self._train_score[:, k], lid, neg.leaf_value))
+            for i in range(len(self._valid_scores)):
+                self._valid_scores[i] = self._valid_scores[i].at[:, k].set(
+                    self._traverse_add(self._valid_scores[i][:, k], neg,
+                                       self._valid_bins[i]))
+        del self.models[-K:]
+        self.iter_ -= 1
+
+    # ------------------------------------------------------------------
+    def eval_results(self) -> List[Tuple]:
+        """All (data_name, metric_name, value, higher_better) entries
+        (reference: GBDT::OutputMetric, gbdt.cpp:513-571)."""
+        out = []
+        score = self._score_for_metrics(self._train_score)
+        for m in self.metrics:
+            for name, value, hib in m.eval(score, self.objective):
+                out.append(("training", name, value, hib))
+        for i, name in enumerate(self.valid_names):
+            vscore = self._score_for_metrics(self._valid_scores[i])
+            for m in self.valid_metrics[i]:
+                for mname, value, hib in m.eval(vscore, self.objective):
+                    out.append((name, mname, value, hib))
+        return out
+
+    def _score_for_metrics(self, score):
+        s = np.asarray(score, dtype=np.float64)
+        return s[:, 0] if self.num_tpi == 1 else s
+
+    # ------------------------------------------------------------------
+    def _iter_window(self, num_iteration: Optional[int],
+                     start_iteration: int = 0) -> Tuple[int, int]:
+        """Resolve (start, stop) boosting-iteration bounds."""
+        n_iters = len(self.models) // self.num_tpi
+        stop = n_iters if num_iteration is None or num_iteration <= 0 \
+            else min(start_iteration + num_iteration, n_iters)
+        return start_iteration, stop
+
+    def predict_raw(self, X: np.ndarray, num_iteration: Optional[int] = None,
+                    start_iteration: int = 0) -> np.ndarray:
+        X = np.ascontiguousarray(X, dtype=np.float64)
+        K = self.num_tpi
+        start, stop = self._iter_window(num_iteration, start_iteration)
+        out = np.zeros((X.shape[0], K))
+        for it in range(start, stop):
+            for k in range(K):
+                out[:, k] += self.models[it * K + k].predict(X)
+        return out
+
+    def predict(self, X, num_iteration=None, raw_score=False,
+                start_iteration: int = 0) -> np.ndarray:
+        raw = self.predict_raw(X, num_iteration, start_iteration)
+        if not raw_score and self.objective is not None:
+            conv = self.objective.convert_output(
+                raw if self.num_tpi > 1 else raw[:, 0])
+            return np.asarray(conv)
+        return raw if self.num_tpi > 1 else raw[:, 0]
+
+    def predict_leaf(self, X, num_iteration=None,
+                     start_iteration: int = 0) -> np.ndarray:
+        X = np.ascontiguousarray(X, dtype=np.float64)
+        K = self.num_tpi
+        start, stop = self._iter_window(num_iteration, start_iteration)
+        cols = []
+        for it in range(start, stop):
+            for k in range(K):
+                cols.append(self.models[it * K + k].predict_leaf(X))
+        return np.stack(cols, axis=1) if cols else np.zeros((X.shape[0], 0))
+
+    @property
+    def num_trees(self) -> int:
+        return len(self.models)
+
+    def current_iteration(self) -> int:
+        return len(self.models) // self.num_tpi
+
+    def feature_importance(self, importance_type: str = "split") -> np.ndarray:
+        """(reference: GBDT::FeatureImportance, gbdt.cpp:573-600)."""
+        imp = np.zeros(self.train_ds.num_total_features)
+        for tree in self.models:
+            nn = max(tree.num_leaves - 1, 0)
+            for i in range(nn):
+                f = int(tree.split_feature[i])
+                if importance_type == "split":
+                    imp[f] += 1.0
+                else:
+                    imp[f] += max(0.0, float(tree.split_gain[i]))
+        return imp
+
+
+def _constant_tree(output: float) -> Tree:
+    t = Tree(
+        num_leaves=1,
+        split_feature=np.zeros(0, np.int32),
+        threshold=np.zeros(0, np.float64),
+        threshold_bin=np.zeros(0, np.int32),
+        decision_type=np.zeros(0, np.int32),
+        left_child=np.zeros(0, np.int32), right_child=np.zeros(0, np.int32),
+        leaf_value=np.array([output], np.float64),
+        leaf_count=np.zeros(1, np.int32),
+        leaf_weight=np.zeros(1, np.float64),
+        split_gain=np.zeros(0, np.float64),
+        internal_value=np.zeros(0, np.float64),
+        internal_count=np.zeros(0, np.int32),
+        internal_weight=np.zeros(0, np.float64),
+    )
+    return t

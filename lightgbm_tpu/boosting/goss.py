@@ -1,0 +1,57 @@
+"""GOSS — gradient-based one-side sampling
+(reference: src/boosting/goss.hpp:30-217).
+
+The reference's per-thread sequential sampler becomes a device-side
+``top_k`` + Bernoulli mask: keep the ``top_rate`` fraction by |g*h|, sample
+``other_rate`` of the rest uniformly, and amplify the sampled rest's
+gradients by ``(1 - top_rate) / other_rate`` (goss.hpp:91-139).  Sampling
+probability is the fixed ``other_k / rest_k`` instead of the reference's
+running-remainder scheme — identical in expectation.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..utils import log
+from .gbdt import GBDT
+
+
+class GOSS(GBDT):
+    def init(self, config, train_ds, objective, metrics) -> None:
+        super().init(config, train_ds, objective, metrics)
+        if config.top_rate + config.other_rate > 1.0:
+            log.fatal("top_rate + other_rate should be <= 1.0 in GOSS")
+        if config.top_rate <= 0.0 or config.other_rate <= 0.0:
+            log.fatal("top_rate and other_rate should be positive in GOSS")
+        if config.bagging_freq > 0 and config.bagging_fraction != 1.0:
+            log.fatal("Cannot use bagging in GOSS")
+        log.info("Using GOSS")
+
+    def _bagging(self, it: int, g, h):
+        import jax
+        import jax.numpy as jnp
+        N = self.train_ds.num_data
+        # no sampling for the first 1/learning_rate iterations
+        # (reference: goss.hpp:144-146)
+        if it < int(1.0 / self.config.learning_rate):
+            self._bag_mask = jnp.ones((N,), jnp.float32)
+            self._bag_mask_host = np.ones(N, dtype=bool)
+            return g, h
+
+        top_k = max(1, int(N * self.config.top_rate))
+        other_k = max(1, int(N * self.config.other_rate))
+        multiply = (N - top_k) / other_k
+
+        weight = jnp.abs(g * h).sum(axis=1)  # summed over classes
+        threshold = jax.lax.top_k(weight, top_k)[0][-1]
+        is_top = weight >= threshold
+        rest_k = jnp.maximum(jnp.sum(~is_top), 1)
+        key = jax.random.fold_in(
+            jax.random.PRNGKey(self.config.bagging_seed), it)
+        unif = jax.random.uniform(key, (N,))
+        sampled_rest = (~is_top) & (unif < other_k / rest_k)
+        mask = is_top | sampled_rest
+        amp = jnp.where(sampled_rest, multiply, 1.0)[:, None].astype(jnp.float32)
+        self._bag_mask = mask.astype(jnp.float32)
+        self._bag_mask_host = np.asarray(mask)
+        return g * amp, h * amp

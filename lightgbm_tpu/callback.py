@@ -1,18 +1,22 @@
-"""Training callbacks (reference: python-package/lightgbm/callback.py:55-247).
+"""Training callbacks.
 
-Each callback is a callable taking a ``CallbackEnv`` namedtuple; callbacks with
-``before_iteration = True`` run before the boosting update, others after.
+Implements the same public contract as the reference callback bus
+(reference: python-package/lightgbm/callback.py — ``CallbackEnv`` fields,
+``EarlyStopException``, the four factory functions, ``order`` /
+``before_iteration`` attributes) but as callable classes holding explicit
+state objects rather than closures over mutable cells.
+
+An evaluation entry is the tuple ``(dataset_name, metric_name, value,
+higher_is_better)`` — cv adds a fifth stdv element.
 """
 from __future__ import annotations
 
-import collections
-from typing import Callable, Dict, List
-
-from .utils import log
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 
 class EarlyStopException(Exception):
-    """Raised by callbacks to stop training (reference: callback.py:14)."""
+    """Signals the training loop to stop at ``best_iteration``."""
 
     def __init__(self, best_iteration: int, best_score):
         super().__init__()
@@ -20,148 +24,215 @@ class EarlyStopException(Exception):
         self.best_score = best_score
 
 
-CallbackEnv = collections.namedtuple(
-    "CallbackEnv",
-    ["model", "params", "iteration", "begin_iteration", "end_iteration",
-     "evaluation_result_list"])
+@dataclass
+class CallbackEnv:
+    """Snapshot passed to every callback once per iteration."""
+    model: Any
+    params: Dict[str, Any]
+    iteration: int
+    begin_iteration: int
+    end_iteration: int
+    evaluation_result_list: List[Tuple]
 
 
-def _format_eval_result(value, show_stdv: bool = True) -> str:
-    if len(value) == 4:
-        return f"{value[0]}'s {value[1]}: {value[2]:g}"
-    if len(value) == 5:
-        if show_stdv:
-            return f"{value[0]}'s {value[1]}: {value[2]:g} + {value[4]:g}"
-        return f"{value[0]}'s {value[1]}: {value[2]:g}"
-    raise ValueError("Wrong metric value")
+def _entry_to_str(entry: Tuple, show_stdv: bool = True) -> str:
+    name, metric, value = entry[0], entry[1], entry[2]
+    if len(entry) == 5 and show_stdv:
+        return f"{name}'s {metric}: {value:g} + {entry[4]:g}"
+    if len(entry) not in (4, 5):
+        raise ValueError(f"Wrong metric value: {entry!r}")
+    return f"{name}'s {metric}: {value:g}"
+
+
+def _results_to_str(entries: List[Tuple], show_stdv: bool = True) -> str:
+    return "\t".join(_entry_to_str(e, show_stdv) for e in entries)
+
+
+class _LogEvaluation:
+    """Logs the evaluation line every ``period`` iterations."""
+
+    order = 10
+    before_iteration = False
+
+    def __init__(self, period: int, show_stdv: bool):
+        self.period = period
+        self.show_stdv = show_stdv
+
+    def __call__(self, env: CallbackEnv) -> None:
+        from .utils import log
+        it = env.iteration + 1
+        if self.period > 0 and env.evaluation_result_list and it % self.period == 0:
+            log.info("[%d]\t%s", it,
+                     _results_to_str(env.evaluation_result_list, self.show_stdv))
 
 
 def print_evaluation(period: int = 1, show_stdv: bool = True) -> Callable:
-    """Log evaluation results every ``period`` iterations."""
-    def _callback(env: CallbackEnv) -> None:
-        if period > 0 and env.evaluation_result_list and (env.iteration + 1) % period == 0:
-            result = "\t".join(
-                _format_eval_result(x, show_stdv) for x in env.evaluation_result_list)
-            log.info("[%d]\t%s", env.iteration + 1, result)
-    _callback.order = 10
-    return _callback
+    """Create a callback that logs evaluation results every ``period`` iters."""
+    return _LogEvaluation(period, show_stdv)
+
+
+class _RecordEvaluation:
+    """Appends each metric value into a nested ``{data: {metric: [...]}}`` dict."""
+
+    order = 20
+    before_iteration = False
+
+    def __init__(self, store: Dict):
+        if not isinstance(store, dict):
+            raise TypeError("eval_result should be a dictionary")
+        store.clear()
+        self.store = store
+
+    def __call__(self, env: CallbackEnv) -> None:
+        for entry in env.evaluation_result_list:
+            data_name, metric_name, value = entry[0], entry[1], entry[2]
+            self.store.setdefault(data_name, {}).setdefault(metric_name, []).append(value)
+            if len(entry) == 5:
+                self.store[data_name].setdefault(f"{metric_name}-stdv", []).append(entry[4])
 
 
 def record_evaluation(eval_result: Dict) -> Callable:
-    """Record evaluation results into ``eval_result``."""
-    if not isinstance(eval_result, dict):
-        raise TypeError("eval_result should be a dictionary")
-    eval_result.clear()
+    """Create a callback recording evaluation history into ``eval_result``."""
+    return _RecordEvaluation(eval_result)
 
-    def _init(env: CallbackEnv) -> None:
-        for data_name, eval_name, _, _ in (x[:4] for x in env.evaluation_result_list):
-            eval_result.setdefault(data_name, collections.OrderedDict())
-            eval_result[data_name].setdefault(eval_name, [])
 
-    def _callback(env: CallbackEnv) -> None:
-        if not eval_result:
-            _init(env)
-        for data_name, eval_name, result, _ in (x[:4] for x in env.evaluation_result_list):
-            eval_result[data_name][eval_name].append(result)
-    _callback.order = 20
-    return _callback
+class _ResetParameter:
+    """Applies per-iteration parameter schedules (lists or callables)."""
+
+    order = 10
+    before_iteration = True
+
+    def __init__(self, schedules: Dict[str, Any]):
+        self.schedules = schedules
+
+    def _value_at(self, key: str, value, step: int, total: int):
+        if isinstance(value, list):
+            if len(value) != total:
+                raise ValueError(
+                    f"Length of list {key!r} has to equal to 'num_boost_round'.")
+            return value[step]
+        if callable(value):
+            return value(step)
+        raise ValueError(f"Schedule for {key!r} must be a list or a callable")
+
+    def __call__(self, env: CallbackEnv) -> None:
+        step = env.iteration - env.begin_iteration
+        total = env.end_iteration - env.begin_iteration
+        changed = {}
+        for key, sched in self.schedules.items():
+            new = self._value_at(key, sched, step, total)
+            if env.params.get(key) != new:
+                changed[key] = new
+        if changed:
+            if env.model is not None:
+                env.model.reset_parameter(changed)
+            env.params.update(changed)
 
 
 def reset_parameter(**kwargs) -> Callable:
-    """Reset a parameter per iteration from a list or schedule function."""
-    def _callback(env: CallbackEnv) -> None:
-        new_parameters = {}
-        for key, value in kwargs.items():
-            if isinstance(value, list):
-                if len(value) != env.end_iteration - env.begin_iteration:
-                    raise ValueError(f"Length of list {key} has to equal to 'num_boost_round'.")
-                new_param = value[env.iteration - env.begin_iteration]
-            else:
-                new_param = value(env.iteration - env.begin_iteration)
-            if new_param != env.params.get(key, None):
-                new_parameters[key] = new_param
-        if new_parameters:
-            env.model.reset_parameter(new_parameters)
-            env.params.update(new_parameters)
-    _callback.before_iteration = True
-    _callback.order = 10
-    return _callback
+    """Create a callback that resets parameters on a schedule each iteration."""
+    return _ResetParameter(kwargs)
+
+
+@dataclass
+class _MetricState:
+    """Best-so-far tracking for one (dataset, metric) pair."""
+    higher_is_better: bool
+    best_score: float = None  # type: ignore[assignment]
+    best_iter: int = 0
+    best_results: Optional[List[Tuple]] = field(default=None)
+
+    def update(self, score: float, iteration: int, results: List[Tuple]) -> bool:
+        better = (self.best_results is None
+                  or (score > self.best_score if self.higher_is_better
+                      else score < self.best_score))
+        if better:
+            self.best_score = score
+            self.best_iter = iteration
+            self.best_results = results
+        return better
+
+
+class _EarlyStopping:
+    """Stops training when no validation metric improves for N rounds.
+
+    Train-set entries never trigger a stop (they almost always improve);
+    they only participate in the mandatory final-iteration report, matching
+    the reference behavior including the cv ``cv_agg``/train special case.
+    """
+
+    order = 30
+    before_iteration = False
+
+    def __init__(self, stopping_rounds: int, first_metric_only: bool, verbose: bool):
+        self.stopping_rounds = stopping_rounds
+        self.first_metric_only = first_metric_only
+        self.verbose = verbose
+        self.states: Optional[List[_MetricState]] = None
+        self.enabled = True
+        self.first_metric = ""
+
+    # -- helpers -------------------------------------------------------
+    def _setup(self, env: CallbackEnv) -> None:
+        from .utils import log
+        boosting = next((env.params[k] for k in ("boosting", "boosting_type", "boost")
+                         if k in env.params), "gbdt")
+        self.enabled = boosting != "dart"
+        if not self.enabled:
+            log.warning("Early stopping is not available in dart mode")
+            return
+        if not env.evaluation_result_list:
+            raise ValueError("For early stopping, at least one dataset and eval "
+                             "metric is required for evaluation")
+        if self.verbose:
+            log.info("Training until validation scores don't improve for %d rounds",
+                     self.stopping_rounds)
+        self.first_metric = self._metric_key(env.evaluation_result_list[0])
+        self.states = [_MetricState(higher_is_better=bool(e[3]))
+                       for e in env.evaluation_result_list]
+
+    @staticmethod
+    def _metric_key(entry: Tuple) -> str:
+        return entry[1].split(" ")[-1]
+
+    def _is_train_entry(self, env: CallbackEnv, entry: Tuple) -> bool:
+        if entry[0] == "cv_agg":
+            return entry[1].split(" ")[0] == "train"
+        train_name = getattr(env.model, "_train_data_name", "training")
+        return entry[0] == train_name
+
+    def _report_and_stop(self, state: _MetricState, reason: str) -> None:
+        from .utils import log
+        if self.verbose:
+            log.info("%s, best iteration is:\n[%d]\t%s", reason,
+                     state.best_iter + 1, _results_to_str(state.best_results))
+            if self.first_metric_only:
+                log.info("Evaluated only: %s", self.first_metric)
+        raise EarlyStopException(state.best_iter, state.best_results)
+
+    # -- main ----------------------------------------------------------
+    def __call__(self, env: CallbackEnv) -> None:
+        if self.states is None:
+            self._setup(env)
+        if not self.enabled:
+            return
+        last_iter = env.iteration == env.end_iteration - 1
+        for state, entry in zip(self.states, env.evaluation_result_list):
+            state.update(float(entry[2]), env.iteration, env.evaluation_result_list)
+            if self.first_metric_only and self.first_metric != self._metric_key(entry):
+                continue
+            if self._is_train_entry(env, entry):
+                if last_iter:
+                    self._report_and_stop(state, "Did not meet early stopping")
+                continue
+            if env.iteration - state.best_iter >= self.stopping_rounds:
+                self._report_and_stop(state, "Early stopping")
+            if last_iter:
+                self._report_and_stop(state, "Did not meet early stopping")
 
 
 def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
                    verbose: bool = True) -> Callable:
-    """Stop training when no validation metric improves for
-    ``stopping_rounds`` rounds (reference: callback.py:152-247)."""
-    best_score: List[float] = []
-    best_iter: List[int] = []
-    best_score_list: List = []
-    cmp_op: List[Callable] = []
-    enabled = [True]
-    first_metric = [""]
-
-    def _init(env: CallbackEnv) -> None:
-        enabled[0] = not any(
-            env.params.get(alias, "") == "dart"
-            for alias in ("boosting", "boosting_type", "boost"))
-        if not enabled[0]:
-            log.warning("Early stopping is not available in dart mode")
-            return
-        if not env.evaluation_result_list:
-            raise ValueError("For early stopping, at least one dataset and eval metric "
-                             "is required for evaluation")
-        if verbose:
-            log.info("Training until validation scores don't improve for %d rounds",
-                     stopping_rounds)
-        first_metric[0] = env.evaluation_result_list[0][1].split(" ")[-1]
-        for eval_ret in env.evaluation_result_list:
-            best_iter.append(0)
-            best_score_list.append(None)
-            if eval_ret[3]:  # higher is better
-                best_score.append(float("-inf"))
-                cmp_op.append(lambda a, b: a > b)
-            else:
-                best_score.append(float("inf"))
-                cmp_op.append(lambda a, b: a < b)
-
-    def _final_iteration_check(env: CallbackEnv, eval_name_splitted, i: int) -> None:
-        if env.iteration == env.end_iteration - 1:
-            if verbose:
-                log.info("Did not meet early stopping. Best iteration is:\n[%d]\t%s",
-                         best_iter[i] + 1,
-                         "\t".join(_format_eval_result(x) for x in best_score_list[i]))
-                if first_metric_only:
-                    log.info("Evaluated only: %s", eval_name_splitted[-1])
-            raise EarlyStopException(best_iter[i], best_score_list[i])
-
-    def _callback(env: CallbackEnv) -> None:
-        if not cmp_op:
-            _init(env)
-        if not enabled[0]:
-            return
-        for i, eval_ret in enumerate(env.evaluation_result_list):
-            score = eval_ret[2]
-            if best_score_list[i] is None or cmp_op[i](score, best_score[i]):
-                best_score[i] = score
-                best_iter[i] = env.iteration
-                best_score_list[i] = env.evaluation_result_list
-            eval_name_splitted = eval_ret[1].split(" ")
-            if first_metric_only and first_metric[0] != eval_name_splitted[-1]:
-                continue
-            if eval_ret[0] == "cv_agg" and eval_name_splitted[0] == "train":
-                _final_iteration_check(env, eval_name_splitted, i)
-                continue
-            elif env.model is not None and eval_ret[0] == getattr(
-                    env.model, "_train_data_name", "training"):
-                _final_iteration_check(env, eval_name_splitted, i)
-                continue
-            if env.iteration - best_iter[i] >= stopping_rounds:
-                if verbose:
-                    log.info("Early stopping, best iteration is:\n[%d]\t%s",
-                             best_iter[i] + 1,
-                             "\t".join(_format_eval_result(x) for x in best_score_list[i]))
-                    if first_metric_only:
-                        log.info("Evaluated only: %s", eval_name_splitted[-1])
-                raise EarlyStopException(best_iter[i], best_score_list[i])
-            _final_iteration_check(env, eval_name_splitted, i)
-    _callback.order = 30
-    return _callback
+    """Create a callback that stops training when no validation metric has
+    improved for ``stopping_rounds`` consecutive rounds."""
+    return _EarlyStopping(stopping_rounds, first_metric_only, verbose)

@@ -1,0 +1,1 @@
+"""Device compute core: histograms, split finding, tree growth, prediction."""

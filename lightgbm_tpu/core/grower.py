@@ -1,0 +1,271 @@
+"""Leaf-wise (best-first) tree growth under ``jit``.
+
+TPU-native rebuild of the reference's serial tree learner
+(reference: src/treelearner/serial_tree_learner.cpp:173-237 Train loop,
+:400-477 BeforeFindBestSplit, :524-605 FindBestSplitsFromHistograms,
+:771-852 Split).  The reference's dynamic structures map to fixed-shape
+arrays:
+
+- ``DataPartition`` (permuted row indices per leaf) becomes a dense
+  ``leaf_id: int32[N]`` vector; applying a split is a vectorized ``where``.
+- The LRU ``HistogramPool`` becomes a fixed ``[L, F, B, 3]`` buffer indexed
+  by leaf; the left child reuses the parent's slot exactly like the
+  reference reuses the parent's leaf index.
+- Histogram subtraction for the sibling (serial_tree_learner.cpp:567) is a
+  pure array op; only the smaller child pays a histogram pass.
+- The whole tree grows inside one ``lax.fori_loop``; a ``lax.cond`` skips
+  the split body once no leaf has positive gain, so early stopping costs
+  nothing but predicated no-ops.
+
+Monotone value-constraint propagation follows the reference's midpoint rule
+(serial_tree_learner.cpp:841-851).
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..io.binning import MISSING_NAN, MISSING_ZERO
+from .histogram import hist_onehot
+from .meta import DeviceMeta, SplitConfig
+from .splitter import BestSplit, best_split, leaf_output
+
+NEG_INF = -jnp.inf
+
+
+class TreeArrays(NamedTuple):
+    """Fixed-capacity SoA tree (reference: include/LightGBM/tree.h:360-445).
+
+    Internal nodes are indexed 0..L-2 in split order; children < 0 encode
+    leaves as ``~leaf_index``. Leaves are indexed 0..L-1 (left child keeps
+    the parent's leaf index, the right child takes the next free one).
+    """
+    split_feature: jnp.ndarray   # i32 [L-1] inner feature (-1 = unused node)
+    threshold_bin: jnp.ndarray   # i32 [L-1]
+    default_left: jnp.ndarray    # bool [L-1]
+    left_child: jnp.ndarray      # i32 [L-1]
+    right_child: jnp.ndarray     # i32 [L-1]
+    split_gain: jnp.ndarray      # f32 [L-1]
+    internal_value: jnp.ndarray  # f32 [L-1] output the node had as a leaf
+    internal_count: jnp.ndarray  # i32 [L-1]
+    internal_weight: jnp.ndarray  # f32 [L-1] sum_hessian
+    leaf_value: jnp.ndarray      # f32 [L]
+    leaf_count: jnp.ndarray      # i32 [L]
+    leaf_weight: jnp.ndarray     # f32 [L] sum_hessian
+    num_leaves: jnp.ndarray      # i32 scalar
+
+
+class _GrowState(NamedTuple):
+    leaf_id: jnp.ndarray      # i32 [N]
+    hist: jnp.ndarray         # f32 [L, F, B, 3]
+    leaf_g: jnp.ndarray       # f32 [L]
+    leaf_h: jnp.ndarray       # f32 [L]
+    leaf_c: jnp.ndarray       # f32 [L]
+    leaf_depth: jnp.ndarray   # i32 [L]
+    leaf_min_c: jnp.ndarray   # f32 [L] monotone lower bound on output
+    leaf_max_c: jnp.ndarray   # f32 [L]
+    leaf_out: jnp.ndarray     # f32 [L] current (constrained) output
+    best_gain: jnp.ndarray    # f32 [L]
+    best_feat: jnp.ndarray    # i32 [L]
+    best_thr: jnp.ndarray     # i32 [L]
+    best_dl: jnp.ndarray      # bool [L]
+    best_lg: jnp.ndarray      # f32 [L]
+    best_lh: jnp.ndarray      # f32 [L]
+    best_lc: jnp.ndarray      # f32 [L]
+    leaf_parent: jnp.ndarray  # i32 [L] node whose child slot is this leaf
+    leaf_is_right: jnp.ndarray  # bool [L]
+    tree: TreeArrays
+
+
+def _empty_tree(L: int) -> TreeArrays:
+    n = max(L - 1, 1)
+    return TreeArrays(
+        split_feature=jnp.full((n,), -1, jnp.int32),
+        threshold_bin=jnp.zeros((n,), jnp.int32),
+        default_left=jnp.zeros((n,), bool),
+        left_child=jnp.zeros((n,), jnp.int32),
+        right_child=jnp.zeros((n,), jnp.int32),
+        split_gain=jnp.zeros((n,), jnp.float32),
+        internal_value=jnp.zeros((n,), jnp.float32),
+        internal_count=jnp.zeros((n,), jnp.int32),
+        internal_weight=jnp.zeros((n,), jnp.float32),
+        leaf_value=jnp.zeros((L,), jnp.float32),
+        leaf_count=jnp.zeros((L,), jnp.int32),
+        leaf_weight=jnp.zeros((L,), jnp.float32),
+        num_leaves=jnp.int32(1),
+    )
+
+
+def go_left_bins(col, threshold, default_left, missing_type, num_bin, default_bin):
+    """Bin-space split decision for every row (reference:
+    src/io/dense_bin.hpp:152-231 Split).  ``col`` int32 [N]."""
+    is_missing = (((missing_type == MISSING_NAN) & (col == num_bin - 1))
+                  | ((missing_type == MISSING_ZERO) & (col == default_bin)))
+    return jnp.where(is_missing, default_left, col <= threshold)
+
+
+def make_grower(meta: DeviceMeta, cfg: SplitConfig, B: int, hist_fn=hist_onehot):
+    """Build a jitted ``grow(bins, g, h, sample_mask, feature_mask)`` closure.
+
+    bins: uint8/int32 [N, F]; g/h: f32 [N]; sample_mask: f32 [N] (bagging);
+    feature_mask: bool [F] (feature_fraction). ``B`` is the static padded
+    bin width. Returns (TreeArrays, leaf_id).
+    """
+    L = cfg.num_leaves
+
+    def _child_best(hist_leaf, sg, sh, sc, depth, min_c, max_c, feature_mask):
+        bs = best_split(hist_leaf, sg, sh, sc, meta, cfg, min_c, max_c,
+                        feature_mask=feature_mask)
+        depth_ok = (cfg.max_depth <= 0) | (depth < cfg.max_depth)
+        gain = jnp.where(depth_ok, bs.gain, NEG_INF)
+        return bs._replace(gain=gain)
+
+    def _split_body(k, st: _GrowState, bins, g, h, sample_mask, feature_mask):
+        leaf = jnp.argmax(st.best_gain).astype(jnp.int32)
+        new = (k + 1).astype(jnp.int32)
+        f = st.best_feat[leaf]
+        t = st.best_thr[leaf]
+        dl = st.best_dl[leaf]
+
+        # ---- child stats ------------------------------------------------
+        lg, lh, lc = st.best_lg[leaf], st.best_lh[leaf], st.best_lc[leaf]
+        pg, ph, pc = st.leaf_g[leaf], st.leaf_h[leaf], st.leaf_c[leaf]
+        rg, rh, rc = pg - lg, ph - lh, pc - lc
+        min_c, max_c = st.leaf_min_c[leaf], st.leaf_max_c[leaf]
+        out_l = jnp.clip(leaf_output(lg, lh, cfg), min_c, max_c)
+        out_r = jnp.clip(leaf_output(rg, rh, cfg), min_c, max_c)
+
+        # ---- monotone constraint propagation ----------------------------
+        mono = meta.monotone[f]
+        mid = (out_l + out_r) / 2.0
+        l_min = jnp.where(mono < 0, mid, min_c)
+        l_max = jnp.where(mono > 0, mid, max_c)
+        r_min = jnp.where(mono > 0, mid, min_c)
+        r_max = jnp.where(mono < 0, mid, max_c)
+
+        # ---- record the split in the tree -------------------------------
+        tr = st.tree
+        parent_node = st.leaf_parent[leaf]
+        has_parent = parent_node >= 0
+        pn = jnp.maximum(parent_node, 0)
+        new_lc_ptr = jnp.where(has_parent & ~st.leaf_is_right[leaf],
+                               k, tr.left_child[pn])
+        new_rc_ptr = jnp.where(has_parent & st.leaf_is_right[leaf],
+                               k, tr.right_child[pn])
+        tr = tr._replace(
+            split_feature=tr.split_feature.at[k].set(f),
+            threshold_bin=tr.threshold_bin.at[k].set(t),
+            default_left=tr.default_left.at[k].set(dl),
+            split_gain=tr.split_gain.at[k].set(st.best_gain[leaf]),
+            internal_value=tr.internal_value.at[k].set(st.leaf_out[leaf]),
+            internal_count=tr.internal_count.at[k].set(pc.astype(jnp.int32)),
+            internal_weight=tr.internal_weight.at[k].set(ph),
+            left_child=tr.left_child.at[pn].set(new_lc_ptr).at[k].set(~leaf),
+            right_child=tr.right_child.at[pn].set(new_rc_ptr).at[k].set(~new),
+            num_leaves=tr.num_leaves + 1,
+        )
+
+        # ---- partition rows ---------------------------------------------
+        col = jnp.take(bins, f, axis=1).astype(jnp.int32)
+        go_left = go_left_bins(col, t, dl, meta.missing_types[f],
+                               meta.num_bins[f], meta.default_bins[f])
+        in_leaf = st.leaf_id == leaf
+        leaf_id = jnp.where(in_leaf & ~go_left, new, st.leaf_id)
+
+        # ---- histograms: pass for the smaller child, subtract sibling ---
+        parent_hist = st.hist[leaf]
+        left_smaller = lc < rc
+        small = jnp.where(left_smaller, leaf, new)
+        large = jnp.where(left_smaller, new, leaf)
+        small_mask = (leaf_id == small).astype(jnp.float32) * sample_mask
+        hist_small = hist_fn(bins, g, h, small_mask, B=B)
+        hist = st.hist.at[small].set(hist_small)
+        hist = hist.at[large].set(parent_hist - hist_small)
+
+        # ---- best splits for the two children ---------------------------
+        d = st.leaf_depth[leaf] + 1
+        bs_l = _child_best(hist[leaf], lg, lh, lc, d, l_min, l_max, feature_mask)
+        bs_r = _child_best(hist[new], rg, rh, rc, d, r_min, r_max, feature_mask)
+
+        def upd(a, i, v):
+            return a.at[i].set(v)
+
+        return st._replace(
+            leaf_id=leaf_id,
+            hist=hist,
+            leaf_g=upd(upd(st.leaf_g, leaf, lg), new, rg),
+            leaf_h=upd(upd(st.leaf_h, leaf, lh), new, rh),
+            leaf_c=upd(upd(st.leaf_c, leaf, lc), new, rc),
+            leaf_depth=upd(upd(st.leaf_depth, leaf, d), new, d),
+            leaf_min_c=upd(upd(st.leaf_min_c, leaf, l_min), new, r_min),
+            leaf_max_c=upd(upd(st.leaf_max_c, leaf, l_max), new, r_max),
+            leaf_out=upd(upd(st.leaf_out, leaf, out_l), new, out_r),
+            best_gain=upd(upd(st.best_gain, leaf, bs_l.gain), new, bs_r.gain),
+            best_feat=upd(upd(st.best_feat, leaf, bs_l.feature), new, bs_r.feature),
+            best_thr=upd(upd(st.best_thr, leaf, bs_l.threshold), new, bs_r.threshold),
+            best_dl=upd(upd(st.best_dl, leaf, bs_l.default_left), new, bs_r.default_left),
+            best_lg=upd(upd(st.best_lg, leaf, bs_l.left_g), new, bs_r.left_g),
+            best_lh=upd(upd(st.best_lh, leaf, bs_l.left_h), new, bs_r.left_h),
+            best_lc=upd(upd(st.best_lc, leaf, bs_l.left_c), new, bs_r.left_c),
+            leaf_parent=upd(upd(st.leaf_parent, leaf, k), new, k),
+            leaf_is_right=upd(upd(st.leaf_is_right, leaf, False), new, True),
+            tree=tr,
+        )
+
+    @jax.jit
+    def grow(bins, g, h, sample_mask, feature_mask):
+        N, F = bins.shape
+        sum_g = jnp.sum(g * sample_mask)
+        sum_h = jnp.sum(h * sample_mask)
+        cnt = jnp.sum(sample_mask)
+
+        hist0 = hist_fn(bins, g, h, sample_mask, B=B)
+        inf = jnp.float32(jnp.inf)
+        root_out = leaf_output(sum_g, sum_h, cfg)
+        bs0 = _child_best(hist0, sum_g, sum_h, cnt, jnp.int32(0),
+                          -inf, inf, feature_mask)
+
+        Lf = jnp.zeros((L,), jnp.float32)
+        Li = jnp.zeros((L,), jnp.int32)
+        st = _GrowState(
+            leaf_id=jnp.zeros((N,), jnp.int32),
+            hist=jnp.zeros((L,) + hist0.shape, jnp.float32).at[0].set(hist0),
+            leaf_g=Lf.at[0].set(sum_g),
+            leaf_h=Lf.at[0].set(sum_h),
+            leaf_c=Lf.at[0].set(cnt),
+            leaf_depth=Li,
+            leaf_min_c=jnp.full((L,), -jnp.inf, jnp.float32),
+            leaf_max_c=jnp.full((L,), jnp.inf, jnp.float32),
+            leaf_out=Lf.at[0].set(root_out),
+            best_gain=jnp.full((L,), NEG_INF, jnp.float32).at[0].set(bs0.gain),
+            best_feat=Li.at[0].set(bs0.feature),
+            best_thr=Li.at[0].set(bs0.threshold),
+            best_dl=jnp.zeros((L,), bool).at[0].set(bs0.default_left),
+            best_lg=Lf.at[0].set(bs0.left_g),
+            best_lh=Lf.at[0].set(bs0.left_h),
+            best_lc=Lf.at[0].set(bs0.left_c),
+            leaf_parent=jnp.full((L,), -1, jnp.int32),
+            leaf_is_right=jnp.zeros((L,), bool),
+            tree=_empty_tree(L),
+        )
+
+        def body(k, st):
+            do = jnp.max(st.best_gain) > 0.0
+            return jax.lax.cond(
+                do,
+                lambda s: _split_body(k, s, bins, g, h, sample_mask, feature_mask),
+                lambda s: s,
+                st)
+
+        st = jax.lax.fori_loop(0, L - 1, body, st)
+
+        tr = st.tree._replace(
+            leaf_value=st.leaf_out,
+            leaf_count=st.leaf_c.astype(jnp.int32),
+            leaf_weight=st.leaf_h,
+        )
+        return tr, st.leaf_id
+
+    return grow

@@ -1,0 +1,87 @@
+"""Histogram construction — the hottest loop of the framework.
+
+The reference accumulates per-feature (sum_grad, sum_hess, count) bins with a
+scalar gather-accumulate loop on CPU (reference: src/io/dense_bin.hpp:71-135)
+and a workgroup-atomic OpenCL kernel on GPU (reference:
+src/treelearner/ocl/histogram256.cl:350).  TPUs have no fast arbitrary
+scatter, so the TPU-native formulation turns bin accumulation into one-hot
+matmuls that run on the MXU:
+
+    hist[f, b, :] = sum_r onehot(X_bin[r, f])[b] * (g, h, 1)[r]
+
+i.e. a single ``[F*B, C] @ [C, 3]`` contraction per row-chunk, scanned over
+chunks so the one-hot tile never exceeds a few tens of MB.  A Pallas kernel
+(ops/pallas_hist.py) implements the same contraction with the one-hot tile
+built directly in VMEM.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+# target one-hot tile size in elements (f32): ~32MB
+_TILE_ELEMS = 8 * 1024 * 1024
+
+
+def _chunk_rows(F: int, B: int) -> int:
+    c = max(128, _TILE_ELEMS // (F * B))
+    # round down to a multiple of 128 (sublane-friendly)
+    return max(128, (c // 128) * 128)
+
+
+@functools.partial(jax.jit, static_argnames=("B",))
+def hist_onehot(bins, g, h, mask, B: int):
+    """Dense histogram via chunked one-hot contraction.
+
+    Parameters
+    ----------
+    bins : uint8/int32 [C, F] per-row bin indices (feature-local, unpadded)
+    g, h : float32 [C] gradients / hessians
+    mask : float32 [C] 1.0 for rows to accumulate (bagging x leaf membership)
+    B : static padded bin width
+
+    Returns
+    -------
+    float32 [F, B, 3] — (sum_grad, sum_hess, count) per feature x bin.
+    """
+    C, F = bins.shape
+    ghc = jnp.stack([g, h, jnp.ones_like(g)], axis=-1) * mask[:, None]  # [C,3]
+    chunk = _chunk_rows(F, B)
+    if C <= chunk:
+        return _hist_block(bins, ghc, B)
+    n_chunks = -(-C // chunk)
+    pad = n_chunks * chunk - C
+    if pad:
+        bins = jnp.pad(bins, ((0, pad), (0, 0)))
+        ghc = jnp.pad(ghc, ((0, pad), (0, 0)))
+    bins_c = bins.reshape(n_chunks, chunk, F)
+    ghc_c = ghc.reshape(n_chunks, chunk, 3)
+
+    def body(acc, xs):
+        b, z = xs
+        return acc + _hist_block(b, z, B), None
+
+    init = jnp.zeros((F, B, 3), dtype=jnp.float32)
+    out, _ = jax.lax.scan(body, init, (bins_c, ghc_c))
+    return out
+
+
+def _hist_block(bins, ghc, B: int):
+    """One-hot contraction for a single row block: [c,F],[c,3] -> [F,B,3]."""
+    c, F = bins.shape
+    oh = jax.nn.one_hot(bins.astype(jnp.int32), B, dtype=jnp.float32)  # [c,F,B]
+    # contract over rows: [c, F*B]^T @ [c, 3]
+    flat = oh.reshape(c, F * B)
+    out = jax.lax.dot_general(
+        flat, ghc, (((0,), (0,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32)
+    return out.reshape(F, B, 3)
+
+
+def hist_subtract(parent, child):
+    """Sibling histogram by subtraction (reference:
+    src/treelearner/feature_histogram.hpp:75-81, serial_tree_learner.cpp:567)."""
+    return parent - child

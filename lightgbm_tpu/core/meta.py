@@ -1,0 +1,126 @@
+"""Static device-side feature metadata and split hyperparameters.
+
+The reference carries per-feature metadata as ``FeatureMetainfo`` structs
+(reference: src/treelearner/feature_histogram.hpp:20-35) and threads the full
+``Config`` through the gain math. On TPU everything the jitted grower needs is
+packed once into small device arrays (``DeviceMeta``) plus a hashable frozen
+dataclass of scalar hyperparameters (``SplitConfig``) that is closed over at
+trace time.
+
+Histogram layout: per-leaf histograms are padded dense ``[F, B, 3]`` arrays
+(features x padded-bin x (grad, hess, count)).  Unlike the reference we store
+*every* bin — no most-frequent-bin elision and therefore no ``FixHistogram``
+reconstruction (reference: src/io/dataset.cpp:1044-1063); HBM is cheap and
+dense fixed shapes are what XLA wants.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import NamedTuple
+
+import numpy as np
+
+from ..io.binning import BIN_CATEGORICAL, MISSING_NAN, MISSING_NONE, MISSING_ZERO
+
+
+class DeviceMeta(NamedTuple):
+    """Per-feature metadata as device arrays (all shaped [F] unless noted)."""
+    num_bins: "jax.Array"       # int32 — actual bin count per feature
+    default_bins: "jax.Array"   # int32 — bin of value 0.0
+    missing_types: "jax.Array"  # int32 — MISSING_{NONE,ZERO,NAN}
+    monotone: "jax.Array"       # int32 — -1/0/+1 monotone constraint
+    penalties: "jax.Array"      # float32 — per-feature gain penalty (feature_contri)
+    is_categorical: "jax.Array"  # bool
+
+
+@dataclass(frozen=True)
+class SplitConfig:
+    """Scalar split hyperparameters (static at trace time).
+
+    Mirrors the subset of ``Config`` read by the reference gain math
+    (reference: src/treelearner/feature_histogram.hpp:446-506).
+    """
+    lambda_l1: float = 0.0
+    lambda_l2: float = 0.0
+    min_data_in_leaf: int = 20
+    min_sum_hessian_in_leaf: float = 1e-3
+    min_gain_to_split: float = 0.0
+    max_delta_step: float = 0.0
+    num_leaves: int = 31
+    max_depth: int = -1
+    # categorical split parameters (reference: config.h:378-430)
+    max_cat_threshold: int = 32
+    cat_l2: float = 10.0
+    cat_smooth: float = 10.0
+    max_cat_to_onehot: int = 4
+    min_data_per_group: int = 100
+
+    @classmethod
+    def from_config(cls, config) -> "SplitConfig":
+        return cls(
+            lambda_l1=float(config.lambda_l1),
+            lambda_l2=float(config.lambda_l2),
+            min_data_in_leaf=int(config.min_data_in_leaf),
+            min_sum_hessian_in_leaf=float(config.min_sum_hessian_in_leaf),
+            min_gain_to_split=float(config.min_gain_to_split),
+            max_delta_step=float(config.max_delta_step),
+            num_leaves=int(config.num_leaves),
+            max_depth=int(config.max_depth),
+            max_cat_threshold=int(config.max_cat_threshold),
+            cat_l2=float(config.cat_l2),
+            cat_smooth=float(config.cat_smooth),
+            max_cat_to_onehot=int(config.max_cat_to_onehot),
+            min_data_per_group=int(config.min_data_per_group),
+        )
+
+
+def _padded_bin_width(max_num_bin: int) -> int:
+    """Pad the per-feature bin axis to the next power of two (min 8)."""
+    b = 8
+    while b < max_num_bin:
+        b *= 2
+    return b
+
+
+def build_device_meta(dataset, config=None):
+    """Build (DeviceMeta, B) from a constructed ``BinnedDataset``.
+
+    ``B`` is the static padded bin width shared by all features.
+    """
+    import jax.numpy as jnp
+
+    nbins = dataset.feature_max_bins().astype(np.int32)
+    F = len(nbins)
+    default_bins = np.zeros(F, dtype=np.int32)
+    missing = np.zeros(F, dtype=np.int32)
+    is_cat = np.zeros(F, dtype=bool)
+    for inner in range(F):
+        m = dataset.inner_to_mapper(inner)
+        default_bins[inner] = m.default_bin
+        missing[inner] = m.missing_type
+        is_cat[inner] = m.bin_type == BIN_CATEGORICAL
+    if is_cat.any():
+        from ..utils import log
+        log.warning("Categorical split search is not implemented yet; "
+                    "declared categorical features will not be split on")
+    monotone = np.zeros(F, dtype=np.int32)
+    penalties = np.ones(F, dtype=np.float32)
+    if config is not None:
+        mc = getattr(config, "monotone_constraints", None) or []
+        fc = getattr(config, "feature_contri", None) or []
+        for inner in range(F):
+            orig = int(dataset.real_feature_idx[inner])
+            if orig < len(mc):
+                monotone[inner] = int(mc[orig])
+            if orig < len(fc):
+                penalties[inner] = float(fc[orig])
+    B = _padded_bin_width(int(nbins.max(initial=1)))
+    meta = DeviceMeta(
+        num_bins=jnp.asarray(nbins),
+        default_bins=jnp.asarray(default_bins),
+        missing_types=jnp.asarray(missing),
+        monotone=jnp.asarray(monotone),
+        penalties=jnp.asarray(penalties),
+        is_categorical=jnp.asarray(is_cat),
+    )
+    return meta, B

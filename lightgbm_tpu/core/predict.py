@@ -1,0 +1,46 @@
+"""Device-side tree traversal over binned data.
+
+Replaces the reference's training-time ``Tree::AddPredictionToScore`` inner
+traversal (reference: include/LightGBM/tree.h:101-114, src/io/tree.cpp) with
+a vectorized gather loop: every row walks the tree simultaneously, one level
+per ``while_loop`` step, until all rows rest in leaves.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .grower import TreeArrays, go_left_bins
+from .meta import DeviceMeta
+
+
+def predict_leaf_bins(tree: TreeArrays, bins, meta: DeviceMeta):
+    """Leaf index per row for binned inputs. bins: [N, F] uint8/int32."""
+    N = bins.shape[0]
+    start = jnp.where(tree.num_leaves > 1, 0, ~0)
+    node = jnp.full((N,), start, jnp.int32)
+
+    def cond(node):
+        return jnp.any(node >= 0)
+
+    def step(node):
+        active = node >= 0
+        nd = jnp.maximum(node, 0)
+        f = tree.split_feature[nd]
+        col = jnp.take_along_axis(bins, f[:, None].astype(jnp.int32),
+                                  axis=1)[:, 0].astype(jnp.int32)
+        gl = go_left_bins(col, tree.threshold_bin[nd], tree.default_left[nd],
+                          meta.missing_types[f], meta.num_bins[f],
+                          meta.default_bins[f])
+        nxt = jnp.where(gl, tree.left_child[nd], tree.right_child[nd])
+        return jnp.where(active, nxt, node)
+
+    node = jax.lax.while_loop(cond, step, node)
+    return ~node
+
+
+def add_score_bins(score, tree: TreeArrays, bins, meta: DeviceMeta, shrinkage):
+    """score += shrinkage * leaf_value[leaf(row)] (reference:
+    src/boosting/score_updater.hpp:84-108)."""
+    leaf = predict_leaf_bins(tree, bins, meta)
+    return score + shrinkage * tree.leaf_value[leaf]

@@ -1,0 +1,222 @@
+"""Host-side tree model: raw-value prediction and serialization state.
+
+The reference ``Tree`` (reference: include/LightGBM/tree.h:25-530,
+src/io/tree.cpp) keeps SoA node arrays in both bin space (training) and value
+space (inference). Here the device grower emits bin-space arrays
+(``core.grower.TreeArrays``); this class converts them once to value space
+using the dataset's bin mappers and serves numpy prediction, feature
+importance and model-text serialization.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..io.binning import BIN_CATEGORICAL, MISSING_NAN, MISSING_NONE, MISSING_ZERO
+
+K_ZERO_THRESHOLD = 1e-35
+
+_MISSING_TYPE_STR = {MISSING_NONE: "None", MISSING_ZERO: "Zero", MISSING_NAN: "NaN"}
+
+# decision_type bit layout (reference: tree.h:19-20, 193-212)
+_CAT_MASK = 1
+_DEFAULT_LEFT_MASK = 2
+
+
+class Tree:
+    """One trained tree in value space.
+
+    All arrays are numpy; ``num_leaves`` is the realized leaf count (unused
+    fixed-capacity slots from the device arrays are trimmed).
+    """
+
+    def __init__(self, num_leaves: int,
+                 split_feature: np.ndarray,      # original (outer) feature idx
+                 threshold: np.ndarray,          # real-valued threshold
+                 threshold_bin: np.ndarray,
+                 decision_type: np.ndarray,      # packed missing/default-left/cat
+                 left_child: np.ndarray, right_child: np.ndarray,
+                 leaf_value: np.ndarray, leaf_count: np.ndarray,
+                 leaf_weight: np.ndarray,
+                 split_gain: np.ndarray, internal_value: np.ndarray,
+                 internal_count: np.ndarray, internal_weight: np.ndarray,
+                 cat_boundaries: Optional[np.ndarray] = None,
+                 cat_threshold: Optional[np.ndarray] = None,
+                 shrinkage: float = 1.0):
+        self.num_leaves = int(num_leaves)
+        self.split_feature = split_feature
+        self.threshold = threshold
+        self.threshold_bin = threshold_bin
+        self.decision_type = decision_type
+        self.left_child = left_child
+        self.right_child = right_child
+        self.leaf_value = leaf_value
+        self.leaf_count = leaf_count
+        self.leaf_weight = leaf_weight
+        self.split_gain = split_gain
+        self.internal_value = internal_value
+        self.internal_count = internal_count
+        self.internal_weight = internal_weight
+        # categorical thresholds: bitsets concatenated, indexed by cat_idx
+        # (reference: tree.h:83-99 cat_boundaries_/cat_threshold_)
+        self.cat_boundaries = (cat_boundaries if cat_boundaries is not None
+                               else np.zeros(1, dtype=np.int32))
+        self.cat_threshold = (cat_threshold if cat_threshold is not None
+                              else np.zeros(0, dtype=np.uint32))
+        self.shrinkage = float(shrinkage)
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_device(cls, arrays, dataset, shrinkage: float = 1.0) -> "Tree":
+        """Convert device ``TreeArrays`` (bin space) to a value-space tree.
+
+        ``dataset`` supplies bin mappers for real thresholds
+        (reference: Dataset::RealThreshold).
+        """
+        import numpy as _np
+        nl = int(arrays.num_leaves)
+        nn = max(nl - 1, 0)
+        split_feature_inner = _np.asarray(arrays.split_feature)[:nn]
+        threshold_bin = _np.asarray(arrays.threshold_bin)[:nn]
+        default_left = _np.asarray(arrays.default_left)[:nn]
+
+        threshold = _np.zeros(nn, dtype=_np.float64)
+        decision_type = _np.zeros(nn, dtype=_np.int32)
+        split_feature = _np.zeros(nn, dtype=_np.int32)
+        cat_boundaries = [0]
+        cat_threshold: List[int] = []
+        for i in range(nn):
+            inner = int(split_feature_inner[i])
+            mapper = dataset.inner_to_mapper(inner)
+            split_feature[i] = int(dataset.real_feature_idx[inner])
+            dt = _MISSING_SHIFT[mapper.missing_type]
+            if mapper.bin_type == BIN_CATEGORICAL:
+                dt |= _CAT_MASK
+                # bin-space bitset was packed by the grower into threshold_bin
+                # as an index into the tree's categorical storage; the grower
+                # appends the bitset via `cat_bitsets` attribute.
+                threshold[i] = threshold_bin[i]  # cat index
+            else:
+                if default_left[i]:
+                    dt |= _DEFAULT_LEFT_MASK
+                threshold[i] = mapper.bin_to_value(int(threshold_bin[i]))
+            decision_type[i] = dt
+
+        return cls(
+            num_leaves=nl,
+            split_feature=split_feature,
+            threshold=threshold,
+            threshold_bin=threshold_bin.astype(_np.int32),
+            decision_type=decision_type,
+            left_child=_np.asarray(arrays.left_child)[:nn].astype(_np.int32),
+            right_child=_np.asarray(arrays.right_child)[:nn].astype(_np.int32),
+            leaf_value=_np.asarray(arrays.leaf_value)[:nl].astype(_np.float64),
+            leaf_count=_np.asarray(arrays.leaf_count)[:nl].astype(_np.int32),
+            leaf_weight=_np.asarray(arrays.leaf_weight)[:nl].astype(_np.float64),
+            split_gain=_np.asarray(arrays.split_gain)[:nn].astype(_np.float64),
+            internal_value=_np.asarray(arrays.internal_value)[:nn].astype(_np.float64),
+            internal_count=_np.asarray(arrays.internal_count)[:nn].astype(_np.int32),
+            internal_weight=_np.asarray(arrays.internal_weight)[:nn].astype(_np.float64),
+            cat_boundaries=np.asarray(cat_boundaries, dtype=np.int32),
+            cat_threshold=np.asarray(cat_threshold, dtype=np.uint32),
+            shrinkage=shrinkage,
+        )
+
+    # ------------------------------------------------------------------
+    def missing_type(self, node: int) -> int:
+        return (int(self.decision_type[node]) >> 2) & 3
+
+    def is_categorical(self, node: int) -> bool:
+        return bool(self.decision_type[node] & _CAT_MASK)
+
+    def default_left(self, node: int) -> bool:
+        return bool(self.decision_type[node] & _DEFAULT_LEFT_MASK)
+
+    def apply_shrinkage(self, rate: float) -> None:
+        """(reference: Tree::Shrinkage, tree.h:149-160)."""
+        self.leaf_value = self.leaf_value * rate
+        self.internal_value = self.internal_value * rate
+        self.shrinkage *= rate
+
+    # ------------------------------------------------------------------
+    def predict_leaf(self, X: np.ndarray) -> np.ndarray:
+        """Leaf index per row for raw feature values (vectorized traversal,
+        reference: Tree::GetLeaf + NumericalDecision, tree.h:447-530)."""
+        n = X.shape[0]
+        if self.num_leaves <= 1:
+            return np.zeros(n, dtype=np.int64)
+        node = np.zeros(n, dtype=np.int64)
+        active = np.ones(n, dtype=bool)
+        while active.any():
+            nd = node[active]
+            fv = X[active, self.split_feature[nd]].astype(np.float64)
+            go_left = self._decide(fv, nd)
+            nxt = np.where(go_left, self.left_child[nd], self.right_child[nd])
+            node[active] = nxt
+            active = node >= 0
+        return ~node
+
+    def _decide(self, fval: np.ndarray, nodes: np.ndarray) -> np.ndarray:
+        """Vectorized NumericalDecision / CategoricalDecision
+        (reference: tree.h:221-303)."""
+        dt = self.decision_type[nodes]
+        missing = (dt >> 2) & 3
+        is_cat = (dt & _CAT_MASK).astype(bool)
+        default_left = (dt & _DEFAULT_LEFT_MASK).astype(bool)
+        thr = self.threshold[nodes]
+
+        nan_mask = np.isnan(fval)
+        fv = np.where(nan_mask & (missing != MISSING_NAN), 0.0, fval)
+        is_zero = np.abs(fv) <= K_ZERO_THRESHOLD
+        is_missing = (((missing == MISSING_ZERO) & is_zero)
+                      | ((missing == MISSING_NAN) & np.isnan(fv)))
+        numerical = np.where(is_missing, default_left, fv <= thr)
+
+        if is_cat.any():
+            cat_left = self._cat_decide(fv, nodes)
+            return np.where(is_cat, cat_left, numerical)
+        return numerical
+
+    def _cat_decide(self, fval: np.ndarray, nodes: np.ndarray) -> np.ndarray:
+        """FindInBitset over the node's category set
+        (reference: tree.h:265-303, utils/common.h ConstructBitset)."""
+        out = np.zeros(len(fval), dtype=bool)
+        iv = np.where(np.isnan(fval) | (fval < 0), -1, fval).astype(np.int64)
+        for j in range(len(fval)):
+            node = int(nodes[j])
+            if not self.is_categorical(node):
+                continue
+            cat_idx = int(self.threshold[node])
+            lo = int(self.cat_boundaries[cat_idx])
+            hi = int(self.cat_boundaries[cat_idx + 1])
+            v = int(iv[j])
+            word, bit = v // 32, v % 32
+            if v >= 0 and word < hi - lo:
+                out[j] = bool((int(self.cat_threshold[lo + word]) >> bit) & 1)
+        return out
+
+    def predict(self, X: np.ndarray) -> np.ndarray:
+        """Raw (margin) predictions for a dense float matrix."""
+        return self.leaf_value[self.predict_leaf(X)]
+
+    @property
+    def max_depth(self) -> int:
+        if self.num_leaves <= 1:
+            return 0
+        depth = np.zeros(self.num_leaves - 1, dtype=np.int64)
+        # nodes are created in split order, so parents precede children
+        md = 1
+        for i in range(self.num_leaves - 1):
+            for child in (self.left_child[i], self.right_child[i]):
+                if child >= 0:
+                    depth[child] = depth[i] + 1
+                    md = max(md, int(depth[child]) + 1)
+        return md
+
+
+_MISSING_SHIFT = {
+    MISSING_NONE: MISSING_NONE << 2,
+    MISSING_ZERO: MISSING_ZERO << 2,
+    MISSING_NAN: MISSING_NAN << 2,
+}

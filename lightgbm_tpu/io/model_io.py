@@ -1,0 +1,299 @@
+"""Model text serialization in the LightGBM v3 format.
+
+Save mirrors ``GBDT::SaveModelToString`` (reference:
+src/boosting/gbdt_model_text.cpp:271-368); load mirrors
+``GBDT::LoadModelFromString`` (:380-480) plus ``Tree``'s parsing ctor
+(src/io/tree.cpp:398-607).  Files written here load in the reference CLI and
+vice versa, which is the cross-framework parity check.
+"""
+from __future__ import annotations
+
+import io as _io
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..core.tree import Tree
+from ..utils import log
+
+
+def _fmt(x: float) -> str:
+    """Shortest round-trip float formatting (C++ uses %.17g-equivalent)."""
+    return np.format_float_positional(
+        float(x), unique=True, trim="0") if np.isfinite(x) else repr(float(x))
+
+
+def _fmt_list(arr) -> str:
+    return " ".join(_fmt(v) for v in arr)
+
+
+def _int_list(arr) -> str:
+    return " ".join(str(int(v)) for v in arr)
+
+
+def _objective_string(objective) -> str:
+    if objective is None:
+        return "custom"
+    name = objective.name
+    if name == "binary":
+        return f"binary sigmoid:{objective.sigmoid:g}"
+    if name in ("multiclass", "multiclassova"):
+        extra = f" num_class:{objective.num_class}"
+        if name == "multiclassova":
+            extra += f" sigmoid:{objective.sigmoid:g}"
+        return name + extra
+    if name == "lambdarank":
+        return "lambdarank"
+    return name
+
+
+def tree_to_string(tree: Tree, index: int) -> str:
+    """One ``Tree=i`` block (reference: Tree::ToString, src/io/tree.cpp:341)."""
+    nn = max(tree.num_leaves - 1, 0)
+    buf = _io.StringIO()
+    buf.write(f"Tree={index}\n")
+    buf.write(f"num_leaves={tree.num_leaves}\n")
+    num_cat = int(len(tree.cat_boundaries) - 1)
+    buf.write(f"num_cat={num_cat}\n")
+    buf.write(f"split_feature={_int_list(tree.split_feature[:nn])}\n")
+    buf.write(f"split_gain={_fmt_list(tree.split_gain[:nn])}\n")
+    buf.write(f"threshold={_fmt_list(tree.threshold[:nn])}\n")
+    buf.write(f"decision_type={_int_list(tree.decision_type[:nn])}\n")
+    buf.write(f"left_child={_int_list(tree.left_child[:nn])}\n")
+    buf.write(f"right_child={_int_list(tree.right_child[:nn])}\n")
+    buf.write(f"leaf_value={_fmt_list(tree.leaf_value[:tree.num_leaves])}\n")
+    buf.write(f"leaf_weight={_fmt_list(tree.leaf_weight[:tree.num_leaves])}\n")
+    buf.write(f"leaf_count={_int_list(tree.leaf_count[:tree.num_leaves])}\n")
+    buf.write(f"internal_value={_fmt_list(tree.internal_value[:nn])}\n")
+    buf.write(f"internal_weight={_fmt_list(tree.internal_weight[:nn])}\n")
+    buf.write(f"internal_count={_int_list(tree.internal_count[:nn])}\n")
+    if num_cat > 0:
+        buf.write(f"cat_boundaries={_int_list(tree.cat_boundaries)}\n")
+        buf.write(f"cat_threshold={_int_list(tree.cat_threshold)}\n")
+    buf.write(f"shrinkage={_fmt(tree.shrinkage)}\n")
+    buf.write("\n\n")
+    return buf.getvalue()
+
+
+def model_to_string(gbdt, num_iteration: int = -1,
+                    start_iteration: int = 0) -> str:
+    """(reference: GBDT::SaveModelToString, gbdt_model_text.cpp:271-368)."""
+    from ..boosting.gbdt import GBDT
+    K = gbdt.num_tpi
+    start, stop = GBDT._iter_window(gbdt, num_iteration, start_iteration)
+    trees = gbdt.models[start * K:stop * K]
+
+    ds = gbdt.train_ds
+    feature_names = (list(ds.feature_names) if ds is not None
+                     else list(getattr(gbdt, "feature_names", [])))
+    max_feature_idx = (len(feature_names) - 1 if feature_names else 0)
+    if ds is not None:
+        infos = []
+        for j in range(ds.num_total_features):
+            m = ds.bin_mappers[j]
+            if m.is_trivial:
+                infos.append("none")
+            elif m.bin_type == 1:  # categorical
+                infos.append(":".join(str(c) for c in sorted(
+                    c for c in m.bin_2_categorical if c >= 0)))
+            else:
+                infos.append(f"[{_fmt(m.min_val)}:{_fmt(m.max_val)}]")
+    else:
+        infos = list(getattr(gbdt, "feature_infos", ["none"] * (max_feature_idx + 1)))
+
+    buf = _io.StringIO()
+    buf.write("tree\n")
+    buf.write("version=v3\n")
+    buf.write(f"num_class={K if gbdt.objective is None or gbdt.objective.num_tree_per_iteration == K else 1}\n")
+    buf.write(f"num_tree_per_iteration={K}\n")
+    buf.write("label_index=0\n")
+    buf.write(f"max_feature_idx={max_feature_idx}\n")
+    buf.write(f"objective={_objective_string(gbdt.objective)}\n")
+    if getattr(gbdt, "average_output", False):
+        buf.write("average_output\n")
+    buf.write(f"feature_names={' '.join(feature_names)}\n")
+    buf.write(f"feature_infos={' '.join(infos)}\n")
+
+    tree_strs = [tree_to_string(t, i) for i, t in enumerate(trees)]
+    buf.write(f"tree_sizes={' '.join(str(len(s)) for s in tree_strs)}\n\n")
+    for s in tree_strs:
+        buf.write(s)
+    buf.write("end of trees\n")
+
+    # feature importances, descending (gbdt_model_text.cpp:330-358)
+    if ds is not None and feature_names:
+        imp = gbdt.feature_importance("split")
+        order = np.argsort(-imp, kind="stable")
+        buf.write("\nfeature_importances:\n")
+        for j in order:
+            if imp[j] > 0:
+                buf.write(f"{feature_names[int(j)]}={int(imp[int(j)])}\n")
+    buf.write("\nparameters:\n")
+    if getattr(gbdt, "config", None) is not None:
+        for k, v in gbdt.config.to_params().items():
+            if isinstance(v, (list, tuple)):
+                v = ",".join(str(x) for x in v)
+            buf.write(f"[{k}: {v}]\n")
+    buf.write("\nend of parameters\n")
+    return buf.getvalue()
+
+
+# ----------------------------------------------------------------------
+def _parse_tree_block(lines: Dict[str, str]) -> Tree:
+    nl = int(lines["num_leaves"])
+    nn = max(nl - 1, 0)
+
+    def farr(key, n, default=0.0):
+        if key not in lines or not lines[key].strip():
+            return np.full(n, default, dtype=np.float64)
+        return np.asarray([float(x) for x in lines[key].split()], dtype=np.float64)
+
+    def iarr(key, n, default=0):
+        if key not in lines or not lines[key].strip():
+            return np.full(n, default, dtype=np.int32)
+        return np.asarray([int(float(x)) for x in lines[key].split()], dtype=np.int32)
+
+    num_cat = int(lines.get("num_cat", "0"))
+    cat_boundaries = iarr("cat_boundaries", 1) if num_cat > 0 else np.zeros(1, np.int32)
+    cat_threshold = (np.asarray([int(x) for x in lines["cat_threshold"].split()],
+                                dtype=np.uint32)
+                     if num_cat > 0 else np.zeros(0, np.uint32))
+    return Tree(
+        num_leaves=nl,
+        split_feature=iarr("split_feature", nn),
+        threshold=farr("threshold", nn),
+        threshold_bin=np.zeros(nn, np.int32),
+        decision_type=iarr("decision_type", nn),
+        left_child=iarr("left_child", nn),
+        right_child=iarr("right_child", nn),
+        leaf_value=farr("leaf_value", nl),
+        leaf_count=iarr("leaf_count", nl),
+        leaf_weight=farr("leaf_weight", nl),
+        split_gain=farr("split_gain", nn),
+        internal_value=farr("internal_value", nn),
+        internal_count=iarr("internal_count", nn),
+        internal_weight=farr("internal_weight", nn),
+        cat_boundaries=cat_boundaries,
+        cat_threshold=cat_threshold,
+        shrinkage=float(lines.get("shrinkage", "1")),
+    )
+
+
+class LoadedGBDT:
+    """Prediction-only booster built from a model file (the reference
+    reconstructs a full GBDT; prediction needs only the trees + objective)."""
+
+    def __init__(self, models: List[Tree], num_tpi: int, objective,
+                 feature_names: List[str], feature_infos: List[str],
+                 average_output: bool):
+        self.models = models
+        self.num_tpi = num_tpi
+        self.objective = objective
+        self.feature_names = feature_names
+        self.feature_infos = feature_infos
+        self.average_output = average_output
+        self.train_ds = None
+        self.config = None
+        self.metrics = []
+        self.best_iteration = -1
+
+    def current_iteration(self) -> int:
+        return len(self.models) // self.num_tpi
+
+    @property
+    def num_trees(self) -> int:
+        return len(self.models)
+
+    def predict_raw(self, X, num_iteration=None, start_iteration: int = 0):
+        from ..boosting.gbdt import GBDT
+        raw = GBDT.predict_raw(self, X, num_iteration, start_iteration)
+        if self.average_output:
+            start, stop = GBDT._iter_window(self, num_iteration, start_iteration)
+            raw /= max(stop - start, 1)
+        return raw
+
+    predict = None  # assigned below (borrow GBDT implementations)
+    predict_leaf = None
+    feature_importance = None
+
+
+def _borrow_gbdt_methods():
+    from ..boosting.gbdt import GBDT
+    LoadedGBDT.predict = GBDT.predict
+    LoadedGBDT.predict_leaf = GBDT.predict_leaf
+    LoadedGBDT._iter_window = GBDT._iter_window
+
+    def feature_importance(self, importance_type="split"):
+        n = len(self.feature_names) or 1
+        imp = np.zeros(n)
+        for tree in self.models:
+            for i in range(max(tree.num_leaves - 1, 0)):
+                f = int(tree.split_feature[i])
+                imp[f] += 1.0 if importance_type == "split" \
+                    else max(0.0, float(tree.split_gain[i]))
+        return imp
+
+    LoadedGBDT.feature_importance = feature_importance
+
+
+_borrow_gbdt_methods()
+
+
+def load_model_string(model_str: str):
+    """Parse a LightGBM model text (ours or the reference's)."""
+    from ..config import Config
+    from ..objective import create_objective
+
+    header: Dict[str, str] = {}
+    pos = model_str.find("\nTree=")
+    head_part = model_str[:pos] if pos >= 0 else model_str
+    for line in head_part.splitlines():
+        if "=" in line:
+            k, _, v = line.partition("=")
+            header[k.strip()] = v.strip()
+
+    average_output = "average_output" in head_part.splitlines()
+
+    objective = None
+    obj_str = header.get("objective", "")
+    num_class = int(header.get("num_class", "1"))
+    if obj_str and obj_str != "custom":
+        parts = obj_str.split()
+        params = {"objective": parts[0]}
+        for tok in parts[1:]:
+            if ":" in tok:
+                k, v = tok.split(":", 1)
+                params[k] = v
+        if num_class > 1:
+            params["num_class"] = num_class
+        try:
+            objective = create_objective(Config.from_params(params))
+        except Exception:  # objective param mismatch shouldn't kill loading
+            log.warning("Could not reconstruct objective %r from model file",
+                        obj_str)
+
+    # tree blocks
+    models: List[Tree] = []
+    chunks = model_str.split("\nTree=")[1:]
+    for chunk in chunks:
+        body = chunk.split("end of trees")[0]
+        lines: Dict[str, str] = {}
+        for line in body.splitlines():
+            if "=" in line:
+                k, _, v = line.partition("=")
+                lines[k.strip()] = v.strip()
+        models.append(_parse_tree_block(lines))
+
+    num_tpi = int(header.get("num_tree_per_iteration", "1"))
+    feature_names = header.get("feature_names", "").split()
+    feature_infos = header.get("feature_infos", "").split()
+    gbdt = LoadedGBDT(models, num_tpi, objective, feature_names,
+                      feature_infos, average_output)
+    config = Config.from_params({"objective": obj_str.split()[0]}
+                                if obj_str and obj_str != "custom" else {})
+    return gbdt, config
+
+
+def load_model_file(path: str):
+    with open(path) as fh:
+        return load_model_string(fh.read())

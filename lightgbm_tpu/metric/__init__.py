@@ -1,0 +1,84 @@
+"""Evaluation metrics (reference: src/metric/ + metric.h).
+
+Host-side numpy implementations; scores arrive as numpy raw margins and are
+converted through the objective where the reference does
+(``objective->ConvertOutput``).  The factory mirrors
+``Metric::CreateMetric`` (reference: src/metric/metric.cpp:16-63).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..utils import log
+from .basic import (BinaryErrorMetric, BinaryLoglossMetric, AUCMetric,
+                    CrossEntropyMetric, CrossEntropyLambdaMetric,
+                    FairMetric, GammaDevianceMetric, GammaMetric,
+                    HuberMetric, KLDivMetric, L1Metric, L2Metric, MAPEMetric,
+                    Metric, MultiErrorMetric, MultiLoglossMetric,
+                    PoissonMetric, QuantileMetric, RMSEMetric, TweedieMetric)
+from .rank import MapMetric, NDCGMetric
+
+_METRICS = {
+    "l2": L2Metric,
+    "rmse": RMSEMetric,
+    "l1": L1Metric,
+    "quantile": QuantileMetric,
+    "huber": HuberMetric,
+    "fair": FairMetric,
+    "poisson": PoissonMetric,
+    "gamma": GammaMetric,
+    "gamma_deviance": GammaDevianceMetric,
+    "tweedie": TweedieMetric,
+    "mape": MAPEMetric,
+    "binary_logloss": BinaryLoglossMetric,
+    "binary_error": BinaryErrorMetric,
+    "auc": AUCMetric,
+    "multi_logloss": MultiLoglossMetric,
+    "multi_error": MultiErrorMetric,
+    "cross_entropy": CrossEntropyMetric,
+    "cross_entropy_lambda": CrossEntropyLambdaMetric,
+    "kullback_leibler": KLDivMetric,
+    "ndcg": NDCGMetric,
+    "map": MapMetric,
+}
+
+# objective name -> default metric (reference: Config::ParseMetrics behavior)
+_DEFAULT_FOR_OBJECTIVE = {
+    "regression": "l2",
+    "regression_l1": "l1",
+    "huber": "huber",
+    "fair": "fair",
+    "poisson": "poisson",
+    "quantile": "quantile",
+    "mape": "mape",
+    "gamma": "gamma",
+    "tweedie": "tweedie",
+    "binary": "binary_logloss",
+    "multiclass": "multi_logloss",
+    "multiclassova": "multi_error",
+    "cross_entropy": "cross_entropy",
+    "cross_entropy_lambda": "cross_entropy_lambda",
+    "lambdarank": "ndcg",
+}
+
+
+def create_metric(name: str, config) -> Optional[Metric]:
+    if name in ("", "none", "null", "na", "custom"):
+        return None
+    if name not in _METRICS:
+        log.fatal(f"Unknown metric type name: {name}")
+    return _METRICS[name](config)
+
+
+def create_metrics(config) -> List[Metric]:
+    """Resolve config.metric (already alias-normalized) into instances;
+    falls back to the objective's default metric."""
+    names = list(config.metric) if config.metric else []
+    if not names and config.objective not in ("none", "null", "custom", "na"):
+        names = [_DEFAULT_FOR_OBJECTIVE.get(config.objective, "")]
+    out = []
+    for n in names:
+        m = create_metric(n, config)
+        if m is not None:
+            out.append(m)
+    return out

@@ -1,0 +1,270 @@
+"""Regression / binary / multiclass / xentropy metrics
+(reference: src/metric/{regression,binary,multiclass,xentropy}_metric.hpp)."""
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import numpy as np
+
+from ..utils import log
+
+EvalResult = Tuple[str, float, bool]  # (name, value, higher_is_better)
+
+
+class Metric:
+    name = "metric"
+    higher_is_better = False
+
+    def __init__(self, config):
+        self.config = config
+        self.label = None
+        self.weights = None
+        self.sum_weights = 0.0
+
+    def init(self, metadata, num_data: int) -> None:
+        self.label = metadata.label
+        self.weights = metadata.weights
+        self.sum_weights = (float(np.sum(self.weights))
+                            if self.weights is not None else float(num_data))
+
+    # -- helpers -------------------------------------------------------
+    def _avg(self, losses: np.ndarray) -> float:
+        if self.weights is not None:
+            return float(np.sum(losses * self.weights) / self.sum_weights)
+        return float(np.mean(losses))
+
+    def eval(self, score: np.ndarray, objective) -> List[EvalResult]:
+        raise NotImplementedError
+
+
+class _PointwiseRegression(Metric):
+    """Average per-row loss on converted predictions
+    (reference: regression_metric.hpp:21-116 RegressionMetric<T>)."""
+
+    def _loss(self, label, pred):
+        raise NotImplementedError
+
+    def _convert(self, score, objective):
+        if objective is not None:
+            return np.asarray(objective.convert_output(score))
+        return score
+
+    def eval(self, score, objective) -> List[EvalResult]:
+        pred = self._convert(score, objective)
+        return [(self.name, self._avg(self._loss(self.label, pred)),
+                 self.higher_is_better)]
+
+
+class L2Metric(_PointwiseRegression):
+    name = "l2"
+
+    def _loss(self, label, pred):
+        d = label - pred
+        return d * d
+
+
+class RMSEMetric(L2Metric):
+    name = "rmse"
+
+    def eval(self, score, objective) -> List[EvalResult]:
+        [(n, v, h)] = super().eval(score, objective)
+        return [(self.name, float(np.sqrt(v)), h)]
+
+
+class L1Metric(_PointwiseRegression):
+    name = "l1"
+
+    def _loss(self, label, pred):
+        return np.abs(label - pred)
+
+
+class QuantileMetric(_PointwiseRegression):
+    name = "quantile"
+
+    def _loss(self, label, pred):
+        alpha = float(self.config.alpha)
+        d = label - pred
+        return np.where(d >= 0, alpha * d, (alpha - 1.0) * d)
+
+
+class HuberMetric(_PointwiseRegression):
+    name = "huber"
+
+    def _loss(self, label, pred):
+        alpha = float(self.config.alpha)
+        d = np.abs(label - pred)
+        return np.where(d <= alpha, 0.5 * d * d, alpha * (d - 0.5 * alpha))
+
+
+class FairMetric(_PointwiseRegression):
+    name = "fair"
+
+    def _loss(self, label, pred):
+        c = float(self.config.fair_c)
+        x = np.abs(label - pred)
+        return c * x - c * c * np.log1p(x / c)
+
+
+class PoissonMetric(_PointwiseRegression):
+    name = "poisson"
+
+    def _loss(self, label, pred):
+        eps = 1e-10
+        p = np.maximum(pred, eps)
+        return p - label * np.log(p)
+
+
+class GammaMetric(_PointwiseRegression):
+    name = "gamma"
+
+    def _loss(self, label, pred):
+        eps = 1e-10
+        p = np.maximum(pred, eps)
+        # negative log-likelihood of Gamma with unit shape
+        # (reference: regression_metric.hpp:228-250)
+        return label / p + np.log(p)
+
+
+class GammaDevianceMetric(_PointwiseRegression):
+    name = "gamma_deviance"
+
+    def _loss(self, label, pred):
+        eps = 1e-10
+        r = label / np.maximum(pred, eps)
+        return 2.0 * (-np.log(np.maximum(r, eps)) + r - 1.0)
+
+
+class TweedieMetric(_PointwiseRegression):
+    name = "tweedie"
+
+    def _loss(self, label, pred):
+        rho = float(self.config.tweedie_variance_power)
+        eps = 1e-10
+        p = np.maximum(pred, eps)
+        a = label * np.power(p, 1.0 - rho) / (1.0 - rho)
+        b = np.power(p, 2.0 - rho) / (2.0 - rho)
+        return -a + b
+
+
+class MAPEMetric(_PointwiseRegression):
+    name = "mape"
+
+    def _loss(self, label, pred):
+        return np.abs((label - pred)) / np.maximum(1.0, np.abs(label))
+
+
+class BinaryLoglossMetric(_PointwiseRegression):
+    """(reference: binary_metric.hpp:115-136)."""
+    name = "binary_logloss"
+
+    def _loss(self, label, pred):
+        eps = 1e-15
+        p = np.clip(pred, eps, 1.0 - eps)
+        y = (label > 0).astype(np.float64)
+        return -(y * np.log(p) + (1.0 - y) * np.log(1.0 - p))
+
+
+class BinaryErrorMetric(_PointwiseRegression):
+    """(reference: binary_metric.hpp:139-156)."""
+    name = "binary_error"
+
+    def _loss(self, label, pred):
+        y = (label > 0).astype(np.float64)
+        return ((pred > 0.5) != (y > 0)).astype(np.float64)
+
+
+class AUCMetric(Metric):
+    """Weighted ROC AUC (reference: binary_metric.hpp:159-225 AUCMetric)."""
+    name = "auc"
+    higher_is_better = True
+
+    def eval(self, score, objective) -> List[EvalResult]:
+        score = np.asarray(score).ravel()
+        y = (self.label > 0).astype(np.float64)
+        w = (self.weights if self.weights is not None
+             else np.ones_like(y))
+        order = np.argsort(-score, kind="stable")
+        ys, ws, ss = y[order], w[order], score[order]
+        # group ties: accumulate within equal-score blocks
+        pos_w = ys * ws
+        neg_w = (1.0 - ys) * ws
+        # boundaries where score changes
+        new_block = np.empty(len(ss), dtype=bool)
+        new_block[0] = True
+        new_block[1:] = ss[1:] != ss[:-1]
+        block_id = np.cumsum(new_block) - 1
+        n_blocks = block_id[-1] + 1 if len(ss) else 0
+        bp = np.bincount(block_id, weights=pos_w, minlength=n_blocks)
+        bn = np.bincount(block_id, weights=neg_w, minlength=n_blocks)
+        cum_neg_before = np.concatenate([[0.0], np.cumsum(bn)[:-1]])
+        area = np.sum(bp * (cum_neg_before + 0.5 * bn))
+        total_pos = pos_w.sum()
+        total_neg = neg_w.sum()
+        if total_pos <= 0 or total_neg <= 0:
+            log.warning("AUC: data contains only one class")
+            return [(self.name, 1.0, True)]
+        # area accumulated is P(neg ranked above pos...) — with descending
+        # sort and negatives-before counting, this is 1 - AUC; flip
+        auc = 1.0 - area / (total_pos * total_neg)
+        return [(self.name, float(auc), True)]
+
+
+class MultiLoglossMetric(Metric):
+    """(reference: multiclass_metric.hpp:138-160)."""
+    name = "multi_logloss"
+
+    def eval(self, score, objective) -> List[EvalResult]:
+        prob = np.asarray(objective.convert_output(score))
+        lab = self.label.astype(np.int64)
+        eps = 1e-15
+        p = np.clip(prob[np.arange(len(lab)), lab], eps, None)
+        return [(self.name, self._avg(-np.log(p)), False)]
+
+
+class MultiErrorMetric(Metric):
+    """(reference: multiclass_metric.hpp:163-180)."""
+    name = "multi_error"
+
+    def eval(self, score, objective) -> List[EvalResult]:
+        score = np.asarray(score)
+        lab = self.label.astype(np.int64)
+        pred = score.argmax(axis=1)
+        return [(self.name, self._avg((pred != lab).astype(np.float64)), False)]
+
+
+class CrossEntropyMetric(_PointwiseRegression):
+    """(reference: xentropy_metric.hpp:71-163)."""
+    name = "cross_entropy"
+
+    def _loss(self, label, pred):
+        eps = 1e-15
+        p = np.clip(pred, eps, 1.0 - eps)
+        return -(label * np.log(p) + (1.0 - label) * np.log(1.0 - p))
+
+
+class CrossEntropyLambdaMetric(Metric):
+    """(reference: xentropy_metric.hpp:166-246)."""
+    name = "cross_entropy_lambda"
+
+    def eval(self, score, objective) -> List[EvalResult]:
+        score = np.asarray(score).ravel()
+        hhat = np.log1p(np.exp(score))
+        w = self.weights if self.weights is not None else 1.0
+        z = -np.expm1(-w * hhat)
+        eps = 1e-15
+        z = np.clip(z, eps, 1.0 - eps)
+        loss = -(self.label * np.log(z) + (1.0 - self.label) * np.log(1.0 - z))
+        return [(self.name, float(np.mean(loss)), False)]
+
+
+class KLDivMetric(Metric):
+    """(reference: xentropy_metric.hpp:249-318)."""
+    name = "kullback_leibler"
+
+    def eval(self, score, objective) -> List[EvalResult]:
+        score = np.asarray(score).ravel()
+        eps = 1e-15
+        p = np.clip(1.0 / (1.0 + np.exp(-score)), eps, 1.0 - eps)
+        y = np.clip(self.label, eps, 1.0 - eps)
+        loss = (y * np.log(y / p) + (1.0 - y) * np.log((1.0 - y) / (1.0 - p)))
+        return [(self.name, self._avg(loss), False)]

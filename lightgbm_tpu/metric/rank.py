@@ -1,0 +1,106 @@
+"""Ranking metrics: NDCG@k and MAP@k
+(reference: src/metric/rank_metric.hpp:19, map_metric.hpp:20,
+src/metric/dcg_calculator.cpp)."""
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from ..utils import log
+from .basic import EvalResult, Metric
+
+
+class _RankMetric(Metric):
+    higher_is_better = True
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.eval_at = [int(k) for k in (config.eval_at or [1, 2, 3, 4, 5])]
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        if metadata.query_boundaries is None:
+            log.fatal(f"The {self.name} metric requires query information")
+        self.query_boundaries = metadata.query_boundaries
+        self.query_weights = metadata.query_weights
+
+
+class NDCGMetric(_RankMetric):
+    """NDCG@k averaged over queries; label gain 2^l - 1
+    (reference: rank_metric.hpp:19-100, dcg_calculator.cpp)."""
+    name = "ndcg"
+
+    def __init__(self, config):
+        super().__init__(config)
+        gains = config.label_gain or []
+        self.label_gain = np.asarray(
+            gains if gains else [(1 << i) - 1 for i in range(31)], dtype=np.float64)
+
+    def _dcg_at_k(self, ks, labels, order):
+        """DCG at each k for one query given ranking order."""
+        gains = self.label_gain[labels[order].astype(np.int64)]
+        discounts = 1.0 / np.log2(np.arange(len(order)) + 2.0)
+        gd = gains * discounts
+        cum = np.cumsum(gd)
+        return [float(cum[min(k, len(order)) - 1]) if len(order) else 0.0
+                for k in ks]
+
+    def eval(self, score, objective) -> List[EvalResult]:
+        score = np.asarray(score).ravel()
+        b = self.query_boundaries
+        nq = len(b) - 1
+        sums = np.zeros(len(self.eval_at))
+        wsum = 0.0
+        for q in range(nq):
+            lo, hi = int(b[q]), int(b[q + 1])
+            lab = self.label[lo:hi]
+            sc = score[lo:hi]
+            qw = (float(self.query_weights[q])
+                  if self.query_weights is not None else 1.0)
+            wsum += qw
+            ideal = np.argsort(-lab, kind="stable")
+            if self.label_gain[lab.astype(np.int64)].max(initial=0.0) <= 0:
+                # all-zero-relevance queries count as perfect (reference:
+                # NDCGMetric::Eval empty-dcg case)
+                sums += qw
+                continue
+            pred = np.argsort(-sc, kind="stable")
+            idcg = self._dcg_at_k(self.eval_at, lab, ideal)
+            dcg = self._dcg_at_k(self.eval_at, lab, pred)
+            for i in range(len(self.eval_at)):
+                sums[i] += qw * (dcg[i] / idcg[i] if idcg[i] > 0 else 1.0)
+        return [(f"{self.name}@{k}", float(sums[i] / max(wsum, 1e-300)), True)
+                for i, k in enumerate(self.eval_at)]
+
+
+class MapMetric(_RankMetric):
+    """MAP@k (reference: map_metric.hpp:20-120)."""
+    name = "map"
+
+    def eval(self, score, objective) -> List[EvalResult]:
+        score = np.asarray(score).ravel()
+        b = self.query_boundaries
+        nq = len(b) - 1
+        sums = np.zeros(len(self.eval_at))
+        wsum = 0.0
+        for q in range(nq):
+            lo, hi = int(b[q]), int(b[q + 1])
+            lab = (self.label[lo:hi] > 0).astype(np.float64)
+            sc = score[lo:hi]
+            qw = (float(self.query_weights[q])
+                  if self.query_weights is not None else 1.0)
+            wsum += qw
+            order = np.argsort(-sc, kind="stable")
+            rel = lab[order]
+            hits = np.cumsum(rel)
+            prec = hits / (np.arange(len(rel)) + 1.0)
+            for i, k in enumerate(self.eval_at):
+                kk = min(k, len(rel))
+                npos = rel[:kk].sum()
+                if npos > 0:
+                    sums[i] += qw * float((prec[:kk] * rel[:kk]).sum() / npos)
+                else:
+                    sums[i] += qw
+        return [(f"{self.name}@{k}", float(sums[i] / max(wsum, 1e-300)), True)
+                for i, k in enumerate(self.eval_at)]

@@ -1,0 +1,47 @@
+"""Objective functions (reference: src/objective/, objective_function.h:19-91).
+
+Each objective computes per-row (gradient, hessian) from raw scores as a
+vectorized jnp expression, plus host-side init-score / output-conversion /
+leaf-renewal logic. The factory mirrors the reference
+``ObjectiveFunction::CreateObjectiveFunction`` (objective_function.cpp:15-50).
+"""
+from __future__ import annotations
+
+from ..utils import log
+from .base import Objective
+from .binary import BinaryLogloss
+from .multiclass import MulticlassOVA, MulticlassSoftmax
+from .rank import LambdarankNDCG
+from .regression import (RegressionFair, RegressionGamma, RegressionHuber,
+                         RegressionL1, RegressionL2, RegressionMAPE,
+                         RegressionPoisson, RegressionQuantile,
+                         RegressionTweedie)
+from .xentropy import CrossEntropy, CrossEntropyLambda
+
+_OBJECTIVES = {
+    "regression": RegressionL2,
+    "regression_l1": RegressionL1,
+    "huber": RegressionHuber,
+    "fair": RegressionFair,
+    "poisson": RegressionPoisson,
+    "quantile": RegressionQuantile,
+    "mape": RegressionMAPE,
+    "gamma": RegressionGamma,
+    "tweedie": RegressionTweedie,
+    "binary": BinaryLogloss,
+    "multiclass": MulticlassSoftmax,
+    "multiclassova": MulticlassOVA,
+    "cross_entropy": CrossEntropy,
+    "cross_entropy_lambda": CrossEntropyLambda,
+    "lambdarank": LambdarankNDCG,
+}
+
+
+def create_objective(config) -> Objective:
+    """(reference: src/objective/objective_function.cpp:15-50)."""
+    name = config.objective
+    if name in ("none", "null", "custom", "na", ""):
+        return None
+    if name not in _OBJECTIVES:
+        log.fatal(f"Unknown objective type name: {name}")
+    return _OBJECTIVES[name](config)

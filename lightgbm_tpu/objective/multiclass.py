@@ -1,0 +1,98 @@
+"""Multiclass objectives (reference: src/objective/multiclass_objective.hpp:24-252)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..utils import log
+from .base import Objective
+from .binary import BinaryLogloss
+
+K_EPSILON = 1e-15
+
+
+class MulticlassSoftmax(Objective):
+    """(reference: multiclass_objective.hpp:24-177)."""
+    name = "multiclass"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.num_class = int(config.num_class)
+        self.num_tree_per_iteration = self.num_class
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        lab = self.label.astype(np.int32)
+        if not ((lab >= 0) & (lab < self.num_class)).all():
+            log.fatal("Label must be in [0, %d), but found out of range label", self.num_class)
+        counts = np.bincount(lab, minlength=self.num_class)
+        self.class_init_probs = counts / max(num_data, 1)
+        import jax.numpy as jnp
+        self._onehot = jnp.asarray(
+            (lab[:, None] == np.arange(self.num_class)[None, :]).astype(np.float32))
+
+    def get_gradients(self, score):
+        """score: [N, num_class] raw margins -> g, h of the same shape."""
+        import jax.nn
+        import jax.numpy as jnp
+        p = jax.nn.softmax(score, axis=1)
+        g = p - self._onehot
+        h = 2.0 * p * (1.0 - p)
+        if self._weights_d is not None:
+            g = g * self._weights_d[:, None]
+            h = h * self._weights_d[:, None]
+        return g, h
+
+    def boost_from_score(self, class_id: int = 0) -> float:
+        return float(np.log(max(K_EPSILON, self.class_init_probs[class_id])))
+
+    def class_need_train(self, class_id: int) -> bool:
+        p = self.class_init_probs[class_id]
+        return K_EPSILON < abs(p) < 1.0 - K_EPSILON
+
+    def convert_output(self, raw):
+        raw = np.asarray(raw)
+        e = np.exp(raw - raw.max(axis=-1, keepdims=True))
+        return e / e.sum(axis=-1, keepdims=True)
+
+
+class MulticlassOVA(Objective):
+    """One-vs-all: an independent BinaryLogloss per class
+    (reference: multiclass_objective.hpp:180-252)."""
+    name = "multiclassova"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.num_class = int(config.num_class)
+        self.num_tree_per_iteration = self.num_class
+        self.sigmoid = float(config.sigmoid)
+        self._binary = [BinaryLogloss(config, is_pos=self._make_is_pos(k))
+                        for k in range(self.num_class)]
+
+    @staticmethod
+    def _make_is_pos(k):
+        return lambda y: np.asarray(y).astype(np.int32) == k
+
+    def init(self, metadata, num_data):
+        self.num_data = num_data
+        self.label = metadata.label
+        self.weights = metadata.weights
+        for b in self._binary:
+            b.init(metadata, num_data)
+
+    def get_gradients(self, score):
+        import jax.numpy as jnp
+        gs, hs = [], []
+        for k, b in enumerate(self._binary):
+            g, h = b.get_gradients(score[:, k])
+            gs.append(g)
+            hs.append(h)
+        return jnp.stack(gs, axis=1), jnp.stack(hs, axis=1)
+
+    def boost_from_score(self, class_id: int = 0) -> float:
+        return self._binary[class_id].boost_from_score()
+
+    def class_need_train(self, class_id: int) -> bool:
+        return self._binary[class_id].need_train
+
+    def convert_output(self, raw):
+        return 1.0 / (1.0 + np.exp(-self.sigmoid * np.asarray(raw)))

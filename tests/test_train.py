@@ -1,0 +1,148 @@
+"""End-to-end training tests for the core engine.
+
+The reference-parity numbers were produced by the reference CLI (built from
+/root/reference) on examples/binary_classification with
+num_leaves=31 lr=0.1 max_bin=255 min_data_in_leaf=20
+min_sum_hessian=0.001, no bagging:
+  iter20 train logloss 0.515361 auc 0.857388; valid logloss 0.543581 auc 0.817558
+(reference: docs in tests/cpp_test, examples/binary_classification/train.conf)
+"""
+import os
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+REF_DIR = "/root/reference/examples/binary_classification"
+
+
+def _synth(n=800, f=8, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    logit = X[:, 0] + 0.7 * X[:, 1] * X[:, 2] - 0.5 * X[:, 3]
+    y = (logit + rng.normal(scale=0.5, size=n) > 0).astype(np.float64)
+    return X, y
+
+
+PARAMS = {"objective": "binary", "metric": ["binary_logloss", "auc"],
+          "num_leaves": 15, "learning_rate": 0.1, "verbose": -1,
+          "min_data_in_leaf": 5}
+
+
+def test_binary_improves():
+    X, y = _synth()
+    res = {}
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train(PARAMS, ds, 15, valid_sets=[ds], valid_names=["training"],
+                    verbose_eval=False, evals_result=res)
+    ll = res["training"]["binary_logloss"]
+    auc = res["training"]["auc"]
+    assert ll[-1] < ll[0] * 0.8
+    assert auc[-1] > 0.9
+    pred = bst.predict(X)
+    assert pred.shape == (len(y),)
+    assert ((pred >= 0) & (pred <= 1)).all()
+
+
+def test_regression_improves():
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(600, 6))
+    y = X[:, 0] * 2 + np.sin(X[:, 1] * 3) + 0.1 * rng.normal(size=600)
+    res = {}
+    ds = lgb.Dataset(X, label=y)
+    lgb.train({"objective": "regression", "metric": "l2", "verbose": -1,
+               "num_leaves": 15, "min_data_in_leaf": 5}, ds, 15,
+              valid_sets=[ds], valid_names=["training"], verbose_eval=False,
+              evals_result=res)
+    l2 = res["training"]["l2"]
+    assert l2[-1] < l2[0] * 0.3
+
+
+def test_multiclass():
+    rng = np.random.default_rng(2)
+    X = rng.normal(size=(400, 5))
+    y = (X[:, 0] > 0).astype(int) + (X[:, 1] > 0).astype(int)
+    ds = lgb.Dataset(X, label=y.astype(float))
+    bst = lgb.train({"objective": "multiclass", "num_class": 3, "verbose": -1,
+                     "num_leaves": 7, "min_data_in_leaf": 5}, ds, 10)
+    p = bst.predict(X)
+    assert p.shape == (400, 3)
+    np.testing.assert_allclose(p.sum(axis=1), 1.0, rtol=1e-5)
+    assert (p.argmax(1) == y).mean() > 0.8
+
+
+def test_missing_values_routed():
+    X, y = _synth(seed=3)
+    X[::4, 0] = np.nan
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train(PARAMS, ds, 8, verbose_eval=False)
+    pred = bst.predict(X)
+    assert np.isfinite(pred).all()
+
+
+def test_early_stopping_halts():
+    X, y = _synth(seed=4)
+    Xv, yv = _synth(seed=5)  # different draw -> valid plateaus
+    ds = lgb.Dataset(X, label=y)
+    vs = ds.create_valid(Xv, label=yv)
+    bst = lgb.train(PARAMS, ds, 200, valid_sets=[vs], verbose_eval=False,
+                    early_stopping_rounds=3)
+    assert bst.best_iteration > 0
+    assert bst.current_iteration() < 200
+
+
+def test_weights_change_model():
+    X, y = _synth(seed=6)
+    w = np.where(y > 0, 5.0, 1.0)
+    ds1 = lgb.Dataset(X, label=y)
+    ds2 = lgb.Dataset(X, label=y, weight=w)
+    b1 = lgb.train(PARAMS, ds1, 5, verbose_eval=False)
+    b2 = lgb.train(PARAMS, ds2, 5, verbose_eval=False)
+    assert not np.allclose(b1.predict(X), b2.predict(X))
+
+
+def test_custom_objective_fobj():
+    X, y = _synth(seed=7)
+    ds = lgb.Dataset(X, label=y)
+
+    def fobj(preds, dataset):
+        lab = dataset.get_label()
+        p = 1.0 / (1.0 + np.exp(-preds))
+        return p - lab, p * (1.0 - p)
+
+    bst = lgb.train({"num_leaves": 15, "verbose": -1, "min_data_in_leaf": 5,
+                     "learning_rate": 0.1, "metric": "none"},
+                    ds, 10, fobj=fobj, verbose_eval=False)
+    raw = bst.predict(X, raw_score=True)
+    from sklearn.metrics import roc_auc_score
+    assert roc_auc_score(y, raw) > 0.85
+
+
+@pytest.mark.skipif(not os.path.exists(REF_DIR), reason="reference not mounted")
+def test_reference_parity_binary():
+    """AUC/logloss within tolerance of the reference CLI trajectory."""
+    tr = np.loadtxt(os.path.join(REF_DIR, "binary.train"))
+    te = np.loadtxt(os.path.join(REF_DIR, "binary.test"))
+    ds = lgb.Dataset(tr[:, 1:], label=tr[:, 0])
+    vs = ds.create_valid(te[:, 1:], label=te[:, 0])
+    res = {}
+    lgb.train({"objective": "binary", "metric": ["binary_logloss", "auc"],
+               "num_leaves": 31, "learning_rate": 0.1, "max_bin": 255,
+               "verbose": -1}, ds, 20, valid_sets=[vs], verbose_eval=False,
+              evals_result=res)
+    assert abs(res["valid_0"]["auc"][-1] - 0.817558) < 0.01
+    assert abs(res["valid_0"]["binary_logloss"][-1] - 0.543581) < 0.01
+
+
+def test_eval_weighted_auc_matches_sklearn():
+    X, y = _synth(seed=8)
+    w = np.abs(np.random.default_rng(8).normal(size=len(y))) + 0.1
+    ds = lgb.Dataset(X, label=y, weight=w)
+    res = {}
+    bst = lgb.train(PARAMS, ds, 5, valid_sets=[ds], valid_names=["training"],
+                    verbose_eval=False, evals_result=res)
+    from sklearn.metrics import roc_auc_score
+    pred = bst.predict(X)
+    skl = roc_auc_score(y, pred, sample_weight=w)
+    np.testing.assert_allclose(res["training"]["auc"][-1], skl, rtol=1e-6)
