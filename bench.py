@@ -1,0 +1,97 @@
+"""Benchmark harness — HIGGS-like binary training throughput.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
+
+Baseline: the reference trains HIGGS (10.5M rows x 28 features, num_leaves
+255, 500 iters) in 238.5 s on 2x E5-2670v3 (BASELINE.md, reference
+docs/Experiments.rst:106) => 2.20e7 row-iterations/second.  This harness
+trains the same shape of problem (synthetic unless a real HIGGS csv is
+present at $HIGGS_PATH) and reports steady-state row-iterations/second;
+vs_baseline > 1 means faster than the reference CPU result.
+
+Env knobs: BENCH_ROWS (default 1_000_000), BENCH_ITERS (default 10),
+BENCH_LEAVES (default 255).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REF_ROW_ITERS_PER_SEC = 10_500_000 * 500 / 238.5  # 2.2013e7
+
+
+def _load_data(rows: int):
+    path = os.environ.get("HIGGS_PATH", "")
+    if path and os.path.exists(path):
+        data = np.loadtxt(path, delimiter=",", max_rows=rows)
+        return data[:, 1:29], data[:, 0]
+    rng = np.random.default_rng(0)
+    n_informative = 8
+    X = rng.normal(size=(rows, 28)).astype(np.float32)
+    w = rng.normal(size=n_informative)
+    logit = X[:, :n_informative] @ w + 0.5 * X[:, 0] * X[:, 1]
+    y = (logit + rng.logistic(size=rows) > 0).astype(np.float64)
+    return X.astype(np.float64), y
+
+
+def main() -> None:
+    rows = int(os.environ.get("BENCH_ROWS", 1_000_000))
+    iters = int(os.environ.get("BENCH_ITERS", 10))
+    leaves = int(os.environ.get("BENCH_LEAVES", 255))
+    if iters < 2:
+        raise SystemExit("BENCH_ITERS must be >= 2: the first iteration is "
+                         "compile warmup and is excluded from throughput")
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import lightgbm_tpu as lgb
+
+    X, y = _load_data(rows)
+    t_bin0 = time.time()
+    ds = lgb.Dataset(X, label=y, params={"max_bin": 255, "verbose": -1})
+    ds.construct()
+    bin_time = time.time() - t_bin0
+
+    params = {"objective": "binary", "metric": "auc", "num_leaves": leaves,
+              "learning_rate": 0.1, "max_bin": 255, "min_data_in_leaf": 100,
+              "verbose": -1}
+    booster = lgb.Booster(params=params, train_set=ds)
+
+    # warmup iteration (jit compile)
+    t0 = time.time()
+    booster.update()
+    compile_time = time.time() - t0
+
+    t1 = time.time()
+    for _ in range(iters - 1):
+        booster.update()
+    steady = time.time() - t1
+    per_iter = steady / max(iters - 1, 1)
+
+    auc = booster.eval_train()
+    auc_val = next((v for (_, m, v, _) in auc if m == "auc"), None)
+
+    row_iters_per_sec = rows / per_iter
+    result = {
+        "metric": "train_throughput",
+        "value": round(row_iters_per_sec, 1),
+        "unit": "row_iters/s",
+        "vs_baseline": round(row_iters_per_sec / REF_ROW_ITERS_PER_SEC, 4),
+        "rows": rows,
+        "iters": iters,
+        "num_leaves": leaves,
+        "per_iter_s": round(per_iter, 3),
+        "compile_s": round(compile_time, 1),
+        "binning_s": round(bin_time, 1),
+        "train_auc": None if auc_val is None else round(float(auc_val), 5),
+        "implied_higgs_500iter_s": round(10_500_000 * 500 / row_iters_per_sec, 1),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -106,18 +106,42 @@ def go_left_bins(col, threshold, default_left, missing_type, num_bin, default_bi
     return jnp.where(is_missing, default_left, col <= threshold)
 
 
-def make_grower(meta: DeviceMeta, cfg: SplitConfig, B: int, hist_fn=hist_onehot):
-    """Build a jitted ``grow(bins, g, h, sample_mask, feature_mask)`` closure.
+def build_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
+                  hist_fn=hist_onehot, reduce_fn=None, best_split_fn=None,
+                  subtract_sibling: bool = True):
+    """Build an *unjitted* ``grow(bins, g, h, sample_mask, feature_mask)``.
 
     bins: uint8/int32 [N, F]; g/h: f32 [N]; sample_mask: f32 [N] (bagging);
     feature_mask: bool [F] (feature_fraction). ``B`` is the static padded
     bin width. Returns (TreeArrays, leaf_id).
+
+    Distribution hooks (used by parallel/mesh.py under shard_map):
+    - ``reduce_fn``: cross-device reduction of histograms and root stats —
+      ``lambda x: lax.psum(x, axis)`` makes rows-sharded training exact,
+      the analog of the reference's histogram ReduceScatter + global leaf
+      counts (reference: src/treelearner/data_parallel_tree_learner.cpp:
+      119-164).
+    - ``best_split_fn``: replaces the local split search — feature-parallel
+      mode scans only the device's feature block then syncs the winner
+      (reference: SyncUpGlobalBestSplit, parallel_tree_learner.h:190-213).
+      Must return a ``BestSplit`` with *global* feature ids; ``meta`` here
+      stays global for the partition step.
+    - ``subtract_sibling=False`` histograms both children explicitly instead
+      of deriving the larger from parent-minus-smaller — required when
+      ``reduce_fn`` is lossy per pass (voting-parallel's top-k gate), where
+      parent and child passes may keep different feature sets and the
+      subtraction would mix them.
     """
     L = cfg.num_leaves
+    if reduce_fn is None:
+        reduce_fn = lambda x: x
+    if best_split_fn is None:
+        def best_split_fn(hist_leaf, sg, sh, sc, min_c, max_c, feature_mask):
+            return best_split(hist_leaf, sg, sh, sc, meta, cfg, min_c, max_c,
+                              feature_mask=feature_mask)
 
     def _child_best(hist_leaf, sg, sh, sc, depth, min_c, max_c, feature_mask):
-        bs = best_split(hist_leaf, sg, sh, sc, meta, cfg, min_c, max_c,
-                        feature_mask=feature_mask)
+        bs = best_split_fn(hist_leaf, sg, sh, sc, min_c, max_c, feature_mask)
         depth_ok = (cfg.max_depth <= 0) | (depth < cfg.max_depth)
         gain = jnp.where(depth_ok, bs.gain, NEG_INF)
         return bs._replace(gain=gain)
@@ -180,9 +204,14 @@ def make_grower(meta: DeviceMeta, cfg: SplitConfig, B: int, hist_fn=hist_onehot)
         small = jnp.where(left_smaller, leaf, new)
         large = jnp.where(left_smaller, new, leaf)
         small_mask = (leaf_id == small).astype(jnp.float32) * sample_mask
-        hist_small = hist_fn(bins, g, h, small_mask, B=B)
+        hist_small = reduce_fn(hist_fn(bins, g, h, small_mask, B=B))
         hist = st.hist.at[small].set(hist_small)
-        hist = hist.at[large].set(parent_hist - hist_small)
+        if subtract_sibling:
+            hist = hist.at[large].set(parent_hist - hist_small)
+        else:
+            large_mask = (leaf_id == large).astype(jnp.float32) * sample_mask
+            hist = hist.at[large].set(
+                reduce_fn(hist_fn(bins, g, h, large_mask, B=B)))
 
         # ---- best splits for the two children ---------------------------
         d = st.leaf_depth[leaf] + 1
@@ -214,14 +243,13 @@ def make_grower(meta: DeviceMeta, cfg: SplitConfig, B: int, hist_fn=hist_onehot)
             tree=tr,
         )
 
-    @jax.jit
     def grow(bins, g, h, sample_mask, feature_mask):
         N, F = bins.shape
-        sum_g = jnp.sum(g * sample_mask)
-        sum_h = jnp.sum(h * sample_mask)
-        cnt = jnp.sum(sample_mask)
+        sum_g = reduce_fn(jnp.sum(g * sample_mask))
+        sum_h = reduce_fn(jnp.sum(h * sample_mask))
+        cnt = reduce_fn(jnp.sum(sample_mask))
 
-        hist0 = hist_fn(bins, g, h, sample_mask, B=B)
+        hist0 = reduce_fn(hist_fn(bins, g, h, sample_mask, B=B))
         inf = jnp.float32(jnp.inf)
         root_out = leaf_output(sum_g, sum_h, cfg)
         bs0 = _child_best(hist0, sum_g, sum_h, cnt, jnp.int32(0),
@@ -269,3 +297,8 @@ def make_grower(meta: DeviceMeta, cfg: SplitConfig, B: int, hist_fn=hist_onehot)
         return tr, st.leaf_id
 
     return grow
+
+
+def make_grower(meta: DeviceMeta, cfg: SplitConfig, B: int, hist_fn=hist_onehot):
+    """Jitted single-device grower."""
+    return jax.jit(build_grow_fn(meta, cfg, B, hist_fn))

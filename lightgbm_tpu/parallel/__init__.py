@@ -1,0 +1,15 @@
+"""Distributed tree learning over a ``jax.sharding.Mesh``.
+
+TPU-native re-expression of the reference's socket/MPI collective backend and
+parallel tree learners (reference: src/network/network.cpp,
+src/treelearner/{data,feature,voting}_parallel_tree_learner.cpp):
+
+- data-parallel: rows sharded, histograms summed with ``lax.psum`` — the
+  analog of ReduceScatter + SyncUpGlobalBestSplit.
+- feature-parallel: features sharded, every device holds all rows; local
+  best splits combined with an all-gather + argmax.
+- voting-parallel: rows sharded, per-device top-k feature gate before the
+  histogram exchange (PV-Tree).
+"""
+from .mesh import (make_data_parallel_grower, make_feature_parallel_grower,
+                   make_voting_parallel_grower, row_sharded, shard_rows)

@@ -1,8 +1,18 @@
 """Test configuration: force an 8-device virtual CPU mesh so sharding tests
-run without TPU hardware (SURVEY.md §4's loopback-collective gap)."""
+run without TPU hardware (SURVEY.md §4's loopback-collective gap).
+
+The container's sitecustomize imports jax and registers the axon TPU plugin
+before pytest starts, so setting env vars alone is too late — the jax config
+must be updated directly (safe: no backend is initialized yet at conftest
+import time).
+"""
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

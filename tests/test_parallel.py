@@ -1,0 +1,93 @@
+"""Distributed-mode tests on the virtual 8-device CPU mesh
+(conftest sets XLA_FLAGS=--xla_force_host_platform_device_count=8).
+
+Closes the SURVEY §4 gap: the reference never had a multi-node CI fixture;
+here data-parallel growth is asserted bit-identical to single-device.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.core.grower import make_grower
+from lightgbm_tpu.core.meta import SplitConfig, build_device_meta, _padded_bin_width
+from lightgbm_tpu.parallel import (make_data_parallel_grower,
+                                   make_feature_parallel_grower,
+                                   make_voting_parallel_grower, shard_rows)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    rng = np.random.default_rng(0)
+    N, F = 512, 6
+    X = rng.normal(size=(N, F))
+    y = (X[:, 0] + X[:, 1] * X[:, 2] > 0).astype(np.float64)
+    cfg = lgb.Config.from_params({"objective": "binary", "num_leaves": 15,
+                                  "min_data_in_leaf": 5, "verbose": -1})
+    ds = lgb.Dataset(X, label=y, params={"min_data_in_leaf": 5})
+    ds.construct()
+    h = ds._handle
+    meta, B = build_device_meta(h, cfg)
+    scfg = SplitConfig.from_config(cfg)
+    bins = jnp.asarray(h.X_bin)
+    score = jnp.zeros(N, jnp.float32)
+    p = 1.0 / (1.0 + jnp.exp(-score))
+    g = (p - jnp.asarray(y, jnp.float32)).astype(jnp.float32)
+    hess = (p * (1 - p)).astype(jnp.float32)
+    mask = jnp.ones(N, jnp.float32)
+    fmask = jnp.ones(h.num_features, bool)
+    return meta, scfg, B, bins, g, hess, mask, fmask
+
+
+def _mesh():
+    devs = np.array(jax.devices())
+    assert len(devs) >= 8, f"expected 8 virtual devices, got {len(devs)}"
+    return Mesh(devs[:8], ("data",))
+
+
+def test_data_parallel_matches_single_device(setup):
+    meta, scfg, B, bins, g, h, mask, fmask = setup
+    tree1, leaf1 = make_grower(meta, scfg, B)(bins, g, h, mask, fmask)
+
+    mesh = _mesh()
+    grow_dp = make_data_parallel_grower(meta, scfg, B, mesh)
+    bins_s, g_s, h_s, mask_s = shard_rows(mesh, bins, g, h, mask)
+    tree8, leaf8 = grow_dp(bins_s, g_s, h_s, mask_s, fmask)
+
+    assert int(tree8.num_leaves) == int(tree1.num_leaves)
+    np.testing.assert_array_equal(np.asarray(tree8.split_feature),
+                                  np.asarray(tree1.split_feature))
+    np.testing.assert_array_equal(np.asarray(tree8.threshold_bin),
+                                  np.asarray(tree1.threshold_bin))
+    np.testing.assert_array_equal(np.asarray(leaf8), np.asarray(leaf1))
+    # leaf values agree to f32 reduction-order tolerance
+    np.testing.assert_allclose(np.asarray(tree8.leaf_value),
+                               np.asarray(tree1.leaf_value), atol=1e-5)
+
+
+def test_feature_parallel_matches_single_device(setup):
+    meta, scfg, B, bins, g, h, mask, fmask = setup
+    tree1, _ = make_grower(meta, scfg, B)(bins, g, h, mask, fmask)
+
+    mesh = _mesh()
+    grow_fp = make_feature_parallel_grower(meta, scfg, B, mesh)
+    tree8, _ = grow_fp(bins, g, h, mask, fmask)
+    assert int(tree8.num_leaves) == int(tree1.num_leaves)
+    np.testing.assert_array_equal(np.asarray(tree8.split_feature),
+                                  np.asarray(tree1.split_feature))
+    np.testing.assert_array_equal(np.asarray(tree8.threshold_bin),
+                                  np.asarray(tree1.threshold_bin))
+
+
+def test_voting_parallel_trains(setup):
+    meta, scfg, B, bins, g, h, mask, fmask = setup
+    mesh = _mesh()
+    grow_v = make_voting_parallel_grower(meta, scfg, B, mesh, top_k=3)
+    bins_s, g_s, h_s, mask_s = shard_rows(mesh, bins, g, h, mask)
+    tree, leaf = grow_v(bins_s, g_s, h_s, mask_s, fmask)
+    # voting is approximate: require a usable tree, not bit-parity
+    assert int(tree.num_leaves) > 4
+    assert np.asarray(leaf).max() < int(tree.num_leaves)
