@@ -1,0 +1,271 @@
+"""Wave-scheduled leaf-wise tree growth — the TPU-native fast path.
+
+The reference pays one histogram pass over the smaller child's rows per
+split (reference: serial_tree_learner.cpp:496-522); its cost model is
+gather-friendly CPU caches. On TPU a data pass costs the same for 1 or 42
+leaf masks (the MXU processes 128 output lanes regardless — see
+ops/pallas_hist.py), so growth is re-scheduled into waves:
+
+  split phase: best-first split every histogram-ready leaf with positive
+      gain (up to the wave capacity), exactly like the reference's loop;
+  wave phase:  ONE kernel pass computes the smaller child's histogram for
+      every split just made (channels packed per leaf); each sibling comes
+      from parent-minus-child subtraction; children's best splits are then
+      scanned with a vmap.
+
+With capacity 1 this is exactly the reference's leaf-wise order; with
+capacity 42 a 255-leaf tree needs ~8-14 data passes instead of 254.  The
+split ORDER can deviate from strict global best-first (a pending child's
+gain is unknown until its wave), which matches the spirit of the
+reference's voting/feature-parallel approximations and is measurably
+accuracy-neutral; exactness is recovered with wave_capacity=1.
+
+Bins are feature-major [F, N] here (see ops/pallas_hist.py layout note).
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.pallas_hist import C_MAX, hist_pallas_wave
+from .grower import TreeArrays, _empty_tree, go_left_bins
+from .meta import DeviceMeta, SplitConfig
+from .splitter import best_split, leaf_output
+
+NEG_INF = -jnp.inf
+
+
+class _WaveState(NamedTuple):
+    leaf_id: jnp.ndarray        # i32 [N]
+    hist: jnp.ndarray           # f32 [L+1, F, B, 3] (slot L = scratch)
+    leaf_g: jnp.ndarray         # f32 [L+1]
+    leaf_h: jnp.ndarray
+    leaf_c: jnp.ndarray
+    leaf_depth: jnp.ndarray     # i32 [L+1]
+    leaf_min_c: jnp.ndarray
+    leaf_max_c: jnp.ndarray
+    leaf_out: jnp.ndarray
+    hist_ready: jnp.ndarray     # bool [L+1]
+    best_gain: jnp.ndarray      # f32 [L+1]
+    best_feat: jnp.ndarray
+    best_thr: jnp.ndarray
+    best_dl: jnp.ndarray
+    best_lg: jnp.ndarray
+    best_lh: jnp.ndarray
+    best_lc: jnp.ndarray
+    leaf_parent: jnp.ndarray
+    leaf_is_right: jnp.ndarray
+    pend_small: jnp.ndarray     # i32 [P] leaf ids (-1 empty)
+    pend_large: jnp.ndarray     # i32 [P]
+    pend_cnt: jnp.ndarray       # i32
+    tree: TreeArrays
+
+
+def build_wave_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
+                       wave_capacity: int = 42, highest: bool = False):
+    """Unjitted ``grow(bins_fm, g, h, sample_mask, feature_mask)`` using the
+    Pallas wave kernel. Returns (TreeArrays, leaf_id)."""
+    L = cfg.num_leaves
+    P = max(1, min(wave_capacity, C_MAX // 3))
+
+    def _scan_leaf(hist_leaf, sg, sh, sc, min_c, max_c, depth, feature_mask):
+        bs = best_split(hist_leaf, sg, sh, sc, meta, cfg, min_c, max_c,
+                        feature_mask=feature_mask)
+        depth_ok = (cfg.max_depth <= 0) | (depth < cfg.max_depth)
+        return bs._replace(gain=jnp.where(depth_ok, bs.gain, NEG_INF))
+
+    # ---------------- split phase --------------------------------------
+    def _split_once(st: _WaveState, bins_fm, feature_mask):
+        gains = jnp.where(st.hist_ready[:L], st.best_gain[:L], NEG_INF)
+        leaf = jnp.argmax(gains).astype(jnp.int32)
+        ok = ((gains[leaf] > 0.0)
+              & (st.tree.num_leaves < L)
+              & (st.pend_cnt < P))
+
+        def do(st: _WaveState) -> _WaveState:
+            new = st.tree.num_leaves.astype(jnp.int32)  # next leaf index
+            k = new - 1                                  # node index
+            f = st.best_feat[leaf]
+            t = st.best_thr[leaf]
+            dl = st.best_dl[leaf]
+            lg, lh, lc = st.best_lg[leaf], st.best_lh[leaf], st.best_lc[leaf]
+            pg, ph, pc = st.leaf_g[leaf], st.leaf_h[leaf], st.leaf_c[leaf]
+            rg, rh, rc = pg - lg, ph - lh, pc - lc
+            min_c, max_c = st.leaf_min_c[leaf], st.leaf_max_c[leaf]
+            out_l = jnp.clip(leaf_output(lg, lh, cfg), min_c, max_c)
+            out_r = jnp.clip(leaf_output(rg, rh, cfg), min_c, max_c)
+            mono = meta.monotone[f]
+            mid = (out_l + out_r) / 2.0
+            l_min = jnp.where(mono < 0, mid, min_c)
+            l_max = jnp.where(mono > 0, mid, max_c)
+            r_min = jnp.where(mono > 0, mid, min_c)
+            r_max = jnp.where(mono < 0, mid, max_c)
+
+            tr = st.tree
+            parent_node = st.leaf_parent[leaf]
+            has_parent = parent_node >= 0
+            pn = jnp.maximum(parent_node, 0)
+            new_lc_ptr = jnp.where(has_parent & ~st.leaf_is_right[leaf],
+                                   k, tr.left_child[pn])
+            new_rc_ptr = jnp.where(has_parent & st.leaf_is_right[leaf],
+                                   k, tr.right_child[pn])
+            tr = tr._replace(
+                split_feature=tr.split_feature.at[k].set(f),
+                threshold_bin=tr.threshold_bin.at[k].set(t),
+                default_left=tr.default_left.at[k].set(dl),
+                split_gain=tr.split_gain.at[k].set(st.best_gain[leaf]),
+                internal_value=tr.internal_value.at[k].set(st.leaf_out[leaf]),
+                internal_count=tr.internal_count.at[k].set(pc.astype(jnp.int32)),
+                internal_weight=tr.internal_weight.at[k].set(ph),
+                left_child=tr.left_child.at[pn].set(new_lc_ptr).at[k].set(~leaf),
+                right_child=tr.right_child.at[pn].set(new_rc_ptr).at[k].set(~new),
+                num_leaves=tr.num_leaves + 1,
+            )
+
+            col = bins_fm[f].astype(jnp.int32)
+            go_left = go_left_bins(col, t, dl, meta.missing_types[f],
+                                   meta.num_bins[f], meta.default_bins[f])
+            in_leaf = st.leaf_id == leaf
+            leaf_id = jnp.where(in_leaf & ~go_left, new, st.leaf_id)
+
+            small = jnp.where(lc < rc, leaf, new)
+            large = jnp.where(lc < rc, new, leaf)
+            d = st.leaf_depth[leaf] + 1
+
+            def upd(a, v1, v2):
+                return a.at[leaf].set(v1).at[new].set(v2)
+
+            return st._replace(
+                leaf_id=leaf_id,
+                leaf_g=upd(st.leaf_g, lg, rg),
+                leaf_h=upd(st.leaf_h, lh, rh),
+                leaf_c=upd(st.leaf_c, lc, rc),
+                leaf_depth=upd(st.leaf_depth, d, d),
+                leaf_min_c=upd(st.leaf_min_c, l_min, r_min),
+                leaf_max_c=upd(st.leaf_max_c, l_max, r_max),
+                leaf_out=upd(st.leaf_out, out_l, out_r),
+                hist_ready=upd(st.hist_ready, False, False),
+                best_gain=upd(st.best_gain, NEG_INF, NEG_INF),
+                leaf_parent=upd(st.leaf_parent, k, k),
+                leaf_is_right=upd(st.leaf_is_right, False, True),
+                pend_small=st.pend_small.at[st.pend_cnt].set(small),
+                pend_large=st.pend_large.at[st.pend_cnt].set(large),
+                pend_cnt=st.pend_cnt + 1,
+                tree=tr,
+            )
+
+        return jax.lax.cond(ok, do, lambda s: s, st)
+
+    # ---------------- wave phase ---------------------------------------
+    def _wave(st: _WaveState, bins_fm, gv, hv, cv, feature_mask):
+        def do(st: _WaveState) -> _WaveState:
+            c_idx = jnp.arange(C_MAX) // 3
+            slot_leaf = jnp.where(c_idx < P, st.pend_small[jnp.minimum(c_idx, P - 1)],
+                                  -1).astype(jnp.int32)
+            hw = hist_pallas_wave(bins_fm, gv, hv, cv, st.leaf_id, slot_leaf,
+                                  B=B, highest=highest)  # [F, B, C]
+            Fdim = hw.shape[0]
+            ws = hw[:, :, :3 * P].reshape(Fdim, B, P, 3).transpose(2, 0, 1, 3)
+
+            smalls = st.pend_small                       # [P]
+            larges = st.pend_large
+            dead = smalls < 0
+            no_sib = larges < 0
+            parents = jnp.minimum(smalls, jnp.where(no_sib, smalls, larges))
+            parents = jnp.maximum(parents, 0)
+            sib = st.hist[parents] - ws                  # [P, F, B, 3]
+
+            smalls_w = jnp.where(dead, L, smalls)
+            larges_w = jnp.where(dead | no_sib, L, larges)
+            hist = st.hist.at[smalls_w].set(ws)
+            hist = hist.at[larges_w].set(sib)
+
+            # best splits for all children of this wave
+            cand = jnp.concatenate([smalls, larges])     # [2P]
+            valid = cand >= 0
+            cl = jnp.where(valid, cand, 0)
+            bs = jax.vmap(
+                _scan_leaf, in_axes=(0, 0, 0, 0, 0, 0, 0, None))(
+                hist[cl], st.leaf_g[cl], st.leaf_h[cl], st.leaf_c[cl],
+                st.leaf_min_c[cl], st.leaf_max_c[cl], st.leaf_depth[cl],
+                feature_mask)
+            cl_w = jnp.where(valid, cand, L)
+            st = st._replace(
+                hist=hist,
+                hist_ready=st.hist_ready.at[cl_w].set(True),
+                best_gain=st.best_gain.at[cl_w].set(bs.gain),
+                best_feat=st.best_feat.at[cl_w].set(bs.feature),
+                best_thr=st.best_thr.at[cl_w].set(bs.threshold),
+                best_dl=st.best_dl.at[cl_w].set(bs.default_left),
+                best_lg=st.best_lg.at[cl_w].set(bs.left_g),
+                best_lh=st.best_lh.at[cl_w].set(bs.left_h),
+                best_lc=st.best_lc.at[cl_w].set(bs.left_c),
+                pend_small=jnp.full((P,), -1, jnp.int32),
+                pend_large=jnp.full((P,), -1, jnp.int32),
+                pend_cnt=jnp.int32(0),
+            )
+            return st
+
+        return jax.lax.cond(st.pend_cnt > 0, do, lambda s: s, st)
+
+    # ---------------- driver -------------------------------------------
+    def grow(bins_fm, g, h, sample_mask, feature_mask):
+        F, N = bins_fm.shape
+        gv = (g * sample_mask).astype(jnp.float32)
+        hv = (h * sample_mask).astype(jnp.float32)
+        cv = sample_mask.astype(jnp.float32)
+        sum_g = jnp.sum(gv)
+        sum_h = jnp.sum(hv)
+        cnt = jnp.sum(cv)
+
+        Lf = jnp.zeros((L + 1,), jnp.float32)
+        Li = jnp.zeros((L + 1,), jnp.int32)
+        inf = jnp.float32(jnp.inf)
+        st = _WaveState(
+            leaf_id=jnp.zeros((N,), jnp.int32),
+            hist=jnp.zeros((L + 1, F, B, 3), jnp.float32),
+            leaf_g=Lf.at[0].set(sum_g),
+            leaf_h=Lf.at[0].set(sum_h),
+            leaf_c=Lf.at[0].set(cnt),
+            leaf_depth=Li,
+            leaf_min_c=jnp.full((L + 1,), -inf),
+            leaf_max_c=jnp.full((L + 1,), inf),
+            leaf_out=Lf.at[0].set(leaf_output(sum_g, sum_h, cfg)),
+            hist_ready=jnp.zeros((L + 1,), bool),
+            best_gain=jnp.full((L + 1,), NEG_INF),
+            best_feat=Li, best_thr=Li,
+            best_dl=jnp.zeros((L + 1,), bool),
+            best_lg=Lf, best_lh=Lf, best_lc=Lf,
+            leaf_parent=jnp.full((L + 1,), -1, jnp.int32),
+            leaf_is_right=jnp.zeros((L + 1,), bool),
+            pend_small=jnp.full((P,), -1, jnp.int32).at[0].set(0),
+            pend_large=jnp.full((P,), -1, jnp.int32),
+            pend_cnt=jnp.int32(1),
+            tree=_empty_tree(L),
+        )
+        # root wave computes leaf 0's histogram + best split
+        st = _wave(st, bins_fm, gv, hv, cv, feature_mask)
+
+        def body(_, st):
+            def split_body(_, st):
+                return _split_once(st, bins_fm, feature_mask)
+            st = jax.lax.fori_loop(0, P, split_body, st)
+            return _wave(st, bins_fm, gv, hv, cv, feature_mask)
+
+        st = jax.lax.fori_loop(0, L - 1, body, st)
+
+        tr = st.tree._replace(
+            leaf_value=st.leaf_out[:L],
+            leaf_count=st.leaf_c[:L].astype(jnp.int32),
+            leaf_weight=st.leaf_h[:L],
+        )
+        return tr, st.leaf_id
+
+    return grow
+
+
+def make_wave_grower(meta: DeviceMeta, cfg: SplitConfig, B: int,
+                     wave_capacity: int = 42, highest: bool = False):
+    return jax.jit(build_wave_grow_fn(meta, cfg, B, wave_capacity, highest))

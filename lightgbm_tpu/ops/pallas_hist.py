@@ -1,0 +1,197 @@
+"""Pallas TPU histogram kernel — the ConstructHistogram replacement.
+
+The reference's hottest loop gathers bins and accumulates (g, h, count)
+per bin with scalar code (reference: src/io/dense_bin.hpp:71-135) or
+workgroup atomics (reference: src/treelearner/ocl/histogram256.cl:350).
+TPUs have no fast scatter, so this kernel turns accumulation into MXU
+matmuls with the one-hot factor built directly in VMEM — it never touches
+HBM, unlike the XLA fallback in core/histogram.py which materializes
+one-hot tiles.
+
+Channel packing: the MXU processes 128 output lanes per pass regardless of
+how many are used, so the kernel accumulates ``C=128`` weight channels at
+once. Callers pack (g*m, h*m, m) triples for up to 42 different leaf masks
+into those channels, making one data pass produce 42 leaves' histograms —
+this is what makes wave-scheduled leaf growth (core/wave_grower.py) run at
+full MXU utilization.
+
+Data layout: bins are FEATURE-MAJOR ``[F, N]`` uint8 (the TPU-native
+resident layout — per-feature column access is a contiguous row slice, and
+the uint8 32-sublane tile constraint lands on the feature axis).
+
+Per grid step (j=feature block, i=row block):
+  bins block  [FB, BR]   uint8
+  gh block    [BR, C]    f32 (pre-masked channels)
+  out block   [FB, B, C] f32, accumulated across the i sweep
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# channel capacity: one MXU lane pass
+C_MAX = 128
+_DEF_BR = 1024
+_DEF_FB = 32  # uint8 sublane tile
+
+
+def _hist_kernel(bins_ref, gh_ref, out_ref, *, B: int, FB: int):
+    i = pl.program_id(1)
+
+    @pl.when(i == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    gh = gh_ref[...]  # [BR, C]
+    iota = jax.lax.broadcasted_iota(jnp.int32, (1, B), 1)
+    for f in range(FB):
+        col = bins_ref[f, :].astype(jnp.int32)           # [BR]
+        oh = (col[:, None] == iota).astype(jnp.float32)  # [BR, B]
+        acc = jax.lax.dot_general(
+            oh, gh, (((0,), (0,)), ((), ())),            # [B, C]
+            precision=jax.lax.Precision.HIGHEST,
+            preferred_element_type=jnp.float32)
+        out_ref[f] += acc
+
+
+@functools.partial(jax.jit, static_argnames=("B", "block_rows", "feat_block"))
+def hist_pallas_channels(bins_fm, gh, B: int, block_rows: int = _DEF_BR,
+                         feat_block: int = _DEF_FB):
+    """Multi-channel histogram: bins_fm [F, N] uint8, gh [N, C] f32 ->
+    [F, B, C] f32 with out[f, b, c] = sum_r gh[r, c] * (bins_fm[f, r] == b)."""
+    F, N = bins_fm.shape
+    C = gh.shape[1]
+    assert C % 128 == 0, f"channel dim must be a multiple of 128, got {C}"
+    BR = min(block_rows, max(128, N))
+    FB = min(feat_block, max(F, 1))
+    pad_rows = (-N) % BR
+    if pad_rows:
+        # padded rows get bin 0 but zero weight in every channel
+        bins_fm = jnp.pad(bins_fm, ((0, 0), (0, pad_rows)))
+        gh = jnp.pad(gh, ((0, pad_rows), (0, 0)))
+    pad_f = (-F) % FB
+    if pad_f:
+        bins_fm = jnp.pad(bins_fm, ((0, pad_f), (0, 0)))
+    Fp, Np = bins_fm.shape
+
+    grid = (Fp // FB, Np // BR)
+    out = pl.pallas_call(
+        functools.partial(_hist_kernel, B=B, FB=FB),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((FB, BR), lambda j, i: (j, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((BR, C), lambda j, i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((FB, B, C), lambda j, i: (j, 0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((Fp, B, C), jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary")),
+    )(bins_fm, gh)
+    return out[:F]
+
+
+def _hist_wave_kernel(bins_ref, vecs_ref, slot_ref, out_ref, *, B: int,
+                      FB: int, prec):
+    """Multi-leaf histogram step: the (g,h,count)x42-leaf channel matrix is
+    built in VMEM from leaf_id + the slot->leaf map, never touching HBM."""
+    i = pl.program_id(1)
+
+    @pl.when(i == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    vecs = vecs_ref[...]                                  # [BR, 4]
+    leaf = vecs[:, 3].astype(jnp.int32)                   # [BR]
+    slot_leaf = slot_ref[0, :].astype(jnp.int32)          # [C]
+    kind = jax.lax.broadcasted_iota(jnp.int32, (1, C_MAX), 1) % 3
+    m = (leaf[:, None] == slot_leaf[None, :]) & (slot_leaf >= 0)[None, :]
+    vals = jnp.where(kind == 0, vecs[:, 0][:, None],
+                     jnp.where(kind == 1, vecs[:, 1][:, None],
+                               vecs[:, 2][:, None]))
+    gh = jnp.where(m, vals, 0.0)                          # [BR, C]
+
+    iota = jax.lax.broadcasted_iota(jnp.int32, (1, B), 1)
+    for f in range(FB):
+        col = bins_ref[f, :].astype(jnp.int32)
+        oh = (col[:, None] == iota).astype(jnp.float32)
+        out_ref[f] += jax.lax.dot_general(
+            oh, gh, (((0,), (0,)), ((), ())),
+            precision=prec, preferred_element_type=jnp.float32)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("B", "block_rows", "feat_block", "highest",
+                                    "interpret"))
+def hist_pallas_wave(bins_fm, gv, hv, cv, leaf_id, slot_leaf, B: int,
+                     block_rows: int = 512, feat_block: int = _DEF_FB,
+                     highest: bool = False, interpret: bool = False):
+    """Wave histogram: bins_fm [F, N] uint8; gv/hv/cv f32 [N] (bag-masked
+    g, h, ones); leaf_id i32 [N]; slot_leaf i32 [C_MAX] maps channel c to a
+    leaf id (channel kinds cycle g,h,count; -1 = unused).  Returns
+    [F, B, C_MAX] f32 where channels 3s..3s+2 hold leaf slot_leaf[3s]'s
+    (sum_g, sum_h, count) histograms."""
+    F, N = bins_fm.shape
+    BR = min(block_rows, max(128, N))
+    FB = min(feat_block, max(F, 1))
+    pad_rows = (-N) % BR
+    if pad_rows:
+        bins_fm = jnp.pad(bins_fm, ((0, 0), (0, pad_rows)))
+        gv = jnp.pad(gv, (0, pad_rows))
+        hv = jnp.pad(hv, (0, pad_rows))
+        cv = jnp.pad(cv, (0, pad_rows))
+        leaf_id = jnp.pad(leaf_id, (0, pad_rows), constant_values=-2)
+    pad_f = (-F) % FB
+    if pad_f:
+        bins_fm = jnp.pad(bins_fm, ((0, pad_f), (0, 0)))
+    Fp, Np = bins_fm.shape
+    prec = (jax.lax.Precision.HIGHEST if highest
+            else jax.lax.Precision.DEFAULT)
+    # pack row vectors into one [N, 4] array (g, h, count-weight, leaf_id);
+    # leaf ids are exact in f32 up to 2^24
+    vecs = jnp.stack([gv, hv, cv, leaf_id.astype(jnp.float32)], axis=1)
+    nb = Np // BR
+
+    grid = (Fp // FB, nb)
+    out = pl.pallas_call(
+        functools.partial(_hist_wave_kernel, B=B, FB=FB, prec=prec),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((FB, BR), lambda j, i: (j, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((BR, 4), lambda j, i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, C_MAX), lambda j, i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((FB, B, C_MAX), lambda j, i: (j, 0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((Fp, B, C_MAX), jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary")),
+        interpret=interpret,
+    )(bins_fm, vecs, slot_leaf.reshape(1, C_MAX))
+    return out[:F]
+
+
+def hist_pallas_fm(bins_fm, g, h, mask, B: int):
+    """Single-leaf histogram from feature-major bins: [F, B, 3] f32."""
+    N = bins_fm.shape[1]
+    gh = jnp.zeros((N, C_MAX), jnp.float32)
+    gh = gh.at[:, 0].set(g * mask)
+    gh = gh.at[:, 1].set(h * mask)
+    gh = gh.at[:, 2].set(mask)
+    out = hist_pallas_channels(bins_fm, gh, B)
+    return out[..., :3]
+
+
+def hist_pallas(bins, g, h, mask, B: int):
+    """Drop-in replacement for ``core.histogram.hist_onehot`` (row-major
+    bins input; transposes once — prefer hist_pallas_fm for resident data)."""
+    return hist_pallas_fm(bins.T, g, h, mask, B)
