@@ -203,6 +203,22 @@ class Dataset:
         return self
 
 
+def _same_bin_mappers(a: BinnedDataset, b: BinnedDataset) -> bool:
+    """True when two constructed datasets share bin mappings (reference:
+    Dataset::CheckAlign semantics for validation data)."""
+    if a.bin_mappers is b.bin_mappers:
+        return True
+    if len(a.bin_mappers) != len(b.bin_mappers):
+        return False
+    for ma, mb in zip(a.bin_mappers, b.bin_mappers):
+        if (ma.num_bin != mb.num_bin or ma.bin_type != mb.bin_type
+                or ma.missing_type != mb.missing_type
+                or not np.array_equal(ma.bin_upper_bound, mb.bin_upper_bound)
+                or ma.bin_2_categorical != mb.bin_2_categorical):
+            return False
+    return True
+
+
 class Booster:
     """Trained model handle + training driver (reference: basic.py:1666+)."""
 
@@ -252,7 +268,22 @@ class Booster:
         if not isinstance(data, Dataset):
             raise TypeError(f"Validation data should be Dataset instance, "
                             f"met {type(data).__name__}")
-        data.construct()
+        # A valid set must be binned with the TRAINING set's mappers —
+        # trees are replayed in bin space, so mismatched mappers silently
+        # corrupt validation metrics (reference fails loudly:
+        # 'Cannot add validation data, since it has different bin mappers
+        # with training data', gbdt.cpp ResetTrainingData analog).
+        if data._handle is None:
+            if self.train_set is not None:
+                data.reference = self.train_set
+            data.construct()
+        elif (self.train_set is not None and self.train_set._handle is not None
+              and not _same_bin_mappers(data._handle,
+                                        self.train_set._handle)):
+            raise LightGBMError(
+                "Cannot add validation data, since it has different bin "
+                "mappers with training data; construct it with "
+                "reference=train_set")
         self._gbdt.add_valid(data._handle, name)
         self.valid_sets.append(data)
         return self
@@ -292,7 +323,7 @@ class Booster:
                 if e[0] == self._train_data_name]
 
     def eval_valid(self, feval=None) -> List:
-        return [e for e in self._eval_all(feval)
+        return [e for e in self._eval_all(feval, include_train=False)
                 if e[0] != self._train_data_name]
 
     def eval(self, data=None, name=None, feval=None) -> List:
@@ -307,9 +338,10 @@ class Booster:
         raise LightGBMError("Can only evaluate the training set or a dataset "
                             "previously attached with add_valid")
 
-    def _eval_all(self, feval=None) -> List:
+    def _eval_all(self, feval=None, include_train: bool = True) -> List:
         out = []
-        for ds_name, mname, value, hib in self._gbdt.eval_results():
+        for ds_name, mname, value, hib in self._gbdt.eval_results(
+                include_train=include_train):
             if ds_name == "training":
                 ds_name = self._train_data_name
             out.append((ds_name, mname, value, hib))
@@ -321,8 +353,9 @@ class Booster:
                 entries = res if isinstance(res, list) else [res]
                 for (n, v, hb) in entries:
                     out.append((tag, n, v, hb))
-            run_feval(self._raw_train_score(), self.train_set,
-                      self._train_data_name)
+            if include_train:
+                run_feval(self._raw_train_score(), self.train_set,
+                          self._train_data_name)
             for i, vds in enumerate(self.valid_sets):
                 s = np.asarray(self._gbdt._valid_scores[i], dtype=np.float64)
                 s = s[:, 0] if self._gbdt.num_tpi == 1 else s
@@ -341,7 +374,8 @@ class Booster:
             return self._gbdt.predict_leaf(mat, num_iteration, start_iteration)
         if pred_contrib:
             from .core.shap import predict_contrib
-            return predict_contrib(self._gbdt, mat, num_iteration)
+            return predict_contrib(self._gbdt, mat, num_iteration,
+                                   start_iteration)
         return self._gbdt.predict(mat, num_iteration, raw_score,
                                   start_iteration)
 

@@ -27,11 +27,43 @@ from ..utils import log
 K_EPSILON = 1e-15
 
 
+class _DeferredTree:
+    """A trained tree still living on device as ``TreeArrays``.
+
+    Per-iteration device->host materialization costs several transfer
+    round-trips; deferring it keeps the training loop device-resident
+    (host Trees are only needed for prediction/serialization/DART).
+    """
+    __slots__ = ("arrs", "init_offset", "shrinkage")
+
+    def __init__(self, arrs, init_offset: float, shrinkage: float):
+        self.arrs = arrs
+        self.init_offset = init_offset
+        self.shrinkage = shrinkage
+
+
+class _TreeList(list):
+    """List of trees that materializes deferred device trees on read."""
+
+    def __init__(self, owner):
+        super().__init__()
+        self._owner = owner
+
+    def __getitem__(self, i):
+        self._owner._materialize_trees()
+        return super().__getitem__(i)
+
+    def __iter__(self):
+        self._owner._materialize_trees()
+        return super().__iter__()
+
+
 class GBDT:
     """Gradient Boosting Decision Tree trainer."""
 
     def __init__(self):
-        self.models: List[Tree] = []
+        self.models: List[Tree] = _TreeList(self)
+        self._has_deferred = False
         self.iter_ = 0
         self.config: Optional[Config] = None
         self.objective = None
@@ -65,8 +97,8 @@ class GBDT:
 
         self.meta, self.B = build_device_meta(train_ds, config)
         self.split_cfg = SplitConfig.from_config(config)
-        self._grow = make_grower(self.meta, self.split_cfg, self.B)
         self._bins = jnp.asarray(train_ds.X_bin)
+        self._init_grower(config, train_ds)
         N = train_ds.num_data
         K = self.num_tpi
         self._train_score = jnp.zeros((N, K), jnp.float32)
@@ -83,7 +115,48 @@ class GBDT:
             for k in range(K)]
         self._jit_helpers()
 
+    def _init_grower(self, config: Config, train_ds) -> None:
+        """Select the tree-growth engine — the TreeLearner factory analog
+        (reference: src/treelearner/tree_learner.cpp:13-36).
+
+        On TPU the wave-scheduled Pallas path (core/wave_grower.py) replaces
+        the reference's GPU histogram offload (gpu_tree_learner.cpp); the
+        XLA one-hot serial grower is the CPU/debug fallback.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        wave_ok = (config.device_type in ("tpu", "gpu")
+                   and jax.default_backend() == "tpu"
+                   and train_ds.X_bin.dtype == np.uint8
+                   and self.B <= 256
+                   and train_ds.num_features > 0)
+        self.uses_wave = bool(wave_ok)
+        if self.uses_wave:
+            from ..core.wave_grower import build_wave_grow_fn
+            self._grow_raw = build_wave_grow_fn(
+                self.meta, self.split_cfg, self.B,
+                wave_capacity=int(config.tpu_wave_capacity),
+                highest=bool(config.gpu_use_dp),
+                gain_gate=float(config.tpu_wave_gain_gate))
+            # feature-major resident copy for the Pallas kernel layout
+            self._grow_bins = jnp.asarray(
+                np.ascontiguousarray(train_ds.X_bin.T))
+        else:
+            from ..core.grower import build_grow_fn
+            self._grow_raw = build_grow_fn(self.meta, self.split_cfg, self.B)
+            self._grow_bins = self._bins
+        self._grow = jax.jit(self._grow_raw)
+
     def _jit_helpers(self) -> None:
+        """Fuse the whole boosting iteration into a handful of jitted
+        calls — remote-dispatch (and any per-op) overhead makes eager ops
+        in the training loop prohibitively slow, so the loop is
+        device-resident: gradients, growth, shrinkage and score updates
+        never leave the device (reference keeps the same data device-side
+        in gpu_tree_learner.cpp's pinned-buffer pipeline)."""
+        import functools
+
         import jax
         import jax.numpy as jnp
 
@@ -98,6 +171,64 @@ class GBDT:
 
         self._apply_leaf = apply_leaf
         self._traverse_add = traverse_add
+
+        objective = self.objective
+        K = self.num_tpi
+
+        if objective is not None:
+            @jax.jit
+            def grad_fn(score):
+                s = score[:, 0] if K == 1 else score
+                g, h = objective.get_gradients(s)
+                if g.ndim == 1:
+                    g, h = g[:, None], h[:, None]
+                return g, h
+            self._grad_fn = grad_fn
+        else:
+            self._grad_fn = None
+
+        grow_raw = self._grow_raw
+
+        @functools.partial(jax.jit, static_argnames=("k",))
+        def grow_apply(bins, g, h, bag_mask, feature_mask, score, lr, k):
+            """grow + shrink + train-score update for class k, one call."""
+            arrs, leaf_id = grow_raw(bins, g[:, k], h[:, k], bag_mask,
+                                     feature_mask)
+            lv = arrs.leaf_value * lr
+            arrs = arrs._replace(
+                leaf_value=lv, internal_value=arrs.internal_value * lr)
+            new_score = score.at[:, k].add(lv[leaf_id])
+            return arrs, leaf_id, new_score
+
+        self._grow_apply = grow_apply
+
+        @functools.partial(jax.jit, static_argnames=("k",))
+        def valid_apply(vscore, arrs, vbins, k):
+            leaf = predict_leaf_bins(arrs, vbins, self.meta)
+            return vscore.at[:, k].add(arrs.leaf_value[leaf])
+
+        self._valid_apply = valid_apply
+
+    # ------------------------------------------------------------------
+    def _materialize_trees(self) -> None:
+        """Convert any device-deferred trees to host ``Tree`` objects in a
+        single batched device->host transfer."""
+        if not self._has_deferred:
+            return
+        import jax
+        raw = list.__iter__(self.models)
+        idxs = [i for i, t in enumerate(raw) if isinstance(t, _DeferredTree)]
+        if idxs:
+            host = jax.device_get([list.__getitem__(self.models, i).arrs
+                                   for i in idxs])
+            for i, arrs in zip(idxs, host):
+                d = list.__getitem__(self.models, i)
+                tree = Tree.from_device(arrs, self.train_ds,
+                                        shrinkage=d.shrinkage)
+                if abs(d.init_offset) > K_EPSILON:
+                    tree.leaf_value = tree.leaf_value + d.init_offset
+                list.__setitem__(self.models, i, tree)
+        self._has_deferred = False
 
     # ------------------------------------------------------------------
     def add_valid(self, valid_ds, name: str) -> None:
@@ -207,7 +338,9 @@ class GBDT:
         F = self.train_ds.num_features
         frac = float(self.config.feature_fraction)
         if frac >= 1.0:
-            return jnp.ones((F,), bool)
+            if getattr(self, "_ones_fmask", None) is None:
+                self._ones_fmask = jnp.ones((F,), bool)
+            return self._ones_fmask
         cnt = max(1, int(round(frac * F)))
         idx = self._feat_rng.permutation(F)[:cnt]
         mask = np.zeros(F, dtype=bool)
@@ -226,46 +359,55 @@ class GBDT:
         if gradients is None or hessians is None:
             for k in range(K):
                 init_scores[k] = self._boost_from_average(k)
-            score = (self._train_score[:, 0] if K == 1 else self._train_score)
-            g, h = self.objective.get_gradients(score)
+            g, h = self._grad_fn(self._train_score)
         else:
             g = jnp.asarray(np.asarray(gradients, dtype=np.float32).reshape(K, N).T)
             h = jnp.asarray(np.asarray(hessians, dtype=np.float32).reshape(K, N).T)
-        if g.ndim == 1:
-            g = g[:, None]
-            h = h[:, None]
+            if g.ndim == 1:
+                g = g[:, None]
+                h = h[:, None]
 
         g, h = self._bagging(self.iter_, g, h)
         feature_mask = self._feature_mask()
+        needs_renew = (self.objective is not None
+                       and self.objective.is_renew_tree_output)
 
         should_continue = False
         for k in range(K):
             tree = None
             if self.class_need_train[k] and self.train_ds.num_features > 0:
-                arrs, leaf_id = self._grow(self._bins, g[:, k], h[:, k],
-                                           self._bag_mask, feature_mask)
+                if needs_renew:
+                    # slow path: the leaf refit needs host residuals between
+                    # growth and shrinkage (reference:
+                    # serial_tree_learner.cpp:855-893)
+                    arrs, leaf_id = self._grow(self._grow_bins, g[:, k],
+                                               h[:, k], self._bag_mask,
+                                               feature_mask)
+                else:
+                    arrs, leaf_id, new_score = self._grow_apply(
+                        self._grow_bins, g, h, self._bag_mask, feature_mask,
+                        self._train_score, jnp.float32(self.shrinkage_rate),
+                        k)
                 nl = int(arrs.num_leaves)
             else:
                 arrs, leaf_id, nl = None, None, 1
 
             if nl > 1:
                 should_continue = True
-                arrs = self._renew_tree_output(arrs, leaf_id, k)
-                # shrinkage + score updates in device space
-                lv = arrs.leaf_value * self.shrinkage_rate
-                arrs = arrs._replace(
-                    leaf_value=lv,
-                    internal_value=arrs.internal_value * self.shrinkage_rate)
-                self._train_score = self._train_score.at[:, k].set(
-                    self._apply_leaf(self._train_score[:, k], leaf_id, lv))
+                if needs_renew:
+                    arrs = self._renew_tree_output(arrs, leaf_id, k)
+                    lv = arrs.leaf_value * self.shrinkage_rate
+                    arrs = arrs._replace(
+                        leaf_value=lv,
+                        internal_value=arrs.internal_value * self.shrinkage_rate)
+                    new_score = self._train_score.at[:, k].set(
+                        self._apply_leaf(self._train_score[:, k], leaf_id, lv))
+                self._train_score = new_score
                 for i in range(len(self._valid_scores)):
-                    self._valid_scores[i] = self._valid_scores[i].at[:, k].set(
-                        self._traverse_add(self._valid_scores[i][:, k], arrs,
-                                           self._valid_bins[i]))
-                tree = Tree.from_device(arrs, self.train_ds,
-                                        shrinkage=self.shrinkage_rate)
-                if abs(init_scores[k]) > K_EPSILON:
-                    tree.leaf_value = tree.leaf_value + init_scores[k]
+                    self._valid_scores[i] = self._valid_apply(
+                        self._valid_scores[i], arrs, self._valid_bins[i], k)
+                tree = _DeferredTree(arrs, init_scores[k], self.shrinkage_rate)
+                self._has_deferred = True
             else:
                 # constant tree, only for the first iteration
                 # (reference: gbdt.cpp:418-436)
@@ -330,14 +472,15 @@ class GBDT:
         self.iter_ -= 1
 
     # ------------------------------------------------------------------
-    def eval_results(self) -> List[Tuple]:
+    def eval_results(self, include_train: bool = True) -> List[Tuple]:
         """All (data_name, metric_name, value, higher_better) entries
         (reference: GBDT::OutputMetric, gbdt.cpp:513-571)."""
         out = []
-        score = self._score_for_metrics(self._train_score)
-        for m in self.metrics:
-            for name, value, hib in m.eval(score, self.objective):
-                out.append(("training", name, value, hib))
+        if include_train and self.metrics:
+            score = self._score_for_metrics(self._train_score)
+            for m in self.metrics:
+                for name, value, hib in m.eval(score, self.objective):
+                    out.append(("training", name, value, hib))
         for i, name in enumerate(self.valid_names):
             vscore = self._score_for_metrics(self._valid_scores[i])
             for m in self.valid_metrics[i]:

@@ -58,7 +58,7 @@ class RF(GBDT):
         K = self.num_tpi
         for k in range(K):
             if self.class_need_train[k] and self.train_ds.num_features > 0:
-                arrs, leaf_id = self._grow(self._bins, g[:, k], h[:, k],
+                arrs, leaf_id = self._grow(self._grow_bins, g[:, k], h[:, k],
                                            self._bag_mask, feature_mask)
                 nl = int(arrs.num_leaves)
             else:

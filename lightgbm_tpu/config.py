@@ -286,6 +286,13 @@ class Config:
     gpu_use_dp: bool = False
     tpu_hist_dtype: str = "float32"     # accumulator dtype for histograms
     tpu_block_rows: int = 1024          # Pallas histogram kernel row-block
+    tpu_wave_capacity: int = 42         # leaves histogrammed per wave pass
+                                        # (<= 42: 3 channels each in the
+                                        # 128-lane Pallas kernel)
+    tpu_wave_gain_gate: float = 0.5     # split-phase throttle: only commit
+                                        # leaves with gain >= gate * best
+                                        # ready gain (1 = strict best-first
+                                        # order, 0 = max wave throughput)
     tpu_donate_buffers: bool = True
     tpu_mesh_shape: str = ""            # e.g. "data:8" or "data:4,feature:2"
 

@@ -123,7 +123,8 @@ def _tree_shap(tree: Tree, x: np.ndarray, phi: np.ndarray, node: int,
                0.0, fi)
 
 
-def predict_contrib(gbdt, X: np.ndarray, num_iteration=None) -> np.ndarray:
+def predict_contrib(gbdt, X: np.ndarray, num_iteration=None,
+                    start_iteration: int = 0) -> np.ndarray:
     """Per-row SHAP contributions (reference: GBDT::PredictContrib,
     gbdt_prediction.cpp + c_api predict_contrib path)."""
     X = np.ascontiguousarray(X, dtype=np.float64)
@@ -131,9 +132,9 @@ def predict_contrib(gbdt, X: np.ndarray, num_iteration=None) -> np.ndarray:
     K = gbdt.num_tpi
     n_iters = len(gbdt.models) // K
     stop = n_iters if num_iteration is None or num_iteration <= 0 \
-        else min(num_iteration, n_iters)
+        else min(start_iteration + num_iteration, n_iters)
     out = np.zeros((n, K, f + 1))
-    for it in range(stop):
+    for it in range(start_iteration, stop):
         for k in range(K):
             tree = gbdt.models[it * K + k]
             for r in range(n):

@@ -64,9 +64,22 @@ class _WaveState(NamedTuple):
 
 
 def build_wave_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
-                       wave_capacity: int = 42, highest: bool = False):
+                       wave_capacity: int = 42, highest: bool = False,
+                       interpret: bool = False, gain_gate: float = 0.0):
     """Unjitted ``grow(bins_fm, g, h, sample_mask, feature_mask)`` using the
-    Pallas wave kernel. Returns (TreeArrays, leaf_id)."""
+    Pallas wave kernel. Returns (TreeArrays, leaf_id).
+
+    ``interpret`` runs the Pallas kernel in interpreter mode so the wave
+    path is testable on CPU (the analog of the reference's
+    GPU_DEBUG_COMPARE harness, gpu_tree_learner.cpp:1011-1043).
+
+    ``gain_gate`` throttles the deviation from strict best-first order: a
+    split phase only commits leaves whose gain is at least ``gain_gate``
+    times the phase's best ready gain, so low-gain leaves never displace
+    higher-gain children still waiting for their wave.  0 disables the
+    gate (split everything positive, max throughput); 1 is strict
+    best-of-phase only.
+    """
     L = cfg.num_leaves
     P = max(1, min(wave_capacity, C_MAX // 3))
 
@@ -77,10 +90,11 @@ def build_wave_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
         return bs._replace(gain=jnp.where(depth_ok, bs.gain, NEG_INF))
 
     # ---------------- split phase --------------------------------------
-    def _split_once(st: _WaveState, bins_fm, feature_mask):
+    def _split_once(st: _WaveState, bins_fm, feature_mask, phase_max):
         gains = jnp.where(st.hist_ready[:L], st.best_gain[:L], NEG_INF)
         leaf = jnp.argmax(gains).astype(jnp.int32)
         ok = ((gains[leaf] > 0.0)
+              & (gains[leaf] >= gain_gate * phase_max)
               & (st.tree.num_leaves < L)
               & (st.pend_cnt < P))
 
@@ -165,7 +179,8 @@ def build_wave_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
             slot_leaf = jnp.where(c_idx < P, st.pend_small[jnp.minimum(c_idx, P - 1)],
                                   -1).astype(jnp.int32)
             hw = hist_pallas_wave(bins_fm, gv, hv, cv, st.leaf_id, slot_leaf,
-                                  B=B, highest=highest)  # [F, B, C]
+                                  B=B, highest=highest,
+                                  interpret=interpret)  # [F, B, C]
             Fdim = hw.shape[0]
             ws = hw[:, :, :3 * P].reshape(Fdim, B, P, 3).transpose(2, 0, 1, 3)
 
@@ -245,16 +260,27 @@ def build_wave_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
             pend_cnt=jnp.int32(1),
             tree=_empty_tree(L),
         )
-        # root wave computes leaf 0's histogram + best split
-        st = _wave(st, bins_fm, gv, hv, cv, feature_mask)
+        # Alternate split and wave phases until no ready leaf has positive
+        # gain and nothing is pending.  The first body iteration has no
+        # ready leaves, so it falls straight through to the root wave.
+        # A while_loop (not fori) so a finished tree stops paying for
+        # kernel passes — each iteration either splits a leaf or is the
+        # root wave, so it runs at most L times.
+        def loop_cond(st):
+            ready = jnp.where(st.hist_ready[:L], st.best_gain[:L], NEG_INF)
+            can_split = (jnp.max(ready) > 0.0) & (st.tree.num_leaves < L)
+            return (st.pend_cnt > 0) | can_split
 
-        def body(_, st):
+        def loop_body(st):
+            ready = jnp.where(st.hist_ready[:L], st.best_gain[:L], NEG_INF)
+            phase_max = jnp.max(ready)
+
             def split_body(_, st):
-                return _split_once(st, bins_fm, feature_mask)
+                return _split_once(st, bins_fm, feature_mask, phase_max)
             st = jax.lax.fori_loop(0, P, split_body, st)
             return _wave(st, bins_fm, gv, hv, cv, feature_mask)
 
-        st = jax.lax.fori_loop(0, L - 1, body, st)
+        st = jax.lax.while_loop(loop_cond, loop_body, st)
 
         tr = st.tree._replace(
             leaf_value=st.leaf_out[:L],
@@ -267,5 +293,7 @@ def build_wave_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
 
 
 def make_wave_grower(meta: DeviceMeta, cfg: SplitConfig, B: int,
-                     wave_capacity: int = 42, highest: bool = False):
-    return jax.jit(build_wave_grow_fn(meta, cfg, B, wave_capacity, highest))
+                     wave_capacity: int = 42, highest: bool = False,
+                     interpret: bool = False, gain_gate: float = 0.0):
+    return jax.jit(build_wave_grow_fn(meta, cfg, B, wave_capacity, highest,
+                                      interpret, gain_gate))

@@ -60,6 +60,11 @@ def train(params: Dict[str, Any], train_set: Dataset,
                 is_valid_contain_train = True
                 train_data_name = name
                 continue
+            # valid sets must share the train set's bin mappers (reference:
+            # engine.py:193 valid_data.set_reference(train_set)); add_valid
+            # raises if vs was already constructed with different mappers
+            if vs._handle is None:
+                vs.reference = train_set
             booster.add_valid(vs, name)
     booster._train_data_name = train_data_name
 
@@ -90,8 +95,13 @@ def train(params: Dict[str, Any], train_set: Dataset,
         if booster.update(fobj=fobj):
             break  # can't split anymore
         evaluation_result_list = []
-        if valid_sets is not None or booster._gbdt.metrics:
-            entries = booster._eval_all(feval)
+        # evaluate only when something consumes the result: attached valid
+        # sets, or the train set explicitly requested via valid_sets
+        # (the reference likewise skips evaluation without valid_sets —
+        # a per-iteration metric pass costs an O(N) device sync)
+        if booster.valid_sets or is_valid_contain_train:
+            entries = booster._eval_all(feval,
+                                        include_train=is_valid_contain_train)
             if is_valid_contain_train:
                 evaluation_result_list.extend(
                     e for e in entries if e[0] == train_data_name)

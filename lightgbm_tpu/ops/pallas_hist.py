@@ -130,7 +130,7 @@ def _hist_wave_kernel(bins_ref, vecs_ref, slot_ref, out_ref, *, B: int,
                    static_argnames=("B", "block_rows", "feat_block", "highest",
                                     "interpret"))
 def hist_pallas_wave(bins_fm, gv, hv, cv, leaf_id, slot_leaf, B: int,
-                     block_rows: int = 512, feat_block: int = _DEF_FB,
+                     block_rows: int = 1024, feat_block: int = _DEF_FB,
                      highest: bool = False, interpret: bool = False):
     """Wave histogram: bins_fm [F, N] uint8; gv/hv/cv f32 [N] (bag-masked
     g, h, ones); leaf_id i32 [N]; slot_leaf i32 [C_MAX] maps channel c to a

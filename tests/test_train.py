@@ -146,3 +146,50 @@ def test_eval_weighted_auc_matches_sklearn():
     pred = bst.predict(X)
     skl = roc_auc_score(y, pred, sample_weight=w)
     np.testing.assert_allclose(res["training"]["auc"][-1], skl, rtol=1e-6)
+
+
+def test_valid_set_uses_train_bin_mappers():
+    """A valid Dataset without an explicit reference must be re-binned with
+    the train set's mappers — otherwise bin-space tree replay silently
+    corrupts validation metrics (round-2 advisor finding)."""
+    X, y = _synth(600, seed=3)
+    Xv, yv = _synth(300, seed=4)
+    ds = lgb.Dataset(X, label=y, params=PARAMS)
+    # NOTE: deliberately no reference=
+    vs = lgb.Dataset(Xv, label=yv, params=PARAMS)
+    evals = {}
+    bst = lgb.train(PARAMS, ds, num_boost_round=15, valid_sets=[vs],
+                    valid_names=["v"], evals_result=evals, verbose_eval=False)
+    reported = evals["v"]["binary_logloss"][-1]
+    p = np.clip(bst.predict(Xv), 1e-15, 1 - 1e-15)
+    direct = float(-np.mean(yv * np.log(p) + (1 - yv) * np.log(1 - p)))
+    assert abs(reported - direct) < 1e-5, (reported, direct)
+
+
+def test_add_valid_mismatched_mappers_raises():
+    """Pre-constructed valid data with foreign bin mappers must fail loudly
+    (reference: 'Cannot add validation data, since it has different bin
+    mappers with training data')."""
+    X, y = _synth(600, seed=5)
+    Xv, yv = _synth(300, seed=6)
+    ds = lgb.Dataset(X, label=y, params=PARAMS)
+    vs = lgb.Dataset(Xv, label=yv, params=PARAMS)
+    vs.construct()  # binned with its own mappers
+    bst = lgb.Booster(params=PARAMS, train_set=ds)
+    with pytest.raises(lgb.LightGBMError):
+        bst.add_valid(vs, "v")
+
+
+def test_pred_contrib_start_iteration():
+    """SHAP contributions must honor the (start_iteration, num_iteration)
+    window like the raw prediction path (round-2 advisor finding)."""
+    X, y = _synth(400, seed=7)
+    ds = lgb.Dataset(X, label=y, params=PARAMS)
+    bst = lgb.train(PARAMS, ds, num_boost_round=8, verbose_eval=False)
+    sub = X[:20]
+    contrib = bst.predict(sub, pred_contrib=True, start_iteration=4,
+                          num_iteration=4)
+    raw = bst.predict(sub, raw_score=True, start_iteration=4, num_iteration=4)
+    np.testing.assert_allclose(contrib.sum(axis=1), raw, rtol=1e-5, atol=1e-6)
+    full = bst.predict(sub, pred_contrib=True)
+    assert not np.allclose(contrib, full)
