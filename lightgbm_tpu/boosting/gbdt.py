@@ -134,11 +134,17 @@ class GBDT:
         self.uses_wave = bool(wave_ok)
         if self.uses_wave:
             from ..core.wave_grower import build_wave_grow_fn
+            # histograms accumulate at f32 input precision unless the user
+            # explicitly opts into bf16 MXU inputs (the reference keeps
+            # float histograms even in single-precision GPU mode,
+            # gpu_tree_learner.h:80-84)
+            highest = config.tpu_hist_dtype != "bfloat16" or config.gpu_use_dp
             self._grow_raw = build_wave_grow_fn(
                 self.meta, self.split_cfg, self.B,
                 wave_capacity=int(config.tpu_wave_capacity),
-                highest=bool(config.gpu_use_dp),
-                gain_gate=float(config.tpu_wave_gain_gate))
+                highest=bool(highest),
+                gain_gate=float(config.tpu_wave_gain_gate),
+                block_rows=int(config.tpu_block_rows))
             # feature-major resident copy for the Pallas kernel layout
             self._grow_bins = jnp.asarray(
                 np.ascontiguousarray(train_ds.X_bin.T))

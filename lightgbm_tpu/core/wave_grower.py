@@ -64,8 +64,9 @@ class _WaveState(NamedTuple):
 
 
 def build_wave_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
-                       wave_capacity: int = 42, highest: bool = False,
-                       interpret: bool = False, gain_gate: float = 0.0):
+                       wave_capacity: int = 42, highest: bool = True,
+                       interpret: bool = False, gain_gate: float = 0.0,
+                       block_rows: int = 1024):
     """Unjitted ``grow(bins_fm, g, h, sample_mask, feature_mask)`` using the
     Pallas wave kernel. Returns (TreeArrays, leaf_id).
 
@@ -79,9 +80,18 @@ def build_wave_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
     higher-gain children still waiting for their wave.  0 disables the
     gate (split everything positive, max throughput); 1 is strict
     best-of-phase only.
+
+    ``highest`` keeps the histogram matmul accumulation at f32 input
+    precision (the reference accumulates float even in single-precision
+    GPU mode, gpu_tree_learner.h:80-84); False allows bf16 MXU inputs —
+    faster but g/h rounded to ~8 mantissa bits, which can flip near-tied
+    split gains.
     """
     L = cfg.num_leaves
     P = max(1, min(wave_capacity, C_MAX // 3))
+    # gain_gate > 1 would make _split_once never commit while loop_cond
+    # stays true — an infinite while_loop on device
+    gain_gate = min(max(float(gain_gate), 0.0), 1.0)
 
     def _scan_leaf(hist_leaf, sg, sh, sc, min_c, max_c, depth, feature_mask):
         bs = best_split(hist_leaf, sg, sh, sc, meta, cfg, min_c, max_c,
@@ -179,7 +189,7 @@ def build_wave_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
             slot_leaf = jnp.where(c_idx < P, st.pend_small[jnp.minimum(c_idx, P - 1)],
                                   -1).astype(jnp.int32)
             hw = hist_pallas_wave(bins_fm, gv, hv, cv, st.leaf_id, slot_leaf,
-                                  B=B, highest=highest,
+                                  B=B, block_rows=block_rows, highest=highest,
                                   interpret=interpret)  # [F, B, C]
             Fdim = hw.shape[0]
             ws = hw[:, :, :3 * P].reshape(Fdim, B, P, 3).transpose(2, 0, 1, 3)
@@ -293,7 +303,8 @@ def build_wave_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
 
 
 def make_wave_grower(meta: DeviceMeta, cfg: SplitConfig, B: int,
-                     wave_capacity: int = 42, highest: bool = False,
-                     interpret: bool = False, gain_gate: float = 0.0):
+                     wave_capacity: int = 42, highest: bool = True,
+                     interpret: bool = False, gain_gate: float = 0.0,
+                     block_rows: int = 1024):
     return jax.jit(build_wave_grow_fn(meta, cfg, B, wave_capacity, highest,
-                                      interpret, gain_gate))
+                                      interpret, gain_gate, block_rows))

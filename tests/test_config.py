@@ -61,6 +61,10 @@ def test_validation_errors():
         Config.from_params({"objective": "multiclass"})  # num_class missing
     with pytest.raises(LightGBMError):
         Config.from_params({"tree_learner": "bogus"})
+    with pytest.raises(LightGBMError):
+        Config.from_params({"tpu_wave_gain_gate": 1.5})
+    with pytest.raises(LightGBMError):
+        Config.from_params({"tpu_hist_dtype": "float16"})
 
 
 def test_parallel_derivation():
