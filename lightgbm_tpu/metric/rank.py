@@ -33,9 +33,10 @@ class NDCGMetric(_RankMetric):
 
     def __init__(self, config):
         super().__init__(config)
+        from ..objective.rank import default_label_gain
         gains = config.label_gain or []
-        self.label_gain = np.asarray(
-            gains if gains else [(1 << i) - 1 for i in range(31)], dtype=np.float64)
+        self.label_gain = (np.asarray(gains, dtype=np.float64) if gains
+                           else default_label_gain())
 
     def _dcg_at_k(self, ks, labels, order):
         """DCG at each k for one query given ranking order."""
