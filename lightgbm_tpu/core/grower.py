@@ -55,6 +55,9 @@ class TreeArrays(NamedTuple):
     leaf_count: jnp.ndarray      # i32 [L]
     leaf_weight: jnp.ndarray     # f32 [L] sum_hessian
     num_leaves: jnp.ndarray      # i32 scalar
+    # bin-space category set per node (left = bins in set; all-zero rows
+    # for numerical nodes; reference: tree.h:83-99 threshold_in_bin form)
+    cat_bitset: jnp.ndarray      # u32 [L-1, W]
 
 
 class _GrowState(NamedTuple):
@@ -74,12 +77,15 @@ class _GrowState(NamedTuple):
     best_lg: jnp.ndarray      # f32 [L]
     best_lh: jnp.ndarray      # f32 [L]
     best_lc: jnp.ndarray      # f32 [L]
+    best_lout: jnp.ndarray    # f32 [L] winning split's left child output
+    best_rout: jnp.ndarray    # f32 [L]
+    best_cb: jnp.ndarray      # u32 [L, W] winning categorical bin set
     leaf_parent: jnp.ndarray  # i32 [L] node whose child slot is this leaf
     leaf_is_right: jnp.ndarray  # bool [L]
     tree: TreeArrays
 
 
-def _empty_tree(L: int) -> TreeArrays:
+def _empty_tree(L: int, W: int = 1) -> TreeArrays:
     n = max(L - 1, 1)
     return TreeArrays(
         split_feature=jnp.full((n,), -1, jnp.int32),
@@ -95,6 +101,7 @@ def _empty_tree(L: int) -> TreeArrays:
         leaf_count=jnp.zeros((L,), jnp.int32),
         leaf_weight=jnp.zeros((L,), jnp.float32),
         num_leaves=jnp.int32(1),
+        cat_bitset=jnp.zeros((n, W), jnp.uint32),
     )
 
 
@@ -104,6 +111,18 @@ def go_left_bins(col, threshold, default_left, missing_type, num_bin, default_bi
     is_missing = (((missing_type == MISSING_NAN) & (col == num_bin - 1))
                   | ((missing_type == MISSING_ZERO) & (col == default_bin)))
     return jnp.where(is_missing, default_left, col <= threshold)
+
+
+def go_left_node(col, threshold, default_left, is_cat, cat_words,
+                 missing_type, num_bin, default_bin):
+    """Numerical-or-categorical bin-space decision for one node over all
+    rows (reference: Tree::Decision / CategoricalDecisionInner,
+    tree.h:221-303).  ``cat_words`` u32 [W]."""
+    from .splitter import bitset_contains
+    num_go = go_left_bins(col, threshold, default_left, missing_type,
+                          num_bin, default_bin)
+    cat_go = bitset_contains(cat_words[None, :], col)
+    return jnp.where(is_cat, cat_go, num_go)
 
 
 def build_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
@@ -152,14 +171,14 @@ def build_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
         f = st.best_feat[leaf]
         t = st.best_thr[leaf]
         dl = st.best_dl[leaf]
+        cb = st.best_cb[leaf]
 
         # ---- child stats ------------------------------------------------
         lg, lh, lc = st.best_lg[leaf], st.best_lh[leaf], st.best_lc[leaf]
         pg, ph, pc = st.leaf_g[leaf], st.leaf_h[leaf], st.leaf_c[leaf]
         rg, rh, rc = pg - lg, ph - lh, pc - lc
         min_c, max_c = st.leaf_min_c[leaf], st.leaf_max_c[leaf]
-        out_l = jnp.clip(leaf_output(lg, lh, cfg), min_c, max_c)
-        out_r = jnp.clip(leaf_output(rg, rh, cfg), min_c, max_c)
+        out_l, out_r = st.best_lout[leaf], st.best_rout[leaf]
 
         # ---- monotone constraint propagation ----------------------------
         mono = meta.monotone[f]
@@ -189,12 +208,14 @@ def build_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
             left_child=tr.left_child.at[pn].set(new_lc_ptr).at[k].set(~leaf),
             right_child=tr.right_child.at[pn].set(new_rc_ptr).at[k].set(~new),
             num_leaves=tr.num_leaves + 1,
+            cat_bitset=tr.cat_bitset.at[k].set(cb),
         )
 
         # ---- partition rows ---------------------------------------------
         col = jnp.take(bins, f, axis=1).astype(jnp.int32)
-        go_left = go_left_bins(col, t, dl, meta.missing_types[f],
-                               meta.num_bins[f], meta.default_bins[f])
+        go_left = go_left_node(col, t, dl, meta.is_categorical[f], cb,
+                               meta.missing_types[f], meta.num_bins[f],
+                               meta.default_bins[f])
         in_leaf = st.leaf_id == leaf
         leaf_id = jnp.where(in_leaf & ~go_left, new, st.leaf_id)
 
@@ -238,13 +259,18 @@ def build_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
             best_lg=upd(upd(st.best_lg, leaf, bs_l.left_g), new, bs_r.left_g),
             best_lh=upd(upd(st.best_lh, leaf, bs_l.left_h), new, bs_r.left_h),
             best_lc=upd(upd(st.best_lc, leaf, bs_l.left_c), new, bs_r.left_c),
+            best_lout=upd(upd(st.best_lout, leaf, bs_l.left_out), new, bs_r.left_out),
+            best_rout=upd(upd(st.best_rout, leaf, bs_l.right_out), new, bs_r.right_out),
+            best_cb=upd(upd(st.best_cb, leaf, bs_l.cat_bitset), new, bs_r.cat_bitset),
             leaf_parent=upd(upd(st.leaf_parent, leaf, k), new, k),
             leaf_is_right=upd(upd(st.leaf_is_right, leaf, False), new, True),
             tree=tr,
         )
 
     def grow(bins, g, h, sample_mask, feature_mask):
+        from .splitter import bitset_words
         N, F = bins.shape
+        W = bitset_words(B)
         sum_g = reduce_fn(jnp.sum(g * sample_mask))
         sum_h = reduce_fn(jnp.sum(h * sample_mask))
         cnt = reduce_fn(jnp.sum(sample_mask))
@@ -274,9 +300,12 @@ def build_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
             best_lg=Lf.at[0].set(bs0.left_g),
             best_lh=Lf.at[0].set(bs0.left_h),
             best_lc=Lf.at[0].set(bs0.left_c),
+            best_lout=Lf.at[0].set(bs0.left_out),
+            best_rout=Lf.at[0].set(bs0.right_out),
+            best_cb=jnp.zeros((L, W), jnp.uint32).at[0].set(bs0.cat_bitset),
             leaf_parent=jnp.full((L,), -1, jnp.int32),
             leaf_is_right=jnp.zeros((L,), bool),
-            tree=_empty_tree(L),
+            tree=_empty_tree(L, W),
         )
 
         def body(k, st):

@@ -24,6 +24,9 @@ from __future__ import annotations
 
 from typing import NamedTuple
 
+import numpy as np
+
+import jax
 import jax.numpy as jnp
 
 from ..io.binning import MISSING_NAN, MISSING_NONE, MISSING_ZERO
@@ -31,6 +34,11 @@ from .meta import DeviceMeta, SplitConfig
 
 K_EPSILON = 1e-15
 NEG_INF = -jnp.inf
+
+
+def bitset_words(B: int) -> int:
+    """uint32 words needed for a bin-space bitset."""
+    return max(1, (B + 31) // 32)
 
 
 def threshold_l1(s, l1):
@@ -41,13 +49,20 @@ def threshold_l1(s, l1):
     return jnp.sign(s) * jnp.maximum(jnp.abs(s) - l1, 0.0)
 
 
-def leaf_output(g, h, cfg: SplitConfig):
-    """Regularized leaf output (reference: CalculateSplittedLeafOutput,
+def leaf_output_l2(g, h, cfg: SplitConfig, l2):
+    """Regularized leaf output with an explicit L2 (categorical splits add
+    cat_l2; reference: CalculateSplittedLeafOutput,
     feature_histogram.hpp:450-457)."""
-    ret = -threshold_l1(g, cfg.lambda_l1) / (h + cfg.lambda_l2)
+    ret = -threshold_l1(g, cfg.lambda_l1) / (h + l2)
     if cfg.max_delta_step > 0.0:
         ret = jnp.clip(ret, -cfg.max_delta_step, cfg.max_delta_step)
     return ret
+
+
+def leaf_output(g, h, cfg: SplitConfig):
+    """Regularized leaf output (reference: CalculateSplittedLeafOutput,
+    feature_histogram.hpp:450-457)."""
+    return leaf_output_l2(g, h, cfg, cfg.lambda_l2)
 
 
 def leaf_output_constrained(g, h, cfg: SplitConfig, min_c, max_c):
@@ -56,10 +71,12 @@ def leaf_output_constrained(g, h, cfg: SplitConfig, min_c, max_c):
     return jnp.clip(leaf_output(g, h, cfg), min_c, max_c)
 
 
-def leaf_gain_given_output(g, h, out, cfg: SplitConfig):
+def leaf_gain_given_output(g, h, out, cfg: SplitConfig, l2=None):
     """(reference: GetLeafSplitGainGivenOutput, feature_histogram.hpp:503-506)."""
+    if l2 is None:
+        l2 = cfg.lambda_l2
     sg = threshold_l1(g, cfg.lambda_l1)
-    return -(2.0 * sg * out + (h + cfg.lambda_l2) * out * out)
+    return -(2.0 * sg * out + (h + l2) * out * out)
 
 
 def leaf_split_gain(g, h, cfg: SplitConfig):
@@ -68,13 +85,16 @@ def leaf_split_gain(g, h, cfg: SplitConfig):
     return leaf_gain_given_output(g, h, leaf_output(g, h, cfg), cfg)
 
 
-def _split_gains(gl, hl, gr, hr, cfg: SplitConfig, min_c, max_c, monotone):
+def _split_gains(gl, hl, gr, hr, cfg: SplitConfig, min_c, max_c, monotone,
+                 l2=None):
     """Pairwise split gain with monotone rejection (reference: GetSplitGains,
     feature_histogram.hpp:459-472). All args broadcastable arrays."""
-    out_l = jnp.clip(leaf_output(gl, hl, cfg), min_c, max_c)
-    out_r = jnp.clip(leaf_output(gr, hr, cfg), min_c, max_c)
-    gain = (leaf_gain_given_output(gl, hl, out_l, cfg)
-            + leaf_gain_given_output(gr, hr, out_r, cfg))
+    if l2 is None:
+        l2 = cfg.lambda_l2
+    out_l = jnp.clip(leaf_output_l2(gl, hl, cfg, l2), min_c, max_c)
+    out_r = jnp.clip(leaf_output_l2(gr, hr, cfg, l2), min_c, max_c)
+    gain = (leaf_gain_given_output(gl, hl, out_l, cfg, l2)
+            + leaf_gain_given_output(gr, hr, out_r, cfg, l2))
     violates = ((monotone > 0) & (out_l > out_r)) | ((monotone < 0) & (out_l < out_r))
     return jnp.where(violates, 0.0, gain)
 
@@ -89,8 +109,161 @@ class BestSplit(NamedTuple):
     left_g: jnp.ndarray        # f32 — left child sum of gradients
     left_h: jnp.ndarray        # f32
     left_c: jnp.ndarray        # f32 — left child row count
+    left_out: jnp.ndarray      # f32 — left child output (reference SplitInfo
+    right_out: jnp.ndarray     # f32   carries outputs; cat splits use +cat_l2)
     # categorical: bitset over bins, left = bins in set (all-zero if numerical)
-    cat_bitset: jnp.ndarray    # uint32 [B/32]
+    cat_bitset: jnp.ndarray    # uint32 [(B+31)/32]
+
+
+def _pack_bitset(member, B: int):
+    """Pack a [B] bool membership vector into uint32 words (the device form
+    of Common::ConstructBitset, reference: utils/common.h)."""
+    W = bitset_words(B)
+    pad = W * 32 - B
+    m = member.astype(jnp.uint32)
+    if pad:
+        m = jnp.pad(m, (0, pad))
+    weights = jnp.left_shift(jnp.uint32(1),
+                             jnp.arange(W * 32, dtype=jnp.uint32) % 32)
+    return (m * weights).reshape(W, 32).sum(axis=1).astype(jnp.uint32)
+
+
+def bitset_contains(words, idx):
+    """Elementwise bit test: words uint32 [..., W], idx int32 [...]."""
+    w = (idx // 32).astype(jnp.int32)
+    b = (idx % 32).astype(jnp.uint32)
+    word = jnp.take_along_axis(words, w[..., None], axis=-1)[..., 0]
+    return (jnp.right_shift(word, b) & jnp.uint32(1)) != 0
+
+
+def _categorical_best(g, h, c, sum_g, sum_h, cnt, meta: DeviceMeta,
+                      cfg: SplitConfig, min_c, max_c, min_gain_shift):
+    """Per-feature best categorical split over raw per-bin histograms
+    (reference: FindBestThresholdCategorical, feature_histogram.hpp:118-279).
+
+    One-hot for features with num_bin <= max_cat_to_onehot; otherwise the
+    sorted-by-g/h-ratio two-direction scan with cat_l2/cat_smooth and the
+    min_data_per_group batching.  Returns per-feature arrays plus the
+    selection info needed to rebuild the winning bin set.
+    """
+    F, B = g.shape
+    bins = jnp.arange(B, dtype=jnp.int32)[None, :]
+    nb = meta.num_bins[:, None]
+    is_full = (meta.missing_types == MISSING_NONE)[:, None]
+    used_bin = nb - 1 + is_full.astype(jnp.int32)            # [F, 1]
+    in_range = bins < used_bin
+    f_idx = jnp.arange(F)
+
+    # ---- one-hot: left = single category t (hpp:139-169) -------------
+    h_e = h + K_EPSILON
+    other_h = sum_h - h - K_EPSILON
+    ok_oh = (in_range & (c >= cfg.min_data_in_leaf)
+             & (h >= cfg.min_sum_hessian_in_leaf)
+             & (cnt - c >= cfg.min_data_in_leaf)
+             & (other_h >= cfg.min_sum_hessian_in_leaf))
+    gain_oh = _split_gains(sum_g - g, other_h, g, h_e, cfg, min_c, max_c, 0)
+    gain_oh = jnp.where(ok_oh & (gain_oh > min_gain_shift), gain_oh, NEG_INF)
+    t_oh = jnp.argmax(gain_oh, axis=1).astype(jnp.int32)     # [F]
+    best_oh = gain_oh[f_idx, t_oh]
+    lg_oh, lh_oh, lc_oh = g[f_idx, t_oh], h_e[f_idx, t_oh], c[f_idx, t_oh]
+    lout_oh = jnp.clip(leaf_output(lg_oh, lh_oh, cfg), min_c, max_c)
+    rout_oh = jnp.clip(leaf_output(sum_g - lg_oh, sum_h - lh_oh, cfg),
+                       min_c, max_c)
+
+    # ---- sorted-ratio scan (hpp:170-239) ------------------------------
+    l2s = cfg.lambda_l2 + cfg.cat_l2
+    ok_bin = in_range & (c >= cfg.cat_smooth)
+    ratio = jnp.where(ok_bin, g / (h + cfg.cat_smooth), jnp.inf)
+    order = jnp.argsort(ratio, axis=1, stable=True).astype(jnp.int32)
+    used = jnp.sum(ok_bin, axis=1).astype(jnp.int32)         # [F]
+    max_num_cat = jnp.minimum(cfg.max_cat_threshold, (used + 1) // 2)
+
+    gather = lambda a, idx: jnp.take_along_axis(a, idx, axis=1)
+    sg1, sh1, sc1 = gather(g, order), gather(h, order), gather(c, order)
+    # dir=-1 visits sorted positions used-1, used-2, ...
+    idx2 = jnp.clip(used[:, None] - 1 - bins, 0, B - 1)
+    sg2, sh2, sc2 = gather(sg1, idx2), gather(sh1, idx2), gather(sc1, idx2)
+
+    def dir_arrays(sg, sh, sc):
+        lg = jnp.cumsum(sg, axis=1)
+        lh = jnp.cumsum(sh, axis=1) + K_EPSILON
+        lc = jnp.cumsum(sc, axis=1)
+        rc, rh = cnt - lc, sum_h - lh
+        valid_i = (bins < used[:, None]) & (bins < max_num_cat[:, None])
+        left_ok = ((lc >= cfg.min_data_in_leaf)
+                   & (lh >= cfg.min_sum_hessian_in_leaf))
+        # break guards fire only at visited positions that pass the left
+        # "continue" guards (hpp:212-219); the breaking position itself is
+        # not evaluated, so the exclusion is inclusive-cumulative
+        brk = (((rc < cfg.min_data_in_leaf) | (rc < cfg.min_data_per_group)
+                | (rh < cfg.min_sum_hessian_in_leaf))
+               & left_ok & valid_i)
+        broken = jnp.cumsum(brk.astype(jnp.int32), axis=1) > 0
+        eligible = valid_i & left_ok & ~broken
+        gain = _split_gains(lg, lh, sum_g - lg, sum_h - lh, cfg,
+                            min_c, max_c, 0, l2=l2s)
+        return lg, lh, lc, eligible, gain
+
+    lg1c, lh1c, lc1c, el1, gg1 = dir_arrays(sg1, sh1, sc1)
+    lg2c, lh2c, lc2c, el2, gg2 = dir_arrays(sg2, sh2, sc2)
+
+    # min_data_per_group batching: a candidate is only evaluated (and the
+    # group counter reset) once the accumulated group reaches the minimum
+    # (hpp:221-224) — a sequential recurrence, scanned over the bin axis
+    cc = jnp.stack([sc1, sc2], axis=1)                       # [F, 2, B]
+    el = jnp.stack([el1, el2], axis=1)
+
+    def step(grp, xs):
+        c_i, elig_i = xs
+        grp = grp + c_i
+        ev = elig_i & (grp >= cfg.min_data_per_group)
+        return jnp.where(ev, 0.0, grp), ev
+
+    _, evs = jax.lax.scan(step, jnp.zeros((F, 2), cc.dtype),
+                          (jnp.moveaxis(cc, 2, 0), jnp.moveaxis(el, 2, 0)))
+    evs = jnp.moveaxis(evs, 0, 2)                            # [F, 2, B]
+
+    gains_s = jnp.stack([gg1, gg2], axis=1)
+    gains_s = jnp.where(evs & (gains_s > min_gain_shift), gains_s, NEG_INF)
+    flat = gains_s.reshape(F, 2 * B)                         # dir-major order
+    w_s = jnp.argmax(flat, axis=1).astype(jnp.int32)
+    best_s = flat[f_idx, w_s]
+    dir_s = w_s // B                                         # 0 → +1, 1 → -1
+    i_s = w_s % B
+    pick_d = lambda a1, a2: jnp.where(dir_s == 0, a1[f_idx, i_s], a2[f_idx, i_s])
+    lg_s, lh_s, lc_s = pick_d(lg1c, lg2c), pick_d(lh1c, lh2c), pick_d(lc1c, lc2c)
+    lout_s = jnp.clip(leaf_output_l2(lg_s, lh_s, cfg, l2s), min_c, max_c)
+    rout_s = jnp.clip(leaf_output_l2(sum_g - lg_s, sum_h - lh_s, cfg, l2s),
+                      min_c, max_c)
+
+    # ---- merge the two paths per feature ------------------------------
+    use_oh = nb[:, 0] <= cfg.max_cat_to_onehot
+    sel = lambda a, b: jnp.where(use_oh, a, b)
+    return dict(
+        gain=sel(best_oh, best_s),
+        left_g=sel(lg_oh, lg_s),
+        left_h=sel(lh_oh, lh_s) - K_EPSILON,
+        left_c=sel(lc_oh, lc_s),
+        left_out=sel(lout_oh, lout_s),
+        right_out=sel(rout_oh, rout_s),
+        use_oh=use_oh, t_oh=t_oh, order=order, used=used,
+        dir_s=dir_s, i_s=i_s,
+    )
+
+
+def _cat_winner_bitset(cat: dict, f_best, B: int):
+    """Left-going bin set of the winning categorical split, packed."""
+    bins = jnp.arange(B, dtype=jnp.int32)
+    orow = cat["order"][f_best]
+    u = cat["used"][f_best]
+    i = cat["i_s"][f_best]
+    pos_member = jnp.where(cat["dir_s"][f_best] == 0,
+                           bins <= i,
+                           (bins >= u - 1 - i) & (bins < u))
+    member_sorted = jnp.zeros((B,), bool).at[orow].set(pos_member)
+    member_oh = bins == cat["t_oh"][f_best]
+    member = jnp.where(cat["use_oh"][f_best], member_oh, member_sorted)
+    return _pack_bitset(member, B)
 
 
 def best_split(hist, sum_g, sum_h, cnt, meta: DeviceMeta, cfg: SplitConfig,
@@ -172,17 +345,35 @@ def best_split(hist, sum_g, sum_h, cnt, meta: DeviceMeta, cfg: SplitConfig,
         gains1 = jnp.where(fm, gains1, NEG_INF)
         gains2 = jnp.where(fm, gains2, NEG_INF)
 
-    # ---- argmax with reference-faithful tie order ------------------------
+    # ---- per-feature best with reference-faithful tie order --------------
     # per feature the reference tries dir=-1 first (high t to low), then
     # dir=+1 (low t to high), keeping the FIRST strict max; across features
     # lower index wins.  Flatten as [F, (rev dir-1 block, dir+1 block)].
     stacked = jnp.concatenate([gains2[:, ::-1], gains1], axis=1)  # [F, 2B]
-    flat_idx = jnp.argmax(stacked)
-    f_best = (flat_idx // (2 * B)).astype(jnp.int32)
-    within = (flat_idx % (2 * B)).astype(jnp.int32)
+    within_f = jnp.argmax(stacked, axis=1).astype(jnp.int32)      # [F]
+    feat_gain = jnp.take_along_axis(stacked, within_f[:, None], 1)[:, 0]
+
+    # ---- categorical candidates (skipped entirely when the dataset has
+    # none — meta arrays are trace-time constants) -------------------------
+    has_cat = bool(np.any(np.asarray(meta.is_categorical)))
+    W = bitset_words(B)
+    if has_cat:
+        cat = _categorical_best(g, h, c, sum_g, sum_h, cnt, meta, cfg,
+                                min_constraint, max_constraint, min_gain_shift)
+        cat_gain = jnp.where(cat["gain"] > NEG_INF,
+                             (cat["gain"] - min_gain_shift) * meta.penalties,
+                             NEG_INF)
+        feat_gain = jnp.where(meta.is_categorical, cat_gain, feat_gain)
+    if feature_mask is not None:
+        feat_gain = jnp.where(feature_mask, feat_gain, NEG_INF)
+
+    f_best = jnp.argmax(feat_gain).astype(jnp.int32)
+    best_gain = feat_gain[f_best]
+
+    # ---- numerical payload at the winner ---------------------------------
+    within = within_f[f_best]
     is_dir2 = within < B
     t_best = jnp.where(is_dir2, B - 1 - within, within - B).astype(jnp.int32)
-    best_gain = stacked[f_best, within]
 
     # default_left: dir=-1 => True; single-scan features: True unless the
     # 2-bin NaN fixup forces False (reference: feature_histogram.hpp:106-110)
@@ -196,6 +387,25 @@ def best_split(hist, sum_g, sum_h, cnt, meta: DeviceMeta, cfg: SplitConfig,
     left_g = pick(lg1, lg2)
     left_h = pick(lh1, lh2) - K_EPSILON
     left_c = pick(lc1, lc2)
+    left_out = jnp.clip(leaf_output(left_g, left_h, cfg),
+                        min_constraint, max_constraint)
+    right_out = jnp.clip(leaf_output(sum_g - left_g, sum_h - left_h, cfg),
+                         min_constraint, max_constraint)
+    cat_bitset = jnp.zeros((W,), dtype=jnp.uint32)
+
+    # ---- swap in the categorical payload when a categorical feature won --
+    if has_cat:
+        win_cat = meta.is_categorical[f_best]
+        sel = lambda cv, nv: jnp.where(win_cat, cv, nv)
+        t_best = sel(jnp.int32(0), t_best)
+        default_left = sel(False, default_left)
+        left_g = sel(cat["left_g"][f_best], left_g)
+        left_h = sel(cat["left_h"][f_best], left_h)
+        left_c = sel(cat["left_c"][f_best], left_c)
+        left_out = sel(cat["left_out"][f_best], left_out)
+        right_out = sel(cat["right_out"][f_best], right_out)
+        cat_bitset = jnp.where(win_cat, _cat_winner_bitset(cat, f_best, B),
+                               cat_bitset)
 
     found = best_gain > NEG_INF
     return BestSplit(
@@ -204,5 +414,6 @@ def best_split(hist, sum_g, sum_h, cnt, meta: DeviceMeta, cfg: SplitConfig,
         threshold=jnp.where(found, t_best, 0).astype(jnp.int32),
         default_left=default_left,
         left_g=left_g, left_h=left_h, left_c=left_c,
-        cat_bitset=jnp.zeros((B // 32,), dtype=jnp.uint32),
+        left_out=left_out, right_out=right_out,
+        cat_bitset=cat_bitset,
     )
