@@ -276,6 +276,23 @@ class GBDT:
             return jnp.asarray(out)
 
         dl = np.array([(tree.decision_type[i] & 2) != 0 for i in range(nn)], bool)
+        # rebuild the bin-space category bitsets from the value-space model
+        # storage (inverse of Tree.from_device's translation)
+        from ..core.splitter import bitset_words
+        W = bitset_words(self.B)
+        cat_bits = np.zeros((max(n, 1), W), np.uint32)
+        inner_feats = self._inner_features(tree)
+        for i in range(nn):
+            if not tree.is_categorical(i):
+                continue
+            mapper = self.train_ds.inner_to_mapper(int(inner_feats[i]))
+            ci = int(tree.threshold[i])
+            lo, hi = int(tree.cat_boundaries[ci]), int(tree.cat_boundaries[ci + 1])
+            for cat, b in mapper.categorical_2_bin.items():
+                word = cat // 32
+                if cat >= 0 and word < hi - lo and \
+                        (int(tree.cat_threshold[lo + word]) >> (cat % 32)) & 1:
+                    cat_bits[i, b // 32] |= np.uint32(1 << (b % 32))
         return TreeArrays(
             split_feature=pad(self._inner_features(tree), n, -1, np.int32),
             threshold_bin=pad(tree.threshold_bin[:nn], n, 0, np.int32),
@@ -292,6 +309,7 @@ class GBDT:
             leaf_weight=pad(tree.leaf_weight[:nl].astype(np.float32), L, 0.0,
                             np.float32),
             num_leaves=np.int32(nl),
+            cat_bitset=jnp.asarray(cat_bits),
         )
 
     def _inner_features(self, tree: Tree) -> np.ndarray:

@@ -12,6 +12,7 @@ import jax.numpy as jnp
 
 from .grower import TreeArrays, go_left_bins
 from .meta import DeviceMeta
+from .splitter import bitset_contains
 
 
 def predict_leaf_bins(tree: TreeArrays, bins, meta: DeviceMeta):
@@ -32,6 +33,10 @@ def predict_leaf_bins(tree: TreeArrays, bins, meta: DeviceMeta):
         gl = go_left_bins(col, tree.threshold_bin[nd], tree.default_left[nd],
                           meta.missing_types[f], meta.num_bins[f],
                           meta.default_bins[f])
+        # categorical nodes: membership in the node's bin-space bitset
+        # (reference: Tree::CategoricalDecisionInner, tree.h:265-303)
+        gl = jnp.where(meta.is_categorical[f],
+                       bitset_contains(tree.cat_bitset[nd], col), gl)
         nxt = jnp.where(gl, tree.left_child[nd], tree.right_child[nd])
         return jnp.where(active, nxt, node)
 

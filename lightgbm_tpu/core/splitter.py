@@ -267,13 +267,22 @@ def _cat_winner_bitset(cat: dict, f_best, B: int):
 
 
 def best_split(hist, sum_g, sum_h, cnt, meta: DeviceMeta, cfg: SplitConfig,
-               min_constraint, max_constraint, feature_mask=None) -> BestSplit:
+               min_constraint, max_constraint, feature_mask=None,
+               has_cat=None) -> BestSplit:
     """Find the best (feature, threshold) split of one leaf.
 
     hist: f32 [F, B, 3]; sum_g/sum_h/cnt: leaf totals (scalars).
     min/max_constraint: monotone value window for this leaf (scalars).
     feature_mask: optional bool [F] — feature_fraction sampling.
+    has_cat: static flag gating the categorical search; None derives it from
+    ``meta`` when concrete (callers whose meta is a tracer — e.g. the
+    feature-parallel grower's per-device block slice — must pass it).
     """
+    if has_cat is None:
+        try:
+            has_cat = bool(np.any(np.asarray(meta.is_categorical)))
+        except jax.errors.TracerArrayConversionError:
+            has_cat = True  # safe: cat gains only apply where is_categorical
     F, B, _ = hist.shape
     g = hist[..., 0]
     h = hist[..., 1]
@@ -354,8 +363,7 @@ def best_split(hist, sum_g, sum_h, cnt, meta: DeviceMeta, cfg: SplitConfig,
     feat_gain = jnp.take_along_axis(stacked, within_f[:, None], 1)[:, 0]
 
     # ---- categorical candidates (skipped entirely when the dataset has
-    # none — meta arrays are trace-time constants) -------------------------
-    has_cat = bool(np.any(np.asarray(meta.is_categorical)))
+    # none — ``has_cat`` is static) ----------------------------------------
     W = bitset_words(B)
     if has_cat:
         cat = _categorical_best(g, h, c, sum_g, sum_h, cnt, meta, cfg,

@@ -80,6 +80,7 @@ class Tree:
         split_feature_inner = _np.asarray(arrays.split_feature)[:nn]
         threshold_bin = _np.asarray(arrays.threshold_bin)[:nn]
         default_left = _np.asarray(arrays.default_left)[:nn]
+        bin_bitsets = _np.asarray(arrays.cat_bitset)[:nn]  # u32 [nn, W]
 
         threshold = _np.zeros(nn, dtype=_np.float64)
         decision_type = _np.zeros(nn, dtype=_np.int32)
@@ -93,10 +94,25 @@ class Tree:
             dt = _MISSING_SHIFT[mapper.missing_type]
             if mapper.bin_type == BIN_CATEGORICAL:
                 dt |= _CAT_MASK
-                # bin-space bitset was packed by the grower into threshold_bin
-                # as an index into the tree's categorical storage; the grower
-                # appends the bitset via `cat_bitsets` attribute.
-                threshold[i] = threshold_bin[i]  # cat index
+                # translate the grower's bin-space bitset into the model's
+                # value-space bitset (reference: tree.cpp Tree::Split cat
+                # form + Common::ConstructBitset); the NaN pseudo-category
+                # (-1) is dropped — value-space prediction sends missing
+                # right, matching CategoricalDecision (tree.h:265-303)
+                words = bin_bitsets[i]
+                cats = [
+                    mapper.bin_2_categorical[b]
+                    for b in range(len(mapper.bin_2_categorical))
+                    if (int(words[b // 32]) >> (b % 32)) & 1
+                    and mapper.bin_2_categorical[b] >= 0
+                ]
+                n_words = (max(cats) // 32 + 1) if cats else 1
+                vw = [0] * n_words
+                for cvals in cats:
+                    vw[cvals // 32] |= 1 << (cvals % 32)
+                threshold[i] = len(cat_boundaries) - 1  # cat index
+                cat_threshold.extend(vw)
+                cat_boundaries.append(cat_boundaries[-1] + n_words)
             else:
                 if default_left[i]:
                     dt |= _DEFAULT_LEFT_MASK

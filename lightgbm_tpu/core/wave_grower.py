@@ -30,9 +30,9 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.pallas_hist import C_MAX, hist_pallas_wave
-from .grower import TreeArrays, _empty_tree, go_left_bins
+from .grower import TreeArrays, _empty_tree, go_left_node
 from .meta import DeviceMeta, SplitConfig
-from .splitter import best_split, leaf_output
+from .splitter import best_split, bitset_words, leaf_output
 
 NEG_INF = -jnp.inf
 
@@ -55,6 +55,9 @@ class _WaveState(NamedTuple):
     best_lg: jnp.ndarray
     best_lh: jnp.ndarray
     best_lc: jnp.ndarray
+    best_lout: jnp.ndarray      # f32 [L+1] winning split's left child output
+    best_rout: jnp.ndarray      # f32 [L+1]
+    best_cb: jnp.ndarray        # u32 [L+1, W] winning categorical bin set
     leaf_parent: jnp.ndarray
     leaf_is_right: jnp.ndarray
     pend_small: jnp.ndarray     # i32 [P] leaf ids (-1 empty)
@@ -114,12 +117,12 @@ def build_wave_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
             f = st.best_feat[leaf]
             t = st.best_thr[leaf]
             dl = st.best_dl[leaf]
+            cb = st.best_cb[leaf]
             lg, lh, lc = st.best_lg[leaf], st.best_lh[leaf], st.best_lc[leaf]
             pg, ph, pc = st.leaf_g[leaf], st.leaf_h[leaf], st.leaf_c[leaf]
             rg, rh, rc = pg - lg, ph - lh, pc - lc
             min_c, max_c = st.leaf_min_c[leaf], st.leaf_max_c[leaf]
-            out_l = jnp.clip(leaf_output(lg, lh, cfg), min_c, max_c)
-            out_r = jnp.clip(leaf_output(rg, rh, cfg), min_c, max_c)
+            out_l, out_r = st.best_lout[leaf], st.best_rout[leaf]
             mono = meta.monotone[f]
             mid = (out_l + out_r) / 2.0
             l_min = jnp.where(mono < 0, mid, min_c)
@@ -146,11 +149,13 @@ def build_wave_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
                 left_child=tr.left_child.at[pn].set(new_lc_ptr).at[k].set(~leaf),
                 right_child=tr.right_child.at[pn].set(new_rc_ptr).at[k].set(~new),
                 num_leaves=tr.num_leaves + 1,
+                cat_bitset=tr.cat_bitset.at[k].set(cb),
             )
 
             col = bins_fm[f].astype(jnp.int32)
-            go_left = go_left_bins(col, t, dl, meta.missing_types[f],
-                                   meta.num_bins[f], meta.default_bins[f])
+            go_left = go_left_node(col, t, dl, meta.is_categorical[f], cb,
+                                   meta.missing_types[f], meta.num_bins[f],
+                                   meta.default_bins[f])
             in_leaf = st.leaf_id == leaf
             leaf_id = jnp.where(in_leaf & ~go_left, new, st.leaf_id)
 
@@ -227,6 +232,9 @@ def build_wave_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
                 best_lg=st.best_lg.at[cl_w].set(bs.left_g),
                 best_lh=st.best_lh.at[cl_w].set(bs.left_h),
                 best_lc=st.best_lc.at[cl_w].set(bs.left_c),
+                best_lout=st.best_lout.at[cl_w].set(bs.left_out),
+                best_rout=st.best_rout.at[cl_w].set(bs.right_out),
+                best_cb=st.best_cb.at[cl_w].set(bs.cat_bitset),
                 pend_small=jnp.full((P,), -1, jnp.int32),
                 pend_large=jnp.full((P,), -1, jnp.int32),
                 pend_cnt=jnp.int32(0),
@@ -238,6 +246,7 @@ def build_wave_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
     # ---------------- driver -------------------------------------------
     def grow(bins_fm, g, h, sample_mask, feature_mask):
         F, N = bins_fm.shape
+        W = bitset_words(B)
         gv = (g * sample_mask).astype(jnp.float32)
         hv = (h * sample_mask).astype(jnp.float32)
         cv = sample_mask.astype(jnp.float32)
@@ -263,12 +272,14 @@ def build_wave_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
             best_feat=Li, best_thr=Li,
             best_dl=jnp.zeros((L + 1,), bool),
             best_lg=Lf, best_lh=Lf, best_lc=Lf,
+            best_lout=Lf, best_rout=Lf,
+            best_cb=jnp.zeros((L + 1, W), jnp.uint32),
             leaf_parent=jnp.full((L + 1,), -1, jnp.int32),
             leaf_is_right=jnp.zeros((L + 1,), bool),
             pend_small=jnp.full((P,), -1, jnp.int32).at[0].set(0),
             pend_large=jnp.full((P,), -1, jnp.int32),
             pend_cnt=jnp.int32(1),
-            tree=_empty_tree(L),
+            tree=_empty_tree(L, W),
         )
         # Alternate split and wave phases until no ready leaf has positive
         # gain and nothing is pending.  The first body iteration has no

@@ -145,6 +145,9 @@ def make_feature_parallel_grower(meta: DeviceMeta, cfg: SplitConfig, B: int,
     F_block = -(-F // D)
     F_pad = F_block * D
     meta_pad = _pad_meta_block(meta, F, F_pad)
+    # static: the sliced per-device meta is a tracer inside shard_map, so
+    # the categorical-path gate must be decided here from the full meta
+    has_cat = bool(np.any(np.asarray(meta.is_categorical)))
 
     def block_slice(a, axis=0):
         idx = jax.lax.axis_index(AXIS)
@@ -167,7 +170,7 @@ def make_feature_parallel_grower(meta: DeviceMeta, cfg: SplitConfig, B: int,
                    if F_pad > F else feature_mask)
             fm = block_slice(fmp)
         bs = splitter.best_split(hist, sg, sh, sc, lm, cfg, min_c, max_c,
-                                 feature_mask=fm)
+                                 feature_mask=fm, has_cat=has_cat)
         offset = jax.lax.axis_index(AXIS) * F_block
         bs = bs._replace(feature=jnp.where(bs.feature >= 0,
                                            bs.feature + offset,
@@ -179,7 +182,8 @@ def make_feature_parallel_grower(meta: DeviceMeta, cfg: SplitConfig, B: int,
             gain=gains[winner], feature=pick(bs.feature),
             threshold=pick(bs.threshold), default_left=pick(bs.default_left),
             left_g=pick(bs.left_g), left_h=pick(bs.left_h),
-            left_c=pick(bs.left_c), cat_bitset=pick(bs.cat_bitset))
+            left_c=pick(bs.left_c), left_out=pick(bs.left_out),
+            right_out=pick(bs.right_out), cat_bitset=pick(bs.cat_bitset))
 
     grow = build_grow_fn(meta, cfg, B, hist_fn=local_hist,
                          best_split_fn=synced_best_split)
