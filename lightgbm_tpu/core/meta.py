@@ -99,10 +99,6 @@ def build_device_meta(dataset, config=None):
         default_bins[inner] = m.default_bin
         missing[inner] = m.missing_type
         is_cat[inner] = m.bin_type == BIN_CATEGORICAL
-    if is_cat.any():
-        from ..utils import log
-        log.warning("Categorical split search is not implemented yet; "
-                    "declared categorical features will not be split on")
     monotone = np.zeros(F, dtype=np.int32)
     penalties = np.ones(F, dtype=np.float32)
     if config is not None:
