@@ -190,7 +190,10 @@ class Tree:
         numerical = np.where(is_missing, default_left, fv <= thr)
 
         if is_cat.any():
-            cat_left = self._cat_decide(fv, nodes)
+            # the raw value, NOT the NaN-zeroed fv: the reference's
+            # CategoricalDecision casts NaN to a negative int and routes it
+            # right before any missing-type handling (tree.h:262-265)
+            cat_left = self._cat_decide(fval, nodes)
             return np.where(is_cat, cat_left, numerical)
         return numerical
 

@@ -7,6 +7,8 @@ src/treelearner/feature_histogram.hpp:118-279), and the full chain —
 train with declared categorical features, category-set partitions, model
 text round-trip, device vs host prediction — is exercised end-to-end.
 """
+import os
+
 import numpy as np
 import pytest
 
@@ -21,6 +23,7 @@ from lightgbm_tpu.core import splitter
 from lightgbm_tpu.core.wave_grower import build_wave_grow_fn
 
 K_EPSILON = 1e-15
+FIX = os.path.join(os.path.dirname(__file__), "fixtures")
 
 
 # ---------------------------------------------------------------------------
@@ -206,6 +209,20 @@ def test_categorical_device_replay_matches_host_predict():
     np.testing.assert_allclose(ev["v"]["binary_logloss"][-1], ll, rtol=1e-5)
 
 
+def test_load_reference_categorical_model_predict_parity():
+    """tests/fixtures/ref_cat_model.txt was trained by the reference CLI
+    (built from /root/reference) with categorical_feature=0 on a synthetic
+    dataset; ref_cat_pred.npy holds its own predictions. Loading that
+    model here must reproduce them — cross-framework categorical-decision
+    parity (reference: tree.h:265-303 CategoricalDecision). The prediction
+    rows include NaN, unseen (25, 40), and negative categories, which the
+    reference routes right."""
+    bst = lgb.Booster(model_file=os.path.join(FIX, "ref_cat_model.txt"))
+    rows = np.load(os.path.join(FIX, "cat_rows.npy"))
+    expected = np.load(os.path.join(FIX, "ref_cat_pred.npy"))
+    np.testing.assert_allclose(bst.predict(rows), expected, atol=1e-12)
+
+
 def test_wave_categorical_matches_serial():
     """Wave grower (capacity 1, interpret mode) reproduces the serial
     grower node-for-node on a dataset with a categorical feature."""
@@ -236,8 +253,17 @@ def test_wave_categorical_matches_serial():
     assert int(t2.num_leaves) == nn + 1
     np.testing.assert_array_equal(np.asarray(t1.split_feature[:nn]),
                                   np.asarray(t2.split_feature[:nn]))
+    np.testing.assert_array_equal(np.asarray(t1.threshold_bin[:nn]),
+                                  np.asarray(t2.threshold_bin[:nn]))
+    np.testing.assert_array_equal(np.asarray(t1.default_left[:nn]),
+                                  np.asarray(t2.default_left[:nn]))
     np.testing.assert_array_equal(np.asarray(t1.cat_bitset[:nn]),
                                   np.asarray(t2.cat_bitset[:nn]))
+    # leaf values too — a wrong l2 (lambda_l2 vs +cat_l2) in the output
+    # computation would keep the structure but change the outputs
+    np.testing.assert_allclose(np.asarray(t1.leaf_value),
+                               np.asarray(t2.leaf_value), rtol=1e-4,
+                               atol=1e-5)
     np.testing.assert_array_equal(np.asarray(lid1), np.asarray(lid2))
     # at least one categorical node must exist for this to be a real test
     assert np.any(np.asarray(t1.cat_bitset[:nn]) != 0)
