@@ -16,6 +16,10 @@ from .gbdt import GBDT
 
 
 class DART(GBDT):
+    # DART normalizes the newest tree every iteration, so the stop check
+    # must stay synchronous
+    _lag_stop = False
+
     def init(self, config, train_ds, objective, metrics) -> None:
         super().init(config, train_ds, objective, metrics)
         self._drop_rng = np.random.default_rng(config.drop_seed)

@@ -61,9 +61,14 @@ class _TreeList(list):
 class GBDT:
     """Gradient Boosting Decision Tree trainer."""
 
+    # subclasses that inspect/rewrite the newest trees every iteration
+    # (DART) must keep the synchronous per-iteration stop check
+    _lag_stop = True
+
     def __init__(self):
         self.models: List[Tree] = _TreeList(self)
         self._has_deferred = False
+        self._pending_nl = None
         self.iter_ = 0
         self.config: Optional[Config] = None
         self.objective = None
@@ -134,15 +139,21 @@ class GBDT:
         self.uses_wave = bool(wave_ok)
         if self.uses_wave:
             from ..core.wave_grower import build_wave_grow_fn
-            # histograms accumulate at f32 input precision unless the user
-            # explicitly opts into bf16 MXU inputs (the reference keeps
+            # histogram precision: "2xbf16" (default, hi/lo split — g/h at
+            # ~16 mantissa bits with f32 accumulation; the reference keeps
             # float histograms even in single-precision GPU mode,
-            # gpu_tree_learner.h:80-84)
-            highest = config.tpu_hist_dtype != "bfloat16" or config.gpu_use_dp
+            # gpu_tree_learner.h:80-84), "highest" for gpu_use_dp, "bf16"
+            # only on explicit opt-in
+            if config.gpu_use_dp or config.tpu_hist_dtype == "highest":
+                mode = "highest"
+            elif config.tpu_hist_dtype == "bfloat16":
+                mode = "bf16"
+            else:
+                mode = "2xbf16"
             self._grow_raw = build_wave_grow_fn(
                 self.meta, self.split_cfg, self.B,
                 wave_capacity=int(config.tpu_wave_capacity),
-                highest=bool(highest),
+                highest=mode,
                 gain_gate=float(config.tpu_wave_gain_gate),
                 block_rows=int(config.tpu_block_rows))
             # feature-major resident copy for the Pallas kernel layout
@@ -197,12 +208,20 @@ class GBDT:
 
         @functools.partial(jax.jit, static_argnames=("k",))
         def grow_apply(bins, g, h, bag_mask, feature_mask, score, lr, k):
-            """grow + shrink + train-score update for class k, one call."""
+            """grow + shrink + train-score update for class k, one call.
+
+            The leaf values are zeroed ON DEVICE when the tree failed to
+            split (num_leaves <= 1), so the score update is a no-op and the
+            host can check the leaf count one iteration late — that lag-1
+            check is what lets the next iteration's growth overlap the
+            device->host fetch instead of serializing on it."""
             arrs, leaf_id = grow_raw(bins, g[:, k], h[:, k], bag_mask,
                                      feature_mask)
-            lv = arrs.leaf_value * lr
+            grew = arrs.num_leaves > 1
+            lv = jnp.where(grew, arrs.leaf_value * lr, 0.0)
             arrs = arrs._replace(
-                leaf_value=lv, internal_value=arrs.internal_value * lr)
+                leaf_value=lv,
+                internal_value=jnp.where(grew, arrs.internal_value * lr, 0.0))
             new_score = score.at[:, k].add(lv[leaf_id])
             return arrs, leaf_id, new_score
 
@@ -219,6 +238,9 @@ class GBDT:
     def _materialize_trees(self) -> None:
         """Convert any device-deferred trees to host ``Tree`` objects in a
         single batched device->host transfer."""
+        # resolve a leftover lag-1 stop check first so dead trailing trees
+        # never materialize into the model
+        self._resolve_pending_stop()
         if not self._has_deferred:
             return
         import jax
@@ -396,7 +418,19 @@ class GBDT:
         needs_renew = (self.objective is not None
                        and self.objective.is_renew_tree_output)
 
+        # Lag-1 stop check (fast path): grow_apply zeroes a dead tree's
+        # values on device, so the host only needs the leaf count to DECIDE
+        # WHEN TO STOP — checking the previous iteration's count lets this
+        # iteration's growth overlap the device->host fetch (one tunnel
+        # round-trip per iteration otherwise serializes the whole loop).
+        # The first iteration stays synchronous: its no-split case must
+        # insert the boost_from_average constant tree immediately
+        # (reference: gbdt.cpp:418-436).
+        lag_ok = self._lag_stop and not needs_renew and self.iter_ >= 1
+
         should_continue = False
+        pend_nl = []
+        cur_grown = []
         for k in range(K):
             tree = None
             if self.class_need_train[k] and self.train_ds.num_features > 0:
@@ -407,14 +441,27 @@ class GBDT:
                     arrs, leaf_id = self._grow(self._grow_bins, g[:, k],
                                                h[:, k], self._bag_mask,
                                                feature_mask)
+                    nl = int(arrs.num_leaves)
                 else:
                     arrs, leaf_id, new_score = self._grow_apply(
                         self._grow_bins, g, h, self._bag_mask, feature_mask,
                         self._train_score, jnp.float32(self.shrinkage_rate),
                         k)
-                nl = int(arrs.num_leaves)
+                    if lag_ok:
+                        nl_dev = arrs.num_leaves
+                        try:  # start the D2H copy now; next iteration's
+                            nl_dev.copy_to_host_async()  # int() finds it
+                        except AttributeError:           # landed already
+                            pass
+                        pend_nl.append(nl_dev)
+                        cur_grown.append((k, arrs, leaf_id))
+                        nl = 2  # optimistic; resolved next iteration
+                    else:
+                        nl = int(arrs.num_leaves)
             else:
                 arrs, leaf_id, nl = None, None, 1
+                if lag_ok:
+                    pend_nl.append(None)
 
             if nl > 1:
                 should_continue = True
@@ -448,6 +495,14 @@ class GBDT:
                 tree = _constant_tree(output)
             self.models.append(tree)
 
+        if lag_ok:
+            prev_dead = self._resolve_pending_stop(current=cur_grown)
+            if prev_dead:
+                log.warning("Stopped training because there are no more "
+                            "leaves that meet the split requirements")
+                return True
+            self._pending_nl = pend_nl
+
         if not should_continue:
             log.warning("Stopped training because there are no more leaves "
                         "that meet the split requirements")
@@ -456,6 +511,38 @@ class GBDT:
             return True
         self.iter_ += 1
         return False
+
+    def _resolve_pending_stop(self, current=None) -> bool:
+        """Resolve the lag-1 stop check: if NO class split in the previous
+        iteration, training effectively stopped there (reference semantics:
+        stop at the first dead iteration).  The previous trees' values were
+        zeroed on device so scores never moved; this iteration's trees —
+        which CAN have split under per-iteration bagging/feature sampling —
+        are stripped and their score contributions rolled back.
+
+        ``current``: [(class, arrs, leaf_id), ...] for trees appended this
+        iteration, or None when called outside train_one_iter."""
+        prev = self._pending_nl
+        self._pending_nl = None
+        if prev is None:
+            return False
+        trained = [x for x in prev if x is not None]
+        if not trained or any(int(x) > 1 for x in trained):
+            return False
+        K = self.num_tpi
+        if current is not None:
+            for k, arrs, leaf_id in current:
+                neg = arrs._replace(leaf_value=-arrs.leaf_value)
+                self._train_score = self._train_score.at[:, k].add(
+                    neg.leaf_value[leaf_id])
+                for i in range(len(self._valid_scores)):
+                    self._valid_scores[i] = self._valid_apply(
+                        self._valid_scores[i], neg, self._valid_bins[i], k)
+            del self.models[-2 * K:]
+        else:
+            del self.models[-K:]
+        self.iter_ -= 1
+        return True
 
     def _renew_tree_output(self, arrs: TreeArrays, leaf_id, class_id: int):
         """Percentile leaf refit for L1-family objectives

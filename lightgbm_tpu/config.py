@@ -285,8 +285,11 @@ class Config:
     gpu_device_id: int = -1
     gpu_use_dp: bool = False
     tpu_hist_dtype: str = "float32"     # histogram matmul input precision:
-                                        # float32 (exact) or bfloat16 (fast,
-                                        # ~8-bit mantissa on g/h)
+                                        # float32 = hi/lo bf16 split (~16
+                                        # mantissa bits on g/h, f32 accum,
+                                        # 2 MXU passes), highest = exact f32
+                                        # (3 passes; also via gpu_use_dp),
+                                        # bfloat16 = 1 pass (~8 bits)
     tpu_block_rows: int = 1024          # Pallas histogram kernel row-block
     tpu_wave_capacity: int = 42         # leaves histogrammed per wave pass
                                         # (<= 42: 3 channels each in the
@@ -388,8 +391,8 @@ class Config:
                 log.fatal("bagging_freq and bagging_fraction (in (0,1)) are required for rf")
         if not (0.0 <= self.tpu_wave_gain_gate <= 1.0):
             log.fatal("tpu_wave_gain_gate should be in [0.0, 1.0]")
-        if self.tpu_hist_dtype not in ("float32", "bfloat16"):
-            log.fatal("tpu_hist_dtype should be float32 or bfloat16")
+        if self.tpu_hist_dtype not in ("float32", "bfloat16", "highest"):
+            log.fatal("tpu_hist_dtype should be float32, bfloat16 or highest")
         if self.tpu_block_rows < 128 or self.tpu_block_rows % 128 != 0:
             log.fatal("tpu_block_rows should be a positive multiple of 128 "
                       "(TPU lane-tile alignment)")

@@ -26,6 +26,8 @@ from __future__ import annotations
 
 from typing import NamedTuple
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 
@@ -67,9 +69,9 @@ class _WaveState(NamedTuple):
 
 
 def build_wave_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
-                       wave_capacity: int = 42, highest: bool = True,
+                       wave_capacity: int = 42, highest="highest",
                        interpret: bool = False, gain_gate: float = 0.0,
-                       block_rows: int = 1024):
+                       block_rows: int = 1024, compact: bool = True):
     """Unjitted ``grow(bins_fm, g, h, sample_mask, feature_mask)`` using the
     Pallas wave kernel. Returns (TreeArrays, leaf_id).
 
@@ -84,11 +86,13 @@ def build_wave_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
     gate (split everything positive, max throughput); 1 is strict
     best-of-phase only.
 
-    ``highest`` keeps the histogram matmul accumulation at f32 input
-    precision (the reference accumulates float even in single-precision
-    GPU mode, gpu_tree_learner.h:80-84); False allows bf16 MXU inputs —
-    faster but g/h rounded to ~8 mantissa bits, which can flip near-tied
-    split gains.
+    ``highest`` selects the histogram matmul precision mode: True/"highest"
+    keeps f32 operands (exact, ~3 MXU passes); "2xbf16" (the engine
+    default) splits g/h into hi+lo bf16 terms — ~16 mantissa bits with f32
+    accumulation in 2 passes (the reference accumulates float even in
+    single-precision GPU mode, gpu_tree_learner.h:80-84); False/"bf16" is
+    one bf16 pass, g/h rounded to ~8 mantissa bits, which can flip
+    near-tied split gains.
     """
     L = cfg.num_leaves
     P = max(1, min(wave_capacity, C_MAX // 3))
@@ -193,9 +197,92 @@ def build_wave_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
             c_idx = jnp.arange(C_MAX) // 3
             slot_leaf = jnp.where(c_idx < P, st.pend_small[jnp.minimum(c_idx, P - 1)],
                                   -1).astype(jnp.int32)
-            hw = hist_pallas_wave(bins_fm, gv, hv, cv, st.leaf_id, slot_leaf,
-                                  B=B, block_rows=block_rows, highest=highest,
-                                  interpret=interpret)  # [F, B, C]
+
+            # ---- active-row compaction --------------------------------
+            # Only rows sitting in a pending-small leaf (and carrying
+            # weight — bagging/GOSS masks zero the rest) contribute to
+            # this wave.  Compact them to the front, then dispatch to the
+            # smallest statically-compiled kernel size tier that fits:
+            # the per-tree histogram cost becomes sum-of-smaller-children
+            # (each overshooting at most 2x), the reference's cost model
+            # (serial_tree_learner.cpp:496-522), instead of N x waves.
+            # Static tiers keep the Pallas grid fully pipelined — a
+            # dynamically bounded grid defeats Mosaic's DMA scheduling.
+            if compact:
+                N = bins_fm.shape[1]
+                # empty pending slots (-1) write to dead slot L+1, never to
+                # a real leaf's entry
+                pend_tbl = jnp.zeros((L + 2,), bool).at[
+                    jnp.where(st.pend_small >= 0, st.pend_small, L + 1)
+                ].set(st.pend_small >= 0)
+                active = (pend_tbl[jnp.clip(st.leaf_id, 0, L + 1)]
+                          & ((gv != 0) | (hv != 0) | (cv != 0)))
+                n_active = jnp.sum(active.astype(jnp.int32))
+                arange_n = jnp.arange(N, dtype=jnp.int32)
+
+                # size tiers: N, N/1.5, N/1.5^2, ... (block_rows-aligned,
+                # >= one block); tier k is the smallest still >= n_active.
+                # The gather into a tier-sized buffer happens INSIDE the
+                # selected branch: TPU gather cost scales with its OUTPUT
+                # size, so late waves (tiny pending sets) pay a tiny gather
+                # + a tiny kernel, and the full tier skips gathering
+                # entirely (inactive rows' leaves miss every slot, so they
+                # contribute zero in-kernel).
+                tiers = []
+                t = N
+                while True:
+                    tiers.append(t)
+                    nt = max(block_rows, ((t * 2 // 3 + block_rows - 1)
+                                          // block_rows) * block_rows)
+                    if nt >= t:
+                        break
+                    t = nt
+                K = len(tiers)
+
+                vecs3 = jnp.stack([gv, hv, cv], axis=1)  # [N, 3]
+
+                def tier_call(T):
+                    def f(_):
+                        if T >= N:
+                            return hist_pallas_wave(
+                                bins_fm, gv, hv, cv, st.leaf_id, slot_leaf,
+                                B=B, block_rows=block_rows, highest=highest,
+                                interpret=interpret)
+                        # index build lives inside the branch: full-tier
+                        # waves never pay for it
+                        pos = jnp.cumsum(active.astype(jnp.int32))
+                        idx = jnp.zeros((N,), jnp.int32).at[
+                            jnp.where(active, pos - 1, N)
+                        ].set(arange_n, mode="drop")
+                        idx_t = idx[:T]
+                        bins_c = jnp.take(bins_fm, idx_t, axis=1)
+                        vc = vecs3[idx_t]                # ONE packed gather
+                        # tail slots repeat row 0: leaf -2 misses every
+                        # channel slot, so their values never contribute
+                        leaf_c = jnp.where(arange_n[:T] < n_active,
+                                           st.leaf_id[idx_t], -2)
+                        return hist_pallas_wave(
+                            bins_c, vc[:, 0], vc[:, 1], vc[:, 2], leaf_c,
+                            slot_leaf, B=B, block_rows=block_rows,
+                            highest=highest, interpret=interpret)
+                    return f
+
+                if K == 1:
+                    hw = tier_call(N)(0)
+                else:
+                    # smallest tier >= n_active: count tiers that fit
+                    thresholds = jnp.asarray(np.asarray(tiers, np.int32))
+                    k = jnp.sum(
+                        (thresholds >= jnp.maximum(n_active, 1)).astype(
+                            jnp.int32)) - 1
+                    hw = jax.lax.switch(
+                        jnp.clip(k, 0, K - 1),
+                        [tier_call(T) for T in tiers], 0)  # [F, B, C]
+            else:
+                hw = hist_pallas_wave(bins_fm, gv, hv, cv, st.leaf_id,
+                                      slot_leaf, B=B, block_rows=block_rows,
+                                      highest=highest,
+                                      interpret=interpret)  # [F, B, C]
             Fdim = hw.shape[0]
             ws = hw[:, :, :3 * P].reshape(Fdim, B, P, 3).transpose(2, 0, 1, 3)
 
@@ -314,7 +401,7 @@ def build_wave_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
 
 
 def make_wave_grower(meta: DeviceMeta, cfg: SplitConfig, B: int,
-                     wave_capacity: int = 42, highest: bool = True,
+                     wave_capacity: int = 42, highest="highest",
                      interpret: bool = False, gain_gate: float = 0.0,
                      block_rows: int = 1024):
     return jax.jit(build_wave_grow_fn(meta, cfg, B, wave_capacity, highest,

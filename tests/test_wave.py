@@ -96,6 +96,29 @@ def test_wave_kernel_bf16_input_error_bounded():
     np.testing.assert_array_equal(got16[..., 2], want[..., 2])
 
 
+def test_wave_kernel_2xbf16_error_bounded():
+    """The default "2xbf16" mode (hi/lo bf16 split, the shipped TPU wave
+    precision) must track the f32 oracle to ~2^-16 relative on g/h — two
+    bf16 terms carry ~16 mantissa bits, and accumulation is f32 — and keep
+    counts exact (0/1 one-hot and 1.0 weights are bf16-exact)."""
+    handle, meta, scfg, B, g, h = _problem(n=300)
+    bins = jnp.asarray(handle.X_bin)
+    bins_fm = jnp.asarray(np.ascontiguousarray(handle.X_bin.T))
+    n = bins.shape[0]
+    leaf_id = jnp.zeros((n,), jnp.int32)
+    slot = np.full(C_MAX, -1, np.int32)
+    slot[:3] = 0
+    cv = jnp.ones((n,), jnp.float32)
+    hw = hist_pallas_wave(bins_fm, g, h, cv, leaf_id, jnp.asarray(slot),
+                          B=B, highest="2xbf16", interpret=True)
+    want = np.asarray(hist_onehot(bins, g, h, cv, B=B))
+    got = np.stack([np.asarray(hw[:, :, k]) for k in range(3)], axis=-1)
+    scale = np.abs(want[..., :2]).max()
+    np.testing.assert_allclose(got[..., :2], want[..., :2],
+                               atol=2 ** -16 * scale * 4, rtol=2 ** -15)
+    np.testing.assert_array_equal(got[..., 2], want[..., 2])
+
+
 def test_wave_kernel_row_padding_leafid_minus2():
     """Rows padded with leaf_id=-2 must not contribute to any slot."""
     handle, meta, scfg, B, g, h = _problem(n=300)
