@@ -20,6 +20,7 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 from ..utils import log
+from .. import native as _native
 
 # Values in (-kZeroThreshold, kZeroThreshold] are "zero"
 # (reference: include/LightGBM/meta.h:53).
@@ -53,6 +54,10 @@ def greedy_find_bin(distinct_values: np.ndarray, counts: np.ndarray, max_bin: in
     to roughly equal counts (reference: GreedyFindBin, bin.cpp:78-155).
     Returns ascending upper bounds; the last is +inf.
     """
+    if _native.lib() is not None:
+        return _native.greedy_find_bin(
+            np.asarray(distinct_values, np.float64),
+            np.asarray(counts, np.int64), max_bin, total_cnt, min_data_in_bin)
     n = len(distinct_values)
     if n == 0:
         return [math.inf]
@@ -199,6 +204,8 @@ def _distinct_with_zero(values: np.ndarray, zero_cnt: int):
     their ordered position (reference: BinMapper::FindBin, bin.cpp:353-389).
     ``values`` excludes zeros and NaNs."""
     values = np.sort(values.astype(np.float64), kind="stable")
+    if _native.lib() is not None:
+        return _native.distinct_with_zero(values, zero_cnt)
     if len(values) == 0:
         return np.array([0.0]), np.array([zero_cnt], dtype=np.int64)
     # merge near-equal neighbours (keep the larger value, sum counts)
@@ -285,12 +292,15 @@ class BinMapper:
                 bounds.append(math.nan)
             self.bin_upper_bound = np.asarray(bounds)
             self.num_bin = len(bounds)
-            cnt_in_bin = np.zeros(self.num_bin, dtype=np.int64)
-            i_bin = 0
-            for dv, c in zip(distinct, counts):
-                while dv > self.bin_upper_bound[i_bin]:
-                    i_bin += 1
-                cnt_in_bin[i_bin] += int(c)
+            # each distinct value lands in the first bin whose upper bound
+            # is >= it (bounds ascend; the count loop of the reference)
+            n_num = (self.num_bin - 1 if self.missing_type == MISSING_NAN
+                     else self.num_bin)
+            which = np.searchsorted(self.bin_upper_bound[:n_num - 1],
+                                    distinct, side="left")
+            cnt_in_bin = np.bincount(
+                which, weights=counts, minlength=self.num_bin
+            ).astype(np.int64)
             if self.missing_type == MISSING_NAN:
                 cnt_in_bin[-1] = na_cnt
             log.check(self.num_bin <= max_bin, "num_bin exceeds max_bin")
@@ -399,15 +409,22 @@ class BinMapper:
         scalar = np.isscalar(value)
         v = np.atleast_1d(np.asarray(value, dtype=np.float64))
         if self.bin_type == BIN_NUMERICAL:
-            nan = np.isnan(v)
             n_search = self.num_bin - (1 if self.missing_type == MISSING_NAN else 0)
-            vv = np.where(nan, 0.0, v)
-            # first bin i with value <= bin_upper_bound[i]; bounds ascend, the
-            # last searchable bound is +inf so the result is always < n_search
-            out = np.searchsorted(self.bin_upper_bound[:n_search - 1], vv, side="left")
-            if self.missing_type == MISSING_NAN:
-                out = np.where(nan, self.num_bin - 1, out)
-            res = out.astype(np.int32)
+            if _native.lib() is not None and v.ndim == 1 and len(v) > 1024:
+                res = _native.binarize_numerical(
+                    v, self.bin_upper_bound, n_search - 1,
+                    self.missing_type, self.num_bin)
+            else:
+                nan = np.isnan(v)
+                vv = np.where(nan, 0.0, v)
+                # first bin i with value <= bin_upper_bound[i]; bounds
+                # ascend, the last searchable bound is +inf so the result
+                # is always < n_search
+                out = np.searchsorted(self.bin_upper_bound[:n_search - 1], vv,
+                                      side="left")
+                if self.missing_type == MISSING_NAN:
+                    out = np.where(nan, self.num_bin - 1, out)
+                res = out.astype(np.int32)
         else:
             res = np.full(v.shape, self.num_bin - 1, dtype=np.int32)
             # NaN is converted to 0.0 before categorical lookup unless this

@@ -1,0 +1,139 @@
+"""ctypes loader for the native binning kernels.
+
+Compiles ``binning_native.cpp`` with g++ on first use (cached as a .so next
+to the source, keyed by a source hash) and exposes typed wrappers.  Every
+caller must handle ``lib() is None`` — the pure-Python implementations in
+``io/binning.py`` remain the reference fallback (and are what the tests
+cross-check the native path against).
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+from typing import Optional
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "binning_native.cpp")
+
+_lib = None
+_tried = False
+
+
+def _build(so_path: str) -> bool:
+    cmd = ["g++", "-O3", "-fopenmp", "-shared", "-fPIC", "-o", so_path, _SRC]
+    try:
+        r = subprocess.run(cmd, capture_output=True, timeout=120)
+        return r.returncode == 0
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+
+
+def lib() -> Optional[ctypes.CDLL]:
+    """The loaded native library, or None when unavailable."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    if os.environ.get("LIGHTGBM_TPU_NO_NATIVE"):
+        return None
+    try:
+        with open(_SRC, "rb") as f:
+            tag = hashlib.sha256(f.read()).hexdigest()[:16]
+    except OSError:
+        return None
+    so_path = os.path.join(_DIR, f"_binning_{tag}.so")
+    if not os.path.exists(so_path):
+        try:
+            # build into a temp file then rename — atomic under concurrent
+            # use (and the package dir may not be writable at all)
+            fd, tmp = tempfile.mkstemp(suffix=".so", dir=_DIR)
+            os.close(fd)
+            if _build(tmp):
+                os.replace(tmp, so_path)
+            else:
+                os.unlink(tmp)
+                return None
+        except OSError:
+            return None
+    try:
+        L = ctypes.CDLL(so_path)
+    except OSError:
+        return None
+    i64 = ctypes.c_int64
+    i32 = ctypes.c_int32
+    pd = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
+    pi64 = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+    pi32 = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+    L.distinct_with_zero.restype = i64
+    L.distinct_with_zero.argtypes = [pd, i64, i64, pd, pi64]
+    L.greedy_find_bin.restype = i64
+    L.greedy_find_bin.argtypes = [pd, pi64, i64, i64, i64, i64, pd]
+    L.binarize_numerical.restype = None
+    L.binarize_numerical.argtypes = [ctypes.c_void_p, i64, i64, pd, i64,
+                                     i32, i32, pi32]
+    L.binarize_numerical_u8.restype = None
+    L.binarize_numerical_u8.argtypes = [ctypes.c_void_p, i64, i64, pd, i64,
+                                        i32, i32, ctypes.c_void_p, i64]
+    _lib = L
+    return _lib
+
+
+def distinct_with_zero(values: np.ndarray, zero_cnt: int):
+    """Native sorted-distinct merge; values sorted f64, no zeros/NaNs."""
+    L = lib()
+    assert L is not None
+    n = len(values)
+    out_v = np.empty(n + 2, np.float64)
+    out_c = np.empty(n + 2, np.int64)
+    m = L.distinct_with_zero(np.ascontiguousarray(values, np.float64), n,
+                             int(zero_cnt), out_v, out_c)
+    return out_v[:m], out_c[:m]
+
+
+def greedy_find_bin(distinct: np.ndarray, counts: np.ndarray, max_bin: int,
+                    total_cnt: int, min_data_in_bin: int):
+    L = lib()
+    assert L is not None
+    out = np.empty(int(max_bin) + 2, np.float64)
+    nb = L.greedy_find_bin(np.ascontiguousarray(distinct, np.float64),
+                           np.ascontiguousarray(counts, np.int64),
+                           len(distinct), int(max_bin), int(total_cnt),
+                           int(min_data_in_bin), out)
+    return list(out[:nb])
+
+
+def binarize_numerical(col: np.ndarray, bounds: np.ndarray, n_bounds: int,
+                       missing_type: int, num_bin: int) -> np.ndarray:
+    L = lib()
+    assert L is not None
+    col = np.asarray(col)
+    if col.dtype != np.float64 or col.strides[0] % 8 != 0:
+        col = np.ascontiguousarray(col, np.float64)
+    stride = col.strides[0] // 8  # strided column views read in place
+    out = np.empty(len(col), np.int32)
+    L.binarize_numerical(col.ctypes.data, len(col), stride,
+                         np.ascontiguousarray(bounds, np.float64),
+                         int(n_bounds), int(missing_type), int(num_bin), out)
+    return out
+
+
+def binarize_numerical_u8(col: np.ndarray, bounds: np.ndarray, n_bounds: int,
+                          missing_type: int, num_bin: int,
+                          out: np.ndarray) -> None:
+    """Binarize straight into a uint8 column view (e.g. ``X[:, j]`` of a
+    C-order [N, F] matrix)."""
+    L = lib()
+    assert L is not None
+    col = np.asarray(col)
+    if col.dtype != np.float64 or col.strides[0] % 8 != 0:
+        col = np.ascontiguousarray(col, np.float64)
+    assert out.dtype == np.uint8 and len(out) == len(col)
+    L.binarize_numerical_u8(col.ctypes.data, len(col), col.strides[0] // 8,
+                            np.ascontiguousarray(bounds, np.float64),
+                            int(n_bounds), int(missing_type), int(num_bin),
+                            out.ctypes.data, out.strides[0])
