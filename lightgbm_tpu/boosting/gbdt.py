@@ -137,23 +137,47 @@ class GBDT:
                    and self.B <= 256
                    and train_ds.num_features > 0)
         self.uses_wave = bool(wave_ok)
+
+        # ---- parallel tree learners (reference: tree_learner.cpp:13-36) --
+        tl = getattr(config, "tree_learner", "serial")
+        if tl != "serial" and train_ds.num_features > 0:
+            from ..parallel.mesh import build_mesh, make_engine_grower
+            if int(getattr(config, "num_machines", 1)) > 1:
+                log.warning(
+                    "num_machines > 1 (multi-host) is not wired up; using "
+                    "the %d local devices of this process instead",
+                    len(jax.devices()))
+            mesh = build_mesh(config.tpu_mesh_shape)
+            wave_kw = None
+            if self.uses_wave:
+                wave_kw = dict(
+                    wave_capacity=int(config.tpu_wave_capacity),
+                    highest=self._hist_mode(config),
+                    gain_gate=float(config.tpu_wave_gain_gate),
+                    block_rows=int(config.tpu_block_rows))
+            use_wave = tl == "data" and wave_kw is not None
+            self.uses_wave = use_wave
+            self._grow = make_engine_grower(
+                tl, self.meta, self.split_cfg, self.B, mesh,
+                wave_kw=wave_kw if use_wave else None)
+            # pre-jitted, but callable from inside grow_apply's jit too
+            self._grow_raw = self._grow
+            from ..parallel.mesh import engine_pad_bins
+            host_bins = (np.ascontiguousarray(train_ds.X_bin.T) if use_wave
+                         else train_ds.X_bin)
+            if tl in ("data", "voting"):
+                host_bins = engine_pad_bins(host_bins, mesh.devices.size,
+                                            feature_major=use_wave)
+            self._grow_bins = jnp.asarray(host_bins)
+            log.info("Using %s-parallel tree learner over a %d-device mesh",
+                     tl, mesh.devices.size)
+            return
         if self.uses_wave:
             from ..core.wave_grower import build_wave_grow_fn
-            # histogram precision: "2xbf16" (default, hi/lo split — g/h at
-            # ~16 mantissa bits with f32 accumulation; the reference keeps
-            # float histograms even in single-precision GPU mode,
-            # gpu_tree_learner.h:80-84), "highest" for gpu_use_dp, "bf16"
-            # only on explicit opt-in
-            if config.gpu_use_dp or config.tpu_hist_dtype == "highest":
-                mode = "highest"
-            elif config.tpu_hist_dtype == "bfloat16":
-                mode = "bf16"
-            else:
-                mode = "2xbf16"
             self._grow_raw = build_wave_grow_fn(
                 self.meta, self.split_cfg, self.B,
                 wave_capacity=int(config.tpu_wave_capacity),
-                highest=mode,
+                highest=self._hist_mode(config),
                 gain_gate=float(config.tpu_wave_gain_gate),
                 block_rows=int(config.tpu_block_rows))
             # feature-major resident copy for the Pallas kernel layout
@@ -164,6 +188,19 @@ class GBDT:
             self._grow_raw = build_grow_fn(self.meta, self.split_cfg, self.B)
             self._grow_bins = self._bins
         self._grow = jax.jit(self._grow_raw)
+
+    @staticmethod
+    def _hist_mode(config: Config) -> str:
+        """Histogram precision: "2xbf16" (default for float32 — hi/lo bf16
+        split, ~16 mantissa bits on g/h, f32 accumulation; the reference
+        keeps float histograms even in single-precision GPU mode,
+        gpu_tree_learner.h:80-84), "highest" for gpu_use_dp or explicit
+        opt-in, "bf16" on explicit opt-in."""
+        if config.gpu_use_dp or config.tpu_hist_dtype == "highest":
+            return "highest"
+        if config.tpu_hist_dtype == "bfloat16":
+            return "bf16"
+        return "2xbf16"
 
     def _jit_helpers(self) -> None:
         """Fuse the whole boosting iteration into a handful of jitted

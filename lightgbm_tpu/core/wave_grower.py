@@ -71,9 +71,18 @@ class _WaveState(NamedTuple):
 def build_wave_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
                        wave_capacity: int = 42, highest="highest",
                        interpret: bool = False, gain_gate: float = 0.0,
-                       block_rows: int = 1024, compact: bool = True):
+                       block_rows: int = 1024, compact: bool = True,
+                       reduce_fn=None):
     """Unjitted ``grow(bins_fm, g, h, sample_mask, feature_mask)`` using the
     Pallas wave kernel. Returns (TreeArrays, leaf_id).
+
+    ``reduce_fn`` (e.g. ``lambda x: jax.lax.psum(x, "data")``) makes the
+    grower row-shard-aware for use under ``shard_map``: root statistics and
+    every wave's kernel histograms are globally reduced, so all devices
+    take identical split decisions while each histograms only its local
+    rows — the composition of the Pallas kernel with XLA collectives that
+    is this framework's data-parallel mode (reference:
+    data_parallel_tree_learner.cpp:119-164).
 
     ``interpret`` runs the Pallas kernel in interpreter mode so the wave
     path is testable on CPU (the analog of the reference's
@@ -283,6 +292,10 @@ def build_wave_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
                                       slot_leaf, B=B, block_rows=block_rows,
                                       highest=highest,
                                       interpret=interpret)  # [F, B, C]
+            if reduce_fn is not None:
+                # global histograms: every device now sees the same wave
+                # result and takes identical split decisions
+                hw = reduce_fn(hw)
             Fdim = hw.shape[0]
             ws = hw[:, :, :3 * P].reshape(Fdim, B, P, 3).transpose(2, 0, 1, 3)
 
@@ -340,6 +353,10 @@ def build_wave_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
         sum_g = jnp.sum(gv)
         sum_h = jnp.sum(hv)
         cnt = jnp.sum(cv)
+        if reduce_fn is not None:
+            sum_g = reduce_fn(sum_g)
+            sum_h = reduce_fn(sum_h)
+            cnt = reduce_fn(cnt)
 
         Lf = jnp.zeros((L + 1,), jnp.float32)
         Li = jnp.zeros((L + 1,), jnp.int32)

@@ -244,7 +244,11 @@ class BinnedDataset:
             num_cols = [int(j) for j in used
                         if self.bin_mappers[int(j)].bin_type == BIN_NUMERICAL]
             if num_cols:
-                dt = np.ascontiguousarray(data[:, num_cols].T)
+                # fill a preallocated transpose column-by-column: one extra
+                # copy of the numerical submatrix, never two at once
+                dt = np.empty((len(num_cols), self.num_data), np.float64)
+                for r, j in enumerate(num_cols):
+                    dt[r] = data[:, j]
                 dt_row = {j: r for r, j in enumerate(num_cols)}
         for inner, j in enumerate(used):
             m = self.bin_mappers[int(j)]

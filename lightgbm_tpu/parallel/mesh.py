@@ -188,3 +188,100 @@ def make_feature_parallel_grower(meta: DeviceMeta, cfg: SplitConfig, B: int,
     grow = build_grow_fn(meta, cfg, B, hist_fn=local_hist,
                          best_split_fn=synced_best_split)
     return _shard_map(grow, mesh, (P(), P(), P(), P(), P()), (P(), P()))
+
+
+def make_data_parallel_wave_grower(meta: DeviceMeta, cfg: SplitConfig, B: int,
+                                   mesh: Mesh, **wave_kw):
+    """Row-sharded WAVE growth: the Pallas kernel histograms local rows,
+    psum makes the result global, every device replays identical split
+    decisions (reference: data_parallel_tree_learner.cpp composed with the
+    GPU learner's kernel).  Takes feature-major bins [F, N] sharded on the
+    row axis."""
+    from ..core.wave_grower import build_wave_grow_fn
+    grow = build_wave_grow_fn(meta, cfg, B, reduce_fn=_psum, **wave_kw)
+    return _shard_map(grow, mesh,
+                      (P(None, AXIS), P(AXIS), P(AXIS), P(AXIS), P()),
+                      (P(), P(AXIS)))
+
+
+def build_mesh(tpu_mesh_shape: str = "") -> Mesh:
+    """Mesh over the available devices; ``tpu_mesh_shape`` ("data:8")
+    optionally caps the device count on the data axis."""
+    import jax
+
+    from ..utils import log
+    devices = jax.devices()
+    n = len(devices)
+    if tpu_mesh_shape:
+        for part in tpu_mesh_shape.split(","):
+            name, _, cnt = part.partition(":")
+            if name.strip() == AXIS and cnt:
+                try:
+                    want = int(cnt)
+                except ValueError:
+                    log.fatal(f"tpu_mesh_shape count is not an integer: "
+                              f"{tpu_mesh_shape!r}")
+                if want < 1:
+                    log.fatal(f"tpu_mesh_shape needs at least 1 device on "
+                              f"'{AXIS}', got {want}")
+                n = min(n, want)
+    return Mesh(np.asarray(devices[:n]), (AXIS,))
+
+
+def make_engine_grower(mode: str, meta: DeviceMeta, cfg: SplitConfig, B: int,
+                       mesh: Mesh, wave_kw=None, top_k: int = 20):
+    """Engine-facing TreeLearner factory for the parallel modes (reference:
+    tree_learner.cpp:13-36): wraps the mesh growers behind the serial
+    signature ``grow(bins, g, h, mask, fmask) -> (tree, leaf_id)`` on
+    UNsharded inputs — row padding to a mesh multiple, resharding, and the
+    unpad of leaf_id all happen inside the jitted wrapper.
+
+    ``mode``: "data" (wave kernel when wave_kw given, else XLA one-hot),
+    "voting", or "feature".  Bins are feature-major [F, N] for the wave
+    path, row-major [N, F] otherwise.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    D = mesh.devices.size
+    if mode == "data" and wave_kw is not None:
+        inner = make_data_parallel_wave_grower(meta, cfg, B, mesh, **wave_kw)
+        feature_major = True
+    elif mode == "data":
+        inner = make_data_parallel_grower(meta, cfg, B, mesh)
+        feature_major = False
+    elif mode == "voting":
+        inner = make_voting_parallel_grower(meta, cfg, B, mesh, top_k=top_k)
+        feature_major = False
+    elif mode == "feature":
+        # replicated inputs — no padding or resharding needed
+        return make_feature_parallel_grower(meta, cfg, B, mesh)
+    else:
+        raise ValueError(f"unknown parallel mode: {mode}")
+
+    row_axis = 1 if feature_major else 0
+
+    def grow(bins, g, h, mask, fmask):
+        # the engine pre-pads the constant bin matrix once (engine_pad_bins)
+        # — only the per-iteration row vectors are padded here
+        N = g.shape[0]
+        pad = bins.shape[row_axis] - N
+        if pad:
+            g = jnp.pad(g, (0, pad))
+            h = jnp.pad(h, (0, pad))
+            mask = jnp.pad(mask, (0, pad))  # mask 0: padded rows inert
+        tree, leaf_id = inner(bins, g, h, mask, fmask)
+        return tree, leaf_id[:N]
+
+    return jax.jit(grow)
+
+
+def engine_pad_bins(bins: np.ndarray, D: int, feature_major: bool):
+    """Pad the host bin matrix's row axis to a multiple of the mesh size —
+    done ONCE at engine init so the per-iteration grow never copies it."""
+    axis = 1 if feature_major else 0
+    pad = (-bins.shape[axis]) % D
+    if pad == 0:
+        return bins
+    widths = [(0, 0), (0, pad)] if feature_major else [(0, pad), (0, 0)]
+    return np.pad(bins, widths)
