@@ -1,0 +1,5 @@
+"""``python -m lightgbm_tpu task=... conf=...`` (reference: src/main.cpp)."""
+from .app import main
+
+if __name__ == "__main__":
+    main()

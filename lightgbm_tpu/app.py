@@ -1,0 +1,131 @@
+"""CLI application driver: ``python -m lightgbm_tpu task=train conf=...``.
+
+The analog of the reference CLI (reference: src/main.cpp,
+src/application/application.cpp:48-81 task dispatch, :198-218 Train with
+snapshots, :221-247 Predict).  Arguments are ``key=value`` pairs; a
+``config=FILE`` pair loads a LightGBM .conf file, with command-line pairs
+taking precedence (reference: config.cpp Config::Set ordering).
+"""
+from __future__ import annotations
+
+import sys
+from typing import Dict, List
+
+import numpy as np
+
+from .basic import Booster, Dataset
+from .config import Config, read_config_file
+from .engine import train as train_api
+from .io.text_loader import load_text
+from .utils import log
+
+
+def _parse_args(argv: List[str]) -> Dict[str, str]:
+    params: Dict[str, str] = {}
+    conf_file = None
+    for arg in argv:
+        k, eq, v = arg.partition("=")
+        if not eq:
+            log.fatal(f"Unknown argument {arg!r}; expected key=value")
+        k = k.strip()
+        if k in ("config", "config_file", "conf"):
+            conf_file = v.strip()
+        else:
+            params[k] = v.strip()
+    if conf_file:
+        file_params = read_config_file(conf_file)
+        for k, v in file_params.items():
+            params.setdefault(k, v)  # CLI pairs win
+    return params
+
+
+def _dataset_from_file(path: str, cfg: Config, params: Dict,
+                       reference=None) -> Dataset:
+    X, label, weight, group, names = load_text(path, cfg)
+    ds = Dataset(X, label=label, weight=weight, group=group,
+                 feature_name=names, params=dict(params),
+                 reference=reference)
+    return ds
+
+
+def run_train(cfg: Config, params: Dict) -> None:
+    train_set = _dataset_from_file(cfg.data, cfg, params)
+    valid_sets, valid_names = [], []
+    for i, vpath in enumerate(cfg.valid):
+        valid_sets.append(_dataset_from_file(vpath, cfg, params,
+                                             reference=train_set))
+        valid_names.append(f"valid_{i + 1}" if len(cfg.valid) > 1 else "valid")
+
+    from . import callback
+    cbs = []
+    if cfg.metric_freq > 0 and (valid_sets or cfg.is_provide_training_metric):
+        cbs.append(callback.print_evaluation(period=cfg.metric_freq))
+    if cfg.snapshot_freq > 0:
+        # reference: gbdt.cpp:290-294 — save <output_model>.snapshot_iter_N
+        def snapshot_cb(env):
+            it = env.iteration + 1
+            if it % cfg.snapshot_freq == 0:
+                out = f"{cfg.output_model}.snapshot_iter_{it}"
+                env.model.save_model(out)
+                log.info("Saved snapshot to %s", out)
+        snapshot_cb.order = 100
+        cbs.append(snapshot_cb)
+
+    if cfg.is_provide_training_metric:
+        valid_sets = [train_set] + valid_sets
+        valid_names = ["training"] + valid_names
+
+    init_model = cfg.input_model or None
+    bst = train_api(params, train_set,
+                    num_boost_round=int(cfg.num_iterations),
+                    valid_sets=valid_sets or None,
+                    valid_names=valid_names or None,
+                    init_model=init_model,
+                    early_stopping_rounds=(cfg.early_stopping_round
+                                           if cfg.early_stopping_round > 0
+                                           else None),
+                    verbose_eval=False,
+                    callbacks=cbs)
+    bst.save_model(cfg.output_model)
+    log.info("Finished training; model saved to %s", cfg.output_model)
+
+
+def run_predict(cfg: Config, params: Dict) -> None:
+    if not cfg.input_model:
+        log.fatal("task=predict needs input_model")
+    bst = Booster(model_file=cfg.input_model)
+    X, _, _, _, _ = load_text(cfg.data, cfg)
+    num_it = cfg.num_iteration_predict if cfg.num_iteration_predict > 0 else None
+    pred = bst.predict(X, num_iteration=num_it,
+                       raw_score=bool(cfg.predict_raw_score),
+                       pred_leaf=bool(cfg.predict_leaf_index),
+                       pred_contrib=bool(cfg.predict_contrib))
+    pred = np.atleast_1d(pred)
+    fmt = "%d" if pred.dtype.kind in "iu" else "%.18g"
+    np.savetxt(cfg.output_result, pred, fmt=fmt, delimiter="\t")
+    log.info("Finished prediction; results saved to %s", cfg.output_result)
+
+
+def run_refit(cfg: Config, params: Dict) -> None:
+    if not cfg.input_model:
+        log.fatal("task=refit needs input_model")
+    bst = Booster(model_file=cfg.input_model)
+    X, label, _, _, _ = load_text(cfg.data, cfg)
+    new_bst = bst.refit(X, label, decay_rate=cfg.refit_decay_rate)
+    new_bst.save_model(cfg.output_model)
+    log.info("Finished refit; model saved to %s", cfg.output_model)
+
+
+def main(argv=None) -> None:
+    argv = sys.argv[1:] if argv is None else argv
+    params = _parse_args(argv)
+    cfg = Config.from_params(params)
+    task = cfg.task
+    if task == "train":
+        run_train(cfg, params)
+    elif task in ("predict", "prediction", "test"):
+        run_predict(cfg, params)
+    elif task == "refit":
+        run_refit(cfg, params)
+    else:
+        log.fatal(f"Unknown task {task!r} (supported: train, predict, refit)")

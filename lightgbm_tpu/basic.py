@@ -308,6 +308,27 @@ class Booster:
         self._gbdt.rollback_one_iter()
         return self
 
+    def refit(self, data, label, decay_rate: float = 0.9,
+              **kwargs) -> "Booster":
+        """Refit this model's tree structures to new data: leaf outputs
+        become ``decay_rate * old + (1 - decay_rate) * new`` (reference:
+        basic.py:2547 Booster.refit -> GBDT::RefitTree gbdt.cpp:298-321)."""
+        import copy
+        if self._gbdt is None or self._gbdt.num_trees == 0:
+            raise LightGBMError("Cannot refit an empty model")
+        if getattr(self._gbdt, "objective", None) is None:
+            raise LightGBMError("Cannot refit due to null objective function.")
+        params = dict(self.params or {})
+        params["refit_decay_rate"] = decay_rate
+        params.update(kwargs)
+        new_set = Dataset(data, label=label, params=params)
+        nb = Booster(params=params, train_set=new_set)
+        nb._gbdt.load_initial_models(
+            [copy.deepcopy(t) for t in self._gbdt.models],
+            replay_scores=False)  # refit rebuilds scores from scratch
+        nb._gbdt.refit_models(decay_rate)
+        return nb
+
     def current_iteration(self) -> int:
         return self._gbdt.current_iteration()
 

@@ -324,7 +324,8 @@ class GBDT:
     def _tree_to_device(self, tree: Tree) -> TreeArrays:
         """Host Tree -> device arrays (bin space) for score replay."""
         import jax.numpy as jnp
-        L = self.split_cfg.num_leaves
+        # init_model forests may carry more leaves than this run's config
+        L = max(self.split_cfg.num_leaves, tree.num_leaves)
         n = max(L - 1, 1)
         nl = tree.num_leaves
         nn = max(nl - 1, 0)
@@ -335,16 +336,43 @@ class GBDT:
             return jnp.asarray(out)
 
         dl = np.array([(tree.decision_type[i] & 2) != 0 for i in range(nn)], bool)
-        # rebuild the bin-space category bitsets from the value-space model
-        # storage (inverse of Tree.from_device's translation)
+        # bin-space split state from the value-space model: thresholds via
+        # value_to_bin (exact inverse of bin_to_value — bounds are strictly
+        # ascending) and category bitsets via categorical_2_bin (inverse of
+        # Tree.from_device's translation); model text carries no bin indices
         from ..core.splitter import bitset_words
         W = bitset_words(self.B)
         cat_bits = np.zeros((max(n, 1), W), np.uint32)
         inner_feats = self._inner_features(tree)
+        is_cat0 = bool(np.asarray(self.meta.is_categorical)[0]) \
+            if self.train_ds.num_features > 0 else False
+        thr_bin = np.zeros(nn, np.int32)
         for i in range(nn):
-            if not tree.is_categorical(i):
+            inner = int(inner_feats[i])
+            if inner < 0:
+                # the split feature is trivial (constant) in THIS dataset —
+                # every row takes the side its constant value decides in
+                # value space; rewrite the node as an always-one-way split
+                # on inner feature 0 (the reference keeps trivial features
+                # binned so DataToBin handles this implicitly)
+                orig = int(tree.split_feature[i])
+                const = float(self.train_ds.bin_mappers[orig].min_val)
+                go_left = bool(tree._decide(np.asarray([const]),
+                                            np.asarray([i]))[0])
+                inner_feats[i] = 0
+                dl[i] = go_left
+                if is_cat0:
+                    # membership decides: all-ones bitset -> left, zeros -> right
+                    cat_bits[i, :] = np.uint32(0xFFFFFFFF) if go_left else 0
+                    # mark the node categorical for go_left_node dispatch:
+                    # handled via meta.is_categorical[0], nothing else needed
+                else:
+                    thr_bin[i] = np.int32(self.B if go_left else -1)
                 continue
-            mapper = self.train_ds.inner_to_mapper(int(inner_feats[i]))
+            mapper = self.train_ds.inner_to_mapper(inner)
+            if not tree.is_categorical(i):
+                thr_bin[i] = int(mapper.value_to_bin(float(tree.threshold[i])))
+                continue
             ci = int(tree.threshold[i])
             lo, hi = int(tree.cat_boundaries[ci]), int(tree.cat_boundaries[ci + 1])
             for cat, b in mapper.categorical_2_bin.items():
@@ -353,8 +381,8 @@ class GBDT:
                         (int(tree.cat_threshold[lo + word]) >> (cat % 32)) & 1:
                     cat_bits[i, b // 32] |= np.uint32(1 << (b % 32))
         return TreeArrays(
-            split_feature=pad(self._inner_features(tree), n, -1, np.int32),
-            threshold_bin=pad(tree.threshold_bin[:nn], n, 0, np.int32),
+            split_feature=pad(inner_feats, n, -1, np.int32),
+            threshold_bin=pad(thr_bin, n, 0, np.int32),
             default_left=pad(dl, n, False, np.bool_),
             left_child=pad(tree.left_child[:nn], n, 0, np.int32),
             right_child=pad(tree.right_child[:nn], n, 0, np.int32),
@@ -597,6 +625,80 @@ class GBDT:
         ok = ~np.isnan(new_vals)
         lv[:nl][ok] = new_vals[ok]
         return arrs._replace(leaf_value=jnp.asarray(lv))
+
+    # ------------------------------------------------------------------
+    def load_initial_models(self, models: List[Tree],
+                            replay_scores: bool = True) -> None:
+        """Continued training: seed this trainer with an existing forest and
+        replay it onto the train (and any valid) scores, so subsequent
+        iterations boost from where the loaded model left off (reference:
+        Boosting::LoadFileToBoosting + GBDT::ResetTrainingData,
+        boosting.cpp:35-69).  ``replay_scores=False`` skips the per-tree
+        score traversal for callers that rebuild scores anyway (refit)."""
+        K = self.num_tpi
+        if len(models) % K != 0:
+            log.fatal(f"init model has {len(models)} trees, not a multiple "
+                      f"of num_tree_per_iteration={K}")
+        list.extend(self.models, models)
+        self.iter_ = len(models) // K
+        if not replay_scores:
+            return
+        for i, tree in enumerate(models):
+            k = i % K
+            arrs = self._tree_to_device(tree)
+            self._train_score = self._train_score.at[:, k].set(
+                self._traverse_add(self._train_score[:, k], arrs, self._bins))
+            for v in range(len(self._valid_scores)):
+                self._valid_scores[v] = self._valid_scores[v].at[:, k].set(
+                    self._traverse_add(self._valid_scores[v][:, k], arrs,
+                                       self._valid_bins[v]))
+
+    def refit_models(self, decay_rate: Optional[float] = None) -> None:
+        """Refit the existing tree STRUCTURES to this trainer's (new) data:
+        sequentially recompute each tree's leaf outputs from the current
+        gradients, mixing old and new by ``refit_decay_rate`` (reference:
+        GBDT::RefitTree gbdt.cpp:298-321 +
+        SerialTreeLearner::FitByExistingTree serial_tree_learner.cpp:239-264).
+        Call load_initial_models first; scores are rebuilt from scratch."""
+        import jax.numpy as jnp
+        decay = float(self.config.refit_decay_rate
+                      if decay_rate is None else decay_rate)
+        K = self.num_tpi
+        cfg = self.split_cfg
+        trees = list(self.models)  # materialize
+        # reset scores; rebuild as we walk the forest — gradients computed
+        # ONCE per boosting iteration, before any of its K class trees
+        # (reference calls Boosting() once per iter, gbdt.cpp:303)
+        self._train_score = jnp.zeros_like(self._train_score)
+        for it in range(len(trees) // K):
+            g, h = self._grad_fn(self._train_score)
+            for k in range(K):
+                tree = trees[it * K + k]
+                gk = np.asarray(g[:, k], np.float64)
+                hk = np.asarray(h[:, k], np.float64)
+                arrs = self._tree_to_device(tree)
+                leaf = np.asarray(predict_leaf_bins(arrs, self._bins,
+                                                    self.meta))
+                nl = tree.num_leaves
+                sum_g = np.bincount(leaf, weights=gk, minlength=nl)[:nl]
+                sum_h = (np.bincount(leaf, weights=hk, minlength=nl)[:nl]
+                         + K_EPSILON)
+                # CalculateSplittedLeafOutput with L1/L2/max_delta_step
+                sg = np.sign(sum_g) * np.maximum(
+                    np.abs(sum_g) - cfg.lambda_l1, 0.0)
+                out = -sg / (sum_h + cfg.lambda_l2)
+                if cfg.max_delta_step > 0:
+                    out = np.clip(out, -cfg.max_delta_step, cfg.max_delta_step)
+                new_lv = decay * tree.leaf_value[:nl] + \
+                    (1.0 - decay) * out * tree.shrinkage
+                tree.leaf_value = new_lv.astype(np.float64)
+                arrs = arrs._replace(
+                    leaf_value=jnp.asarray(
+                        np.pad(new_lv, (0, arrs.leaf_value.shape[0] - nl))
+                    ).astype(jnp.float32))
+                self._train_score = self._train_score.at[:, k].set(
+                    self._apply_leaf(self._train_score[:, k],
+                                     jnp.asarray(leaf), arrs.leaf_value))
 
     # ------------------------------------------------------------------
     def rollback_one_iter(self) -> None:

@@ -42,12 +42,25 @@ def train(params: Dict[str, Any], train_set: Dataset,
     if categorical_feature != "auto":
         train_set.categorical_feature = categorical_feature
 
+    init_trees = None
     if init_model is not None:
-        raise LightGBMError("init_model / continued training requires "
-                            "loading support; pass a Booster via "
-                            "keep_training_booster instead")
+        # continued training (reference: boosting.cpp:35-69 — a model file
+        # or Booster seeds the forest and scores before the first iteration)
+        if isinstance(init_model, Booster):
+            init_trees = list(init_model._gbdt.models)
+        elif isinstance(init_model, (str, bytes)) or hasattr(init_model,
+                                                             "__fspath__"):
+            import os
+            from .io.model_io import load_model_file
+            loaded, _ = load_model_file(os.fsdecode(init_model))
+            init_trees = list(loaded.models)
+        else:
+            raise TypeError("init_model should be a Booster or a model "
+                            f"file path, met {type(init_model).__name__}")
 
     booster = Booster(params=params, train_set=train_set)
+    if init_trees:
+        booster._gbdt.load_initial_models(init_trees)
     is_valid_contain_train = False
     train_data_name = "training"
     if valid_sets is not None:

@@ -1,0 +1,157 @@
+"""Text dataset loading for the CLI driver.
+
+The analog of the reference's DatasetLoader text path (reference:
+src/io/dataset_loader.cpp:168,807-1042): dense TSV/CSV files with the
+label in a configurable column, optional header, weight/group columns, and
+the ``<data>.weight`` / ``<data>.query`` sidecar files.  Sparse LibSVM
+input is not supported (the TPU path is dense; see io/dataset.py).
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..utils import log
+
+
+def _sniff_delimiter(line: str) -> str:
+    for d in ("\t", ",", " "):
+        if d in line:
+            return d
+    return "\t"
+
+
+def _resolve_column(spec: str, names: List[str], what: str) -> Optional[int]:
+    """Column spec: "" -> None, "3" -> 3, "name:foo" -> index of foo
+    (reference: dataset_loader.cpp column-by-name needs a header)."""
+    if spec == "":
+        return None
+    if spec.startswith("name:"):
+        name = spec[5:]
+        if name not in names:
+            log.fatal(f"{what} column {name!r} not found in header")
+        return names.index(name)
+    try:
+        return int(spec)
+    except ValueError:
+        log.fatal(f"Bad {what} column spec {spec!r}")
+
+
+def load_text(path: str, config) -> Tuple[np.ndarray, Optional[np.ndarray],
+                                          Optional[np.ndarray],
+                                          Optional[np.ndarray], List[str]]:
+    """Load a dense text data file.
+
+    Returns (X, label, weight, group, feature_names); label/weight/group
+    are None when absent.  Column indices in the config count ALL file
+    columns (label included), like the reference.
+    """
+    if not os.path.exists(path):
+        log.fatal(f"Data file {path} does not exist")
+    with open(path) as fh:
+        first = fh.readline()
+    if ":" in first and not getattr(config, "header", False):
+        return _load_libsvm(path, config)
+    delim = _sniff_delimiter(first.rstrip("\n"))
+    names: List[str] = []
+    skip = 0
+    if getattr(config, "header", False):
+        names = [t.strip() for t in first.rstrip("\n").split(delim)]
+        skip = 1
+    data = np.loadtxt(path, delimiter=None if delim == " " else delim,
+                      skiprows=skip, ndmin=2, dtype=np.float64)
+    ncol = data.shape[1]
+    if not names:
+        names = [f"Column_{i}" for i in range(ncol)]
+
+    label_col = _resolve_column(getattr(config, "label_column", ""),
+                                names, "label")
+    if label_col is None:
+        label_col = 0
+    weight_col = _resolve_column(getattr(config, "weight_column", ""),
+                                 names, "weight")
+    group_col = _resolve_column(getattr(config, "group_column", ""),
+                                names, "group")
+
+    drop = {label_col}
+    if weight_col is not None:
+        drop.add(weight_col)
+    if group_col is not None:
+        drop.add(group_col)
+    ignore = getattr(config, "ignore_column", "")
+    if ignore:
+        for tok in str(ignore).split(","):
+            c = _resolve_column(tok.strip(), names, "ignore")
+            if c is not None:
+                drop.add(c)
+
+    label = data[:, label_col]
+    weight = data[:, weight_col] if weight_col is not None else None
+    group_raw = data[:, group_col] if group_col is not None else None
+    keep = [i for i in range(ncol) if i not in drop]
+    X = data[:, keep]
+    feat_names = [names[i] for i in keep]
+
+    weight, group = _load_sidecars(path, weight, None)
+    return X, label, weight, group if group is not None else _group_from_col(
+        group_raw), feat_names
+
+
+def _group_from_col(group_raw):
+    if group_raw is None:
+        return None
+    # per-row query ids -> query sizes (reference converts ordered ids)
+    ids = group_raw.astype(np.int64)
+    change = np.flatnonzero(np.diff(ids)) + 1
+    bounds = np.concatenate([[0], change, [len(ids)]])
+    return np.diff(bounds)
+
+
+def _load_libsvm(path: str, config):
+    """Sparse ``label idx:val ...`` rows, densified (missing entries are
+    0.0, which the zero-bin handling treats natively; reference:
+    dataset_loader.cpp sparse parser)."""
+    labels: List[float] = []
+    rows: List[List[Tuple[int, float]]] = []
+    max_idx = -1
+    with open(path) as fh:
+        for line in fh:
+            toks = line.split()
+            if not toks:
+                continue
+            labels.append(float(toks[0]))
+            pairs = []
+            for tok in toks[1:]:
+                i, _, v = tok.partition(":")
+                idx = int(i)
+                pairs.append((idx, float(v)))
+                if idx > max_idx:
+                    max_idx = idx
+            rows.append(pairs)
+    X = np.zeros((len(rows), max_idx + 1), dtype=np.float64)
+    for r, pairs in enumerate(rows):
+        for idx, v in pairs:
+            X[r, idx] = v
+    label = np.asarray(labels)
+    names = [f"Column_{i}" for i in range(max_idx + 1)]
+    weight, group = _load_sidecars(path, None, None)
+    return X, label, weight, group, names
+
+
+def _load_sidecars(path: str, weight, group):
+    """``<data>.weight`` / ``<data>.query`` / ``<data>.group`` files
+    (reference: dataset_loader.cpp LoadWeights/LoadQueryBoundaries)."""
+    wpath = path + ".weight"
+    if weight is None and os.path.exists(wpath):
+        weight = np.loadtxt(wpath, dtype=np.float64, ndmin=1)
+        log.info("Loading weights from %s", wpath)
+    if group is None:
+        for suffix in (".query", ".group"):
+            qpath = path + suffix
+            if os.path.exists(qpath):
+                group = np.loadtxt(qpath, dtype=np.int64, ndmin=1)
+                log.info("Loading query boundaries from %s", qpath)
+                break
+    return weight, group

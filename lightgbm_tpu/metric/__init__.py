@@ -10,8 +10,8 @@ from __future__ import annotations
 from typing import List, Optional
 
 from ..utils import log
-from .basic import (BinaryErrorMetric, BinaryLoglossMetric, AUCMetric,
-                    CrossEntropyMetric, CrossEntropyLambdaMetric,
+from .basic import (AucMuMetric, BinaryErrorMetric, BinaryLoglossMetric,
+                    AUCMetric, CrossEntropyMetric, CrossEntropyLambdaMetric,
                     FairMetric, GammaDevianceMetric, GammaMetric,
                     HuberMetric, KLDivMetric, L1Metric, L2Metric, MAPEMetric,
                     Metric, MultiErrorMetric, MultiLoglossMetric,
@@ -35,6 +35,7 @@ _METRICS = {
     "auc": AUCMetric,
     "multi_logloss": MultiLoglossMetric,
     "multi_error": MultiErrorMetric,
+    "auc_mu": AucMuMetric,
     "cross_entropy": CrossEntropyMetric,
     "cross_entropy_lambda": CrossEntropyLambdaMetric,
     "kullback_leibler": KLDivMetric,

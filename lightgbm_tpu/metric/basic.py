@@ -232,6 +232,65 @@ class MultiErrorMetric(Metric):
         return [(self.name, self._avg((pred != lab).astype(np.float64)), False)]
 
 
+class AucMuMetric(Metric):
+    """Multi-class AUC-mu of Kleiman & Page (reference:
+    multiclass_metric.hpp:183-294): averages pairwise class-separation
+    AUCs measured along partition-weight difference directions.  Sample
+    weights are ignored — faithful to the reference, whose AucMuMetric
+    never reads Metadata::weights (unlike its logloss/error siblings)."""
+    name = "auc_mu"
+    higher_is_better = True
+    K_EPS = 1e-15
+
+    def eval(self, score, objective) -> List[EvalResult]:
+        score = np.asarray(score)
+        K = int(self.config.num_class)
+        lab = self.label.astype(np.int64)
+        w = self.config.auc_mu_weights
+        if w:
+            W = np.asarray(w, np.float64).reshape(K, K)
+        else:
+            W = 1.0 - np.eye(K)
+        total = 0.0
+        for i in range(K):
+            idx_i = np.flatnonzero(lab == i)
+            for j in range(i + 1, K):
+                idx_j = np.flatnonzero(lab == j)
+                if len(idx_i) == 0 or len(idx_j) == 0:
+                    continue
+                v = W[i] - W[j]                      # [K]
+                t1 = v[i] - v[j]
+                rows = np.concatenate([idx_i, idx_j])
+                dist = t1 * (score[rows] @ v)
+                is_i = np.concatenate([np.ones(len(idx_i), bool),
+                                       np.zeros(len(idx_j), bool)])
+                # ascending by distance; class j first on (exact) ties —
+                # the epsilon-chained tie handling follows in the scan
+                order = np.lexsort((is_i, dist))
+                d_s, i_s = dist[order], is_i[order]
+                s_ij = 0.0
+                num_j = 0.0
+                last_j_dist = 0.0
+                num_cur_j = 0.0
+                for k in range(len(d_s)):
+                    if i_s[k]:
+                        if abs(d_s[k] - last_j_dist) < self.K_EPS:
+                            # class-j members at this distance count half
+                            s_ij += num_j - 0.5 * num_cur_j
+                        else:
+                            s_ij += num_j
+                    else:
+                        num_j += 1.0
+                        if abs(d_s[k] - last_j_dist) < self.K_EPS:
+                            num_cur_j += 1.0
+                        else:
+                            last_j_dist = d_s[k]
+                            num_cur_j = 1.0
+                total += s_ij / (len(idx_i) * len(idx_j))
+        value = 2.0 * total / (K * (K - 1)) if K > 1 else 1.0
+        return [(self.name, float(value), True)]
+
+
 class CrossEntropyMetric(_PointwiseRegression):
     """(reference: xentropy_metric.hpp:71-163)."""
     name = "cross_entropy"

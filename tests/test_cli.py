@@ -1,0 +1,119 @@
+"""CLI driver + text loader + auc_mu
+(reference: src/main.cpp, application.cpp:48-81, dataset_loader.cpp)."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_cli(args, cwd):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run([sys.executable, "-m", "lightgbm_tpu"] + args,
+                       cwd=cwd, env=env, capture_output=True, text=True,
+                       timeout=420)
+    assert r.returncode == 0, r.stderr[-2000:]
+    return r
+
+
+@pytest.fixture(scope="module")
+def workdir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("cli")
+    rng = np.random.default_rng(0)
+    N = 800
+    X = rng.normal(size=(N, 4))
+    y = (X[:, 0] + X[:, 1] > 0).astype(int)
+    np.savetxt(d / "data.train", np.column_stack([y, X]), delimiter="\t",
+               fmt="%.8f")
+    np.savetxt(d / "data.test", np.column_stack([y, X])[:200], delimiter="\t",
+               fmt="%.8f")
+    (d / "train.conf").write_text(
+        "task = train\nobjective = binary\ndata = data.train\n"
+        "valid_data = data.test\nmetric = auc\nnum_trees = 8\n"
+        "num_leaves = 15\nmin_data_in_leaf = 5\n"
+        "output_model = model.txt\nverbosity = -1\n")
+    return d
+
+
+def test_cli_train_predict_matches_python_api(workdir):
+    _run_cli(["config=train.conf"], workdir)
+    assert (workdir / "model.txt").exists()
+    _run_cli(["task=predict", "data=data.test", "input_model=model.txt",
+              "output_result=pred.txt"], workdir)
+    pred_cli = np.loadtxt(workdir / "pred.txt")
+
+    bst = lgb.Booster(model_file=str(workdir / "model.txt"))
+    data = np.loadtxt(workdir / "data.test", delimiter="\t")
+    np.testing.assert_allclose(bst.predict(data[:, 1:]), pred_cli, atol=1e-10)
+
+
+def test_cli_snapshots_and_continue(workdir):
+    _run_cli(["config=train.conf", "num_trees=4", "snapshot_freq=2",
+              "output_model=m2.txt"], workdir)
+    assert (workdir / "m2.txt.snapshot_iter_2").exists()
+    # continued training from the snapshot
+    _run_cli(["config=train.conf", "num_trees=4",
+              "input_model=m2.txt", "output_model=m_cont.txt"], workdir)
+    b = lgb.Booster(model_file=str(workdir / "m_cont.txt"))
+    assert b.num_trees() == 8
+
+
+def test_cli_overrides_beat_config_file(workdir):
+    _run_cli(["config=train.conf", "num_trees=3",
+              "output_model=m3.txt"], workdir)
+    b = lgb.Booster(model_file=str(workdir / "m3.txt"))
+    assert b.num_trees() == 3
+
+
+def test_text_loader_query_sidecar(tmp_path):
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.text_loader import load_text
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(30, 3))
+    y = rng.integers(0, 3, 30)
+    np.savetxt(tmp_path / "r.train", np.column_stack([y, X]), delimiter="\t")
+    (tmp_path / "r.train.query").write_text("10\n12\n8\n")
+    Xl, yl, w, group, names = load_text(str(tmp_path / "r.train"), Config())
+    assert Xl.shape == (30, 3)
+    np.testing.assert_array_equal(group, [10, 12, 8])
+    assert w is None
+
+
+def test_text_loader_libsvm(tmp_path):
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.text_loader import load_text
+    (tmp_path / "s.train").write_text(
+        "1 0:0.5 2:1.5\n0 1:2.0\n1 0:-1.0 1:3.0 2:0.25\n")
+    X, y, w, g, names = load_text(str(tmp_path / "s.train"), Config())
+    np.testing.assert_array_equal(y, [1, 0, 1])
+    np.testing.assert_allclose(
+        X, [[0.5, 0.0, 1.5], [0.0, 2.0, 0.0], [-1.0, 3.0, 0.25]])
+
+
+def test_auc_mu_matches_pairwise_auc_binary_case():
+    """With 2 classes and default weights, auc_mu reduces to plain AUC on
+    the score difference (the paper's Proposition 1 sanity case)."""
+    from sklearn.metrics import roc_auc_score
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.metric import AucMuMetric
+
+    rng = np.random.default_rng(2)
+    n = 400
+    y = rng.integers(0, 2, n)
+    score = np.column_stack([rng.normal(size=n), rng.normal(size=n)])
+    cfg = Config.from_params({"objective": "multiclass", "num_class": 2})
+    m = AucMuMetric(cfg)
+
+    class MD:
+        label = y.astype(np.float64)
+        weights = None
+    m.init(MD(), n)
+    (_, got, _), = m.eval(score, None)
+    want = roc_auc_score(y, score[:, 1] - score[:, 0])
+    assert abs(got - want) < 1e-9

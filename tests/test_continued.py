@@ -1,0 +1,144 @@
+"""Continued training, init_model, and refit
+(reference: boosting.cpp:35-69, gbdt.cpp:298-321, basic.py:2547)."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _problem(n=1500, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 6))
+    y = (X[:, 0] + X[:, 1] * X[:, 2] + 0.2 * rng.normal(size=n) > 0).astype(float)
+    return X, y
+
+
+PARAMS = {"objective": "binary", "num_leaves": 15, "learning_rate": 0.1,
+          "min_data_in_leaf": 5, "verbose": -1}
+
+
+def test_init_model_booster_equals_uninterrupted():
+    """train 10 then continue 10 == train 20 in one go (no bagging, so the
+    RNG stream doesn't matter)."""
+    X, y = _problem()
+    ds = lgb.Dataset(X, label=y, params=PARAMS)
+    b20 = lgb.train(PARAMS, ds, num_boost_round=20)
+
+    ds1 = lgb.Dataset(X, label=y, params=PARAMS)
+    b10 = lgb.train(PARAMS, ds1, num_boost_round=10)
+    assert b10.num_trees() == 10
+    ds2 = lgb.Dataset(X, label=y, params=PARAMS)
+    b_cont = lgb.train(PARAMS, ds2, num_boost_round=10, init_model=b10)
+    assert b_cont.num_trees() == 20
+    assert b_cont.current_iteration() == 20
+    np.testing.assert_allclose(b_cont.predict(X), b20.predict(X),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_init_model_file_roundtrip(tmp_path):
+    """save after 10, load the FILE as init_model, continue — same as the
+    booster-object path up to text-serialization rounding."""
+    X, y = _problem(seed=1)
+    ds1 = lgb.Dataset(X, label=y, params=PARAMS)
+    b10 = lgb.train(PARAMS, ds1, num_boost_round=10)
+    path = tmp_path / "m10.txt"
+    b10.save_model(str(path))
+
+    ds2 = lgb.Dataset(X, label=y, params=PARAMS)
+    b_cont = lgb.train(PARAMS, ds2, num_boost_round=10, init_model=str(path))
+    assert b_cont.num_trees() == 20
+
+    ds3 = lgb.Dataset(X, label=y, params=PARAMS)
+    b20 = lgb.train(PARAMS, ds3, num_boost_round=20)
+    np.testing.assert_allclose(b_cont.predict(X), b20.predict(X),
+                               rtol=1e-4, atol=1e-6)
+
+
+def test_init_model_multiclass():
+    rng = np.random.default_rng(2)
+    X = rng.normal(size=(900, 5))
+    y = (X[:, 0] > 0).astype(int) + (X[:, 1] > 0.5).astype(int)
+    params = {"objective": "multiclass", "num_class": 3, "num_leaves": 7,
+              "verbose": -1}
+    ds1 = lgb.Dataset(X, label=y.astype(float), params=params)
+    b5 = lgb.train(params, ds1, num_boost_round=5)
+    ds2 = lgb.Dataset(X, label=y.astype(float), params=params)
+    bc = lgb.train(params, ds2, num_boost_round=5, init_model=b5)
+    assert bc.num_trees() == 30  # 10 iters x 3 classes
+    ds3 = lgb.Dataset(X, label=y.astype(float), params=params)
+    b10 = lgb.train(params, ds3, num_boost_round=10)
+    np.testing.assert_allclose(bc.predict(X), b10.predict(X),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_refit_moves_leaf_values_toward_new_data():
+    X, y = _problem(seed=3)
+    ds = lgb.Dataset(X, label=y, params=PARAMS)
+    bst = lgb.train(PARAMS, ds, num_boost_round=10)
+
+    # refit on new data drawn from a SHIFTED distribution
+    X2, y2 = _problem(seed=4)
+    y2 = 1.0 - y2  # inverted labels: leaf values must move
+    rf = bst.refit(X2, y2, decay_rate=0.5)
+    assert rf.num_trees() == bst.num_trees()
+    # same structures
+    t_old = bst.model_to_string()
+    t_new = rf.model_to_string()
+    feats = lambda txt: [l for l in txt.splitlines()
+                         if l.startswith("split_feature=")]
+    assert feats(t_old) == feats(t_new)
+    # predictions moved toward the new labels
+    from sklearn.metrics import roc_auc_score
+    auc_old = roc_auc_score(y2, bst.predict(X2))
+    auc_new = roc_auc_score(y2, rf.predict(X2))
+    assert auc_new > auc_old
+
+    # decay_rate=1.0 keeps the model unchanged
+    rf1 = bst.refit(X2, y2, decay_rate=1.0)
+    np.testing.assert_allclose(rf1.predict(X), bst.predict(X), atol=1e-9)
+
+
+def test_refit_requires_objective():
+    X, y = _problem(seed=5)
+    ds = lgb.Dataset(X, label=y, params=PARAMS)
+    bst = lgb.train(dict(PARAMS), ds, num_boost_round=3)
+    gb = bst._gbdt
+    obj, gb.objective = gb.objective, None
+    try:
+        with pytest.raises(lgb.LightGBMError):
+            bst.refit(X, y)
+    finally:
+        gb.objective = obj
+
+
+def test_init_model_with_now_trivial_feature():
+    """A loaded tree splitting on a feature that is CONSTANT in the new
+    dataset must replay exactly: every row takes the side the constant
+    decides in value space (the reference keeps trivial features binned, so
+    DataToBin handles this implicitly)."""
+    X, y = _problem(seed=7)
+    ds1 = lgb.Dataset(X, label=y, params=PARAMS)
+    b1 = lgb.train(PARAMS, ds1, num_boost_round=8)
+    used = np.flatnonzero(b1._gbdt.feature_importance("split") > 0)
+    f = int(used[0])
+
+    # new data: feature f frozen at a constant that sends rows LEFT or
+    # RIGHT depending on the node; replay must equal host prediction
+    X2 = X.copy()
+    X2[:, f] = float(np.quantile(X[:, f], 0.25))
+    y2 = y
+    ds2 = lgb.Dataset(X2, label=y2, params=PARAMS)
+    bc = lgb.train(PARAMS, ds2, num_boost_round=1, init_model=b1)
+    gb = bc._gbdt
+    # the continued model's first 8 trees replayed onto scores must match
+    # host value-space prediction of the ORIGINAL model on X2
+    import jax.numpy as jnp
+    want = b1.predict(X2, raw_score=True)
+    # replay check: rebuild scores from scratch through _tree_to_device
+    score = np.zeros(len(X2))
+    from lightgbm_tpu.core.predict import predict_leaf_bins
+    for t in list(b1._gbdt.models):
+        arrs = gb._tree_to_device(t)
+        leaf = np.asarray(predict_leaf_bins(arrs, gb._bins, gb.meta))
+        score += np.asarray(arrs.leaf_value)[leaf]
+    np.testing.assert_allclose(score, want, atol=1e-5)

@@ -1,0 +1,133 @@
+"""sklearn estimator wrappers
+(reference: python-package/lightgbm/sklearn.py:169-976)."""
+import numpy as np
+import pytest
+
+from lightgbm_tpu import LGBMClassifier, LGBMModel, LGBMRanker, LGBMRegressor
+
+PARAMS = dict(n_estimators=10, num_leaves=15, min_child_samples=5)
+
+
+def _xy_clf(n=600, seed=0, classes=2):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 5))
+    if classes == 2:
+        y = np.where(X[:, 0] + X[:, 1] > 0, "pos", "neg")
+    else:
+        y = (X[:, 0] > 0).astype(int) + (X[:, 1] > 0.5).astype(int)
+    return X, y
+
+
+def test_classifier_binary_string_labels():
+    X, y = _xy_clf()
+    clf = LGBMClassifier(**PARAMS).fit(X, y)
+    assert set(clf.classes_) == {"neg", "pos"}
+    pred = clf.predict(X)
+    assert pred.dtype == np.asarray(y).dtype
+    assert (pred == y).mean() > 0.9
+    proba = clf.predict_proba(X)
+    assert proba.shape == (len(y), 2)
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, atol=1e-9)
+    assert clf.score(X, y) > 0.9
+    assert clf.n_features_ == 5
+    assert len(clf.feature_importances_) == 5
+
+
+def test_classifier_multiclass():
+    X, y = _xy_clf(classes=3, seed=1)
+    clf = LGBMClassifier(**PARAMS).fit(X, y)
+    assert clf.n_classes_ == 3
+    proba = clf.predict_proba(X)
+    assert proba.shape == (len(y), 3)
+    assert clf.score(X, y) > 0.85
+
+
+def test_regressor_r2():
+    rng = np.random.default_rng(2)
+    X = rng.normal(size=(600, 4))
+    y = X[:, 0] * 2 + X[:, 1] + 0.1 * rng.normal(size=600)
+    reg = LGBMRegressor(**PARAMS).fit(X, y)
+    assert reg.score(X, y) > 0.8
+    assert reg.objective is None  # constructor param untouched (clone safety)
+    assert reg.objective_ == "regression"
+
+
+def test_sklearn_clone_and_grid_search():
+    from sklearn.base import clone
+    from sklearn.model_selection import GridSearchCV
+    X, y = _xy_clf(n=300, seed=3)
+    base = LGBMClassifier(**PARAMS)
+    c = clone(base)
+    assert c.get_params() == base.get_params()
+    gs = GridSearchCV(LGBMClassifier(n_estimators=5, min_child_samples=5),
+                      {"num_leaves": [7, 15]}, cv=2, scoring="accuracy")
+    gs.fit(X, y)
+    assert gs.best_params_["num_leaves"] in (7, 15)
+    assert gs.best_score_ > 0.8
+
+
+def test_early_stopping_eval_set():
+    X, y = _xy_clf(n=800, seed=4)
+    clf = LGBMClassifier(n_estimators=100, num_leaves=15, min_child_samples=5)
+    clf.fit(X[:600], y[:600], eval_set=[(X[600:], y[600:])],
+            eval_metric="binary_logloss", early_stopping_rounds=5)
+    assert clf.best_iteration_ >= 1
+    assert "valid_0" in clf.evals_result_
+    assert "binary_logloss" in clf.evals_result_["valid_0"]
+
+
+def test_ranker_ndcg_improves():
+    rng = np.random.default_rng(5)
+    n_q, per_q = 40, 12
+    n = n_q * per_q
+    X = rng.normal(size=(n, 6))
+    rel = np.clip((X[:, 0] * 1.5 + 0.3 * rng.normal(size=n)).astype(int) % 4,
+                  0, 3)
+    group = np.full(n_q, per_q)
+    rk = LGBMRanker(n_estimators=20, num_leaves=7, min_child_samples=3)
+    rk.fit(X, rel, group=group, eval_set=[(X, rel)], eval_group=[group],
+           eval_at=(3,))
+    res = rk.evals_result_["valid_0"]
+    key = next(k for k in res if "ndcg" in k)
+    assert res[key][-1] > res[key][0], res[key]
+    assert rk.predict(X).shape == (n,)
+
+
+def test_ranker_requires_group():
+    X, y = _xy_clf(n=100, seed=6)
+    with pytest.raises(ValueError, match="group"):
+        LGBMRanker().fit(X, (np.asarray(y) == "pos").astype(int))
+
+
+def test_unfitted_raises():
+    from lightgbm_tpu import LightGBMError
+    with pytest.raises(LightGBMError):
+        LGBMClassifier().predict(np.zeros((2, 3)))
+
+
+def test_kwargs_passthrough():
+    X, y = _xy_clf(n=300, seed=7)
+    clf = LGBMClassifier(max_bin=63, **PARAMS)
+    assert clf.get_params()["max_bin"] == 63
+    clf.fit(X, y)
+    assert clf.score(X, y) > 0.8
+
+
+def test_plotting_smoke(tmp_path):
+    """plot_importance / plot_metric / split-value histogram / digraph
+    (reference: python-package/lightgbm/plotting.py)."""
+    import matplotlib
+    matplotlib.use("Agg")
+    import lightgbm_tpu as lgb
+    X, y = _xy_clf(n=400, seed=8)
+    clf = LGBMClassifier(**PARAMS)
+    clf.fit(X, y, eval_set=[(X, y)], eval_metric="binary_logloss")
+    ax = lgb.plot_importance(clf)
+    assert ax is not None
+    ax2 = lgb.plot_metric(clf)
+    assert ax2 is not None
+    used = int(np.flatnonzero(clf.feature_importances_ > 0)[0])
+    ax3 = lgb.plot_split_value_histogram(clf, used)
+    assert ax3 is not None
+    g = lgb.create_tree_digraph(clf, tree_index=0)
+    assert "leaf" in g.source
