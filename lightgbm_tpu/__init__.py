@@ -10,6 +10,17 @@ The public API mirrors the reference Python package
 (reference: python-package/lightgbm/__init__.py).
 """
 
+import os as _os
+
+# The container's sitecustomize pins jax's platform list at import time,
+# which silently overrides a JAX_PLATFORMS env var set by a parent process
+# (e.g. the test suite spawning the CLI with JAX_PLATFORMS=cpu). Re-apply
+# the env var so subprocess platform selection behaves as documented.
+if _os.environ.get("JAX_PLATFORMS"):
+    import jax as _jax
+
+    _jax.config.update("jax_platforms", _os.environ["JAX_PLATFORMS"])
+
 from .basic import Booster, Dataset
 from .config import Config
 from .engine import cv, train

@@ -106,6 +106,18 @@ def run_predict(cfg: Config, params: Dict) -> None:
     log.info("Finished prediction; results saved to %s", cfg.output_result)
 
 
+def run_convert_model(cfg: Config, params: Dict) -> None:
+    """task=convert_model: model file -> standalone if-else scoring code
+    (reference: Application::ConvertModel, application.cpp:233-241)."""
+    if not cfg.input_model:
+        log.fatal("task=convert_model needs input_model")
+    bst = Booster(model_file=cfg.input_model)
+    code = bst.model_to_if_else()
+    with open(cfg.convert_model, "w") as fh:
+        fh.write(code)
+    log.info("Finished converting model; code saved to %s", cfg.convert_model)
+
+
 def run_refit(cfg: Config, params: Dict) -> None:
     if not cfg.input_model:
         log.fatal("task=refit needs input_model")
@@ -127,5 +139,8 @@ def main(argv=None) -> None:
         run_predict(cfg, params)
     elif task == "refit":
         run_refit(cfg, params)
+    elif task == "convert_model":
+        run_convert_model(cfg, params)
     else:
-        log.fatal(f"Unknown task {task!r} (supported: train, predict, refit)")
+        log.fatal(f"Unknown task {task!r} (supported: train, predict, "
+                  "convert_model, refit)")

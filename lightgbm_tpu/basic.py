@@ -414,9 +414,28 @@ class Booster:
             num_iteration = self.best_iteration if self.best_iteration > 0 else -1
         return model_to_string(self._gbdt, num_iteration, start_iteration)
 
+    def dump_model(self, num_iteration: Optional[int] = None,
+                   start_iteration: int = 0) -> Dict[str, Any]:
+        """Model as a nested dict, the reference's JSON dump structure
+        (reference: GBDT::DumpModel, gbdt_model_text.cpp:20-85; python
+        Booster.dump_model, basic.py:2243)."""
+        from .io.model_json import dump_model
+        if num_iteration is None:
+            num_iteration = self.best_iteration if self.best_iteration > 0 else -1
+        return dump_model(self._gbdt, num_iteration, start_iteration)
+
+    def model_to_if_else(self, num_iteration: Optional[int] = None) -> str:
+        """Standalone C scoring code for the forest (reference:
+        GBDT::ModelToIfElse, gbdt_model_text.cpp:88-270 — the CLI
+        ``task=convert_model`` output)."""
+        from .io.model_json import model_to_if_else
+        return model_to_if_else(self._gbdt, num_iteration)
+
     def feature_importance(self, importance_type: str = "split",
                            iteration=None) -> np.ndarray:
-        return self._gbdt.feature_importance(importance_type)
+        return self._gbdt.feature_importance(
+            importance_type, num_iteration=-1 if iteration is None
+            else int(iteration))
 
     def feature_name(self) -> List[str]:
         if self._gbdt.train_ds is not None:

@@ -44,7 +44,8 @@ class DART(GBDT):
         arrs = self._tree_to_device(tree)
         if train:
             from ..core.predict import predict_leaf_bins
-            lid = predict_leaf_bins(arrs, self._bins, self.meta)
+            lid = predict_leaf_bins(arrs, self._bins, self.meta,
+                                    phys=self._bundled)
             self._train_score = self._train_score.at[:, k].set(
                 self._apply_leaf(self._train_score[:, k], lid, arrs.leaf_value))
         if valid:

@@ -80,6 +80,7 @@ class GBDT:
         self.num_tpi = 1  # trees per iteration (num_class for multiclass)
         self.shrinkage_rate = 0.1
         self.num_init_iteration = 0
+        self._model_version = 0       # bumped on every forest mutation
         self._train_score = None      # [N, K] device
         self._valid_scores: List = []  # [Ni, K] device
         self.best_iteration = -1
@@ -101,6 +102,9 @@ class GBDT:
             m.init(train_ds.metadata, train_ds.num_data)
 
         self.meta, self.B = build_device_meta(train_ds, config)
+        from ..core.meta import padded_phys_width
+        self.B_phys = padded_phys_width(train_ds)
+        self._bundled = train_ds.bundle is not None
         self.split_cfg = SplitConfig.from_config(config)
         self._bins = jnp.asarray(train_ds.X_bin)
         self._init_grower(config, train_ds)
@@ -131,15 +135,66 @@ class GBDT:
         import jax
         import jax.numpy as jnp
 
+        # ---- CEGB (reference: cost_effective_gradient_boosting.hpp) -----
+        self._cegb_on = False
+        self._cegb_state = []
+        cegb_cfg = None
+        cl = list(config.cegb_penalty_feature_coupled or [])
+        ll = list(config.cegb_penalty_feature_lazy or [])
+        if config.cegb_penalty_split > 0 or cl or ll:
+            from ..core.grower import CegbConfig
+            F = train_ds.num_features
+
+            def to_inner(lst, name):
+                if not lst:
+                    return None
+                if len(lst) != train_ds.num_total_features:
+                    log.fatal(f"{name} should be the same size as feature "
+                              "number.")
+                return tuple(
+                    float(lst[int(train_ds.real_feature_idx[i])])
+                    for i in range(F))
+            cegb_cfg = CegbConfig(
+                tradeoff=float(config.cegb_tradeoff),
+                penalty_split=float(config.cegb_penalty_split),
+                coupled=to_inner(cl, "cegb_penalty_feature_coupled"),
+                lazy=to_inner(ll, "cegb_penalty_feature_lazy"))
+            self._cegb_on = True
+            if getattr(config, "tree_learner", "serial") != "serial":
+                log.fatal("CEGB is not supported with parallel tree "
+                          "learners (reference scopes it to the serial "
+                          "learner, serial_tree_learner.cpp:557)")
+        self._cegb_cfg = cegb_cfg
+
+        # ---- forced splits (reference: serial_tree_learner.cpp:607) -----
+        from ..io.forced_splits import load_forced_splits
+        forced = load_forced_splits(
+            getattr(config, "forcedsplits_filename", ""), train_ds,
+            self.split_cfg.num_leaves)
+
         wave_ok = (config.device_type in ("tpu", "gpu")
                    and jax.default_backend() == "tpu"
                    and train_ds.X_bin.dtype == np.uint8
-                   and self.B <= 256
+                   and self.B_phys <= 256
                    and train_ds.num_features > 0)
+        if forced is not None and wave_ok:
+            log.info("forcedsplits_filename set: using the XLA serial "
+                     "grower (the wave grower splits many leaves per pass "
+                     "and cannot follow a BFS prescription)")
+            wave_ok = False
+        if cegb_cfg is not None and cegb_cfg.lazy is not None and wave_ok:
+            log.warning("cegb_penalty_feature_lazy needs per-row state; "
+                        "falling back to the XLA serial grower")
+            wave_ok = False
         self.uses_wave = bool(wave_ok)
 
         # ---- parallel tree learners (reference: tree_learner.cpp:13-36) --
         tl = getattr(config, "tree_learner", "serial")
+        if forced is not None and tl != "serial":
+            log.warning("forcedsplits_filename is ignored with "
+                        "tree_learner=%s (supported on the serial "
+                        "learner only)", tl)
+            forced = None
         if tl != "serial" and train_ds.num_features > 0:
             from ..parallel.mesh import build_mesh, make_engine_grower
             if int(getattr(config, "num_machines", 1)) > 1:
@@ -159,7 +214,8 @@ class GBDT:
             self.uses_wave = use_wave
             self._grow = make_engine_grower(
                 tl, self.meta, self.split_cfg, self.B, mesh,
-                wave_kw=wave_kw if use_wave else None)
+                wave_kw=wave_kw if use_wave else None,
+                B_phys=self.B_phys, bundled=self._bundled)
             # pre-jitted, but callable from inside grow_apply's jit too
             self._grow_raw = self._grow
             from ..parallel.mesh import engine_pad_bins
@@ -179,15 +235,31 @@ class GBDT:
                 wave_capacity=int(config.tpu_wave_capacity),
                 highest=self._hist_mode(config),
                 gain_gate=float(config.tpu_wave_gain_gate),
-                block_rows=int(config.tpu_block_rows))
+                block_rows=int(config.tpu_block_rows),
+                B_phys=self.B_phys, bundled=self._bundled, cegb=cegb_cfg)
             # feature-major resident copy for the Pallas kernel layout
             self._grow_bins = jnp.asarray(
                 np.ascontiguousarray(train_ds.X_bin.T))
         else:
             from ..core.grower import build_grow_fn
-            self._grow_raw = build_grow_fn(self.meta, self.split_cfg, self.B)
+            self._grow_raw = build_grow_fn(self.meta, self.split_cfg, self.B,
+                                           B_phys=self.B_phys,
+                                           bundled=self._bundled,
+                                           cegb=cegb_cfg, forced=forced)
             self._grow_bins = self._bins
         self._grow = jax.jit(self._grow_raw)
+        if self._cegb_on:
+            F = train_ds.num_features
+            coupled0 = np.zeros(F, np.float32)
+            if cegb_cfg.coupled is not None:
+                coupled0 = (cegb_cfg.tradeoff
+                            * np.asarray(cegb_cfg.coupled, np.float32))
+            self._cegb_state = [jnp.asarray(coupled0)]
+            if not self.uses_wave:
+                rows0 = (np.ones((F, train_ds.num_data), np.uint8)
+                         if cegb_cfg.lazy is not None
+                         else np.zeros((1, 1), np.uint8))
+                self._cegb_state.append(jnp.asarray(rows0))
 
     @staticmethod
     def _hist_mode(config: Config) -> str:
@@ -218,9 +290,11 @@ class GBDT:
         def apply_leaf(score_col, leaf_id, leaf_values):
             return score_col + leaf_values[leaf_id]
 
+        bundled = self._bundled
+
         @jax.jit
         def traverse_add(score_col, tree: TreeArrays, bins):
-            leaf = predict_leaf_bins(tree, bins, self.meta)
+            leaf = predict_leaf_bins(tree, bins, self.meta, phys=bundled)
             return score_col + tree.leaf_value[leaf]
 
         self._apply_leaf = apply_leaf
@@ -266,7 +340,7 @@ class GBDT:
 
         @functools.partial(jax.jit, static_argnames=("k",))
         def valid_apply(vscore, arrs, vbins, k):
-            leaf = predict_leaf_bins(arrs, vbins, self.meta)
+            leaf = predict_leaf_bins(arrs, vbins, self.meta, phys=bundled)
             return vscore.at[:, k].add(arrs.leaf_value[leaf])
 
         self._valid_apply = valid_apply
@@ -321,20 +395,14 @@ class GBDT:
         self._valid_bins = getattr(self, "_valid_bins", [])
         self._valid_bins.append(bins)
 
-    def _tree_to_device(self, tree: Tree) -> TreeArrays:
-        """Host Tree -> device arrays (bin space) for score replay."""
-        import jax.numpy as jnp
-        # init_model forests may carry more leaves than this run's config
-        L = max(self.split_cfg.num_leaves, tree.num_leaves)
-        n = max(L - 1, 1)
-        nl = tree.num_leaves
-        nn = max(nl - 1, 0)
-
-        def pad(a, size, fill=0, dtype=None):
-            out = np.full(size, fill, dtype=dtype or a.dtype)
-            out[:len(a)] = a
-            return jnp.asarray(out)
-
+    def _tree_bin_space(self, tree: Tree):
+        """Translate a value-space host ``Tree`` back to bin space:
+        (inner_feats i32[nn], thr_bin i32[nn], default_left bool[nn],
+        cat_bits u32[nn, W], left_child i32[nn], right_child i32[nn]) —
+        children differ from the host tree's only for trivial-feature
+        nodes, whose one-way decision is encoded as left==right."""
+        nn = max(tree.num_leaves - 1, 0)
+        forced_child = {}  # node -> winning child for trivial-feature nodes
         dl = np.array([(tree.decision_type[i] & 2) != 0 for i in range(nn)], bool)
         # bin-space split state from the value-space model: thresholds via
         # value_to_bin (exact inverse of bin_to_value — bounds are strictly
@@ -342,10 +410,8 @@ class GBDT:
         # Tree.from_device's translation); model text carries no bin indices
         from ..core.splitter import bitset_words
         W = bitset_words(self.B)
-        cat_bits = np.zeros((max(n, 1), W), np.uint32)
+        cat_bits = np.zeros((max(nn, 1), W), np.uint32)
         inner_feats = self._inner_features(tree)
-        is_cat0 = bool(np.asarray(self.meta.is_categorical)[0]) \
-            if self.train_ds.num_features > 0 else False
         thr_bin = np.zeros(nn, np.int32)
         for i in range(nn):
             inner = int(inner_feats[i])
@@ -361,13 +427,10 @@ class GBDT:
                                             np.asarray([i]))[0])
                 inner_feats[i] = 0
                 dl[i] = go_left
-                if is_cat0:
-                    # membership decides: all-ones bitset -> left, zeros -> right
-                    cat_bits[i, :] = np.uint32(0xFFFFFFFF) if go_left else 0
-                    # mark the node categorical for go_left_node dispatch:
-                    # handled via meta.is_categorical[0], nothing else needed
-                else:
-                    thr_bin[i] = np.int32(self.B if go_left else -1)
+                # exact regardless of feature-0's type or any sentinel bin:
+                # both child pointers aim at the winning side
+                forced_child[i] = int(tree.left_child[i] if go_left
+                                      else tree.right_child[i])
                 continue
             mapper = self.train_ds.inner_to_mapper(inner)
             if not tree.is_categorical(i):
@@ -380,12 +443,55 @@ class GBDT:
                 if cat >= 0 and word < hi - lo and \
                         (int(tree.cat_threshold[lo + word]) >> (cat % 32)) & 1:
                     cat_bits[i, b // 32] |= np.uint32(1 << (b % 32))
+        left = tree.left_child[:nn].astype(np.int32).copy()
+        right = tree.right_child[:nn].astype(np.int32).copy()
+        for i, child in forced_child.items():
+            left[i] = child
+            right[i] = child
+        return inner_feats, thr_bin, dl, cat_bits, left, right
+
+    def _tree_arrays_np(self, tree: Tree) -> dict:
+        """Bin-space numpy arrays for one host tree, unpadded — the unit
+        ``core.forest.stack_forest`` batches for device prediction."""
+        nl = tree.num_leaves
+        nn = max(nl - 1, 0)
+        inner_feats, thr_bin, dl, cat_bits, left, right = \
+            self._tree_bin_space(tree)
+        return dict(
+            split_feature=inner_feats,
+            threshold_bin=thr_bin,
+            default_left=dl,
+            left_child=left,
+            right_child=right,
+            leaf_value=tree.leaf_value[:nl].astype(np.float32),
+            num_leaves=np.int32(nl),
+            cat_bitset=cat_bits[:nn] if nn else cat_bits[:0],
+        )
+
+    def _tree_to_device(self, tree: Tree) -> TreeArrays:
+        """Host Tree -> device arrays (bin space) for score replay."""
+        import jax.numpy as jnp
+        # init_model forests may carry more leaves than this run's config
+        L = max(self.split_cfg.num_leaves, tree.num_leaves)
+        n = max(L - 1, 1)
+        nl = tree.num_leaves
+        nn = max(nl - 1, 0)
+        inner_feats, thr_bin, dl, cat_bits, left, right = \
+            self._tree_bin_space(tree)
+
+        def pad(a, size, fill=0, dtype=None):
+            out = np.full(size, fill, dtype=dtype or a.dtype)
+            out[:len(a)] = a
+            return jnp.asarray(out)
+
+        cat_full = np.zeros((n, cat_bits.shape[1]), np.uint32)
+        cat_full[:nn] = cat_bits[:nn]
         return TreeArrays(
             split_feature=pad(inner_feats, n, -1, np.int32),
             threshold_bin=pad(thr_bin, n, 0, np.int32),
             default_left=pad(dl, n, False, np.bool_),
-            left_child=pad(tree.left_child[:nn], n, 0, np.int32),
-            right_child=pad(tree.right_child[:nn], n, 0, np.int32),
+            left_child=pad(left, n, 0, np.int32),
+            right_child=pad(right, n, 0, np.int32),
             split_gain=pad(tree.split_gain[:nn], n, 0, np.float32),
             internal_value=pad(tree.internal_value[:nn], n, 0, np.float32),
             internal_count=pad(tree.internal_count[:nn], n, 0, np.int32),
@@ -396,7 +502,7 @@ class GBDT:
             leaf_weight=pad(tree.leaf_weight[:nl].astype(np.float32), L, 0.0,
                             np.float32),
             num_leaves=np.int32(nl),
-            cat_bitset=jnp.asarray(cat_bits),
+            cat_bitset=jnp.asarray(cat_full),
         )
 
     def _inner_features(self, tree: Tree) -> np.ndarray:
@@ -466,11 +572,15 @@ class GBDT:
         K = self.num_tpi
         N = self.train_ds.num_data
 
+        from ..utils.timetag import sync, timetag
+
         init_scores = [0.0] * K
         if gradients is None or hessians is None:
             for k in range(K):
                 init_scores[k] = self._boost_from_average(k)
-            g, h = self._grad_fn(self._train_score)
+            with timetag("boosting (grad/hess)"):
+                g, h = self._grad_fn(self._train_score)
+                sync(h)
         else:
             g = jnp.asarray(np.asarray(gradients, dtype=np.float32).reshape(K, N).T)
             h = jnp.asarray(np.asarray(hessians, dtype=np.float32).reshape(K, N).T)
@@ -491,7 +601,8 @@ class GBDT:
         # The first iteration stays synchronous: its no-split case must
         # insert the boost_from_average constant tree immediately
         # (reference: gbdt.cpp:418-436).
-        lag_ok = self._lag_stop and not needs_renew and self.iter_ >= 1
+        slow_path = needs_renew or self._cegb_on
+        lag_ok = self._lag_stop and not slow_path and self.iter_ >= 1
 
         should_continue = False
         pend_nl = []
@@ -499,19 +610,28 @@ class GBDT:
         for k in range(K):
             tree = None
             if self.class_need_train[k] and self.train_ds.num_features > 0:
-                if needs_renew:
-                    # slow path: the leaf refit needs host residuals between
-                    # growth and shrinkage (reference:
-                    # serial_tree_learner.cpp:855-893)
-                    arrs, leaf_id = self._grow(self._grow_bins, g[:, k],
-                                               h[:, k], self._bag_mask,
-                                               feature_mask)
+                if slow_path:
+                    # slow path: leaf refit needs host residuals between
+                    # growth and shrinkage (serial_tree_learner.cpp:855-893);
+                    # CEGB threads penalty state through the call
+                    with timetag("tree growth"):
+                        res = self._grow(self._grow_bins, g[:, k], h[:, k],
+                                         self._bag_mask, feature_mask,
+                                         *self._cegb_state)
+                        sync(res[1])
+                    if self._cegb_on:
+                        arrs, leaf_id = res[0], res[1]
+                        self._cegb_state = list(res[2:])
+                    else:
+                        arrs, leaf_id = res
                     nl = int(arrs.num_leaves)
                 else:
-                    arrs, leaf_id, new_score = self._grow_apply(
-                        self._grow_bins, g, h, self._bag_mask, feature_mask,
-                        self._train_score, jnp.float32(self.shrinkage_rate),
-                        k)
+                    with timetag("tree growth"):
+                        arrs, leaf_id, new_score = self._grow_apply(
+                            self._grow_bins, g, h, self._bag_mask,
+                            feature_mask, self._train_score,
+                            jnp.float32(self.shrinkage_rate), k)
+                        sync(new_score)
                     if lag_ok:
                         nl_dev = arrs.num_leaves
                         try:  # start the D2H copy now; next iteration's
@@ -530,7 +650,7 @@ class GBDT:
 
             if nl > 1:
                 should_continue = True
-                if needs_renew:
+                if slow_path:
                     arrs = self._renew_tree_output(arrs, leaf_id, k)
                     lv = arrs.leaf_value * self.shrinkage_rate
                     arrs = arrs._replace(
@@ -539,9 +659,12 @@ class GBDT:
                     new_score = self._train_score.at[:, k].set(
                         self._apply_leaf(self._train_score[:, k], leaf_id, lv))
                 self._train_score = new_score
-                for i in range(len(self._valid_scores)):
-                    self._valid_scores[i] = self._valid_apply(
-                        self._valid_scores[i], arrs, self._valid_bins[i], k)
+                with timetag("valid score update"):
+                    for i in range(len(self._valid_scores)):
+                        self._valid_scores[i] = self._valid_apply(
+                            self._valid_scores[i], arrs,
+                            self._valid_bins[i], k)
+                        sync(self._valid_scores[i])
                 tree = _DeferredTree(arrs, init_scores[k], self.shrinkage_rate)
                 self._has_deferred = True
             else:
@@ -559,6 +682,7 @@ class GBDT:
                             self._valid_scores[i] = self._valid_scores[i].at[:, k].add(output)
                 tree = _constant_tree(output)
             self.models.append(tree)
+        self._model_version += 1
 
         if lag_ok:
             prev_dead = self._resolve_pending_stop(current=cur_grown)
@@ -606,6 +730,7 @@ class GBDT:
             del self.models[-2 * K:]
         else:
             del self.models[-K:]
+        self._model_version += 1
         self.iter_ -= 1
         return True
 
@@ -640,6 +765,7 @@ class GBDT:
             log.fatal(f"init model has {len(models)} trees, not a multiple "
                       f"of num_tree_per_iteration={K}")
         list.extend(self.models, models)
+        self._model_version += 1
         self.iter_ = len(models) // K
         if not replay_scores:
             return
@@ -677,8 +803,8 @@ class GBDT:
                 gk = np.asarray(g[:, k], np.float64)
                 hk = np.asarray(h[:, k], np.float64)
                 arrs = self._tree_to_device(tree)
-                leaf = np.asarray(predict_leaf_bins(arrs, self._bins,
-                                                    self.meta))
+                leaf = np.asarray(predict_leaf_bins(
+                    arrs, self._bins, self.meta, phys=self._bundled))
                 nl = tree.num_leaves
                 sum_g = np.bincount(leaf, weights=gk, minlength=nl)[:nl]
                 sum_h = (np.bincount(leaf, weights=hk, minlength=nl)[:nl]
@@ -711,7 +837,8 @@ class GBDT:
             tree = self.models[len(self.models) - K + k]
             arrs = self._tree_to_device(tree)
             neg = arrs._replace(leaf_value=-arrs.leaf_value)
-            lid = predict_leaf_bins(neg, self._bins, self.meta)
+            lid = predict_leaf_bins(neg, self._bins, self.meta,
+                                    phys=self._bundled)
             self._train_score = self._train_score.at[:, k].set(
                 self._apply_leaf(self._train_score[:, k], lid, neg.leaf_value))
             for i in range(len(self._valid_scores)):
@@ -719,6 +846,7 @@ class GBDT:
                     self._traverse_add(self._valid_scores[i][:, k], neg,
                                        self._valid_bins[i]))
         del self.models[-K:]
+        self._model_version += 1
         self.iter_ -= 1
 
     # ------------------------------------------------------------------
@@ -751,20 +879,118 @@ class GBDT:
             else min(start_iteration + num_iteration, n_iters)
         return start_iteration, stop
 
+    # device prediction kicks in above this many (rows x trees): below it,
+    # host numpy wins on dispatch+binning overhead
+    _DEVICE_PREDICT_MIN_WORK = 2_000_000
+
     def predict_raw(self, X: np.ndarray, num_iteration: Optional[int] = None,
-                    start_iteration: int = 0) -> np.ndarray:
+                    start_iteration: int = 0,
+                    early_stop: Optional[dict] = None) -> np.ndarray:
         X = np.ascontiguousarray(X, dtype=np.float64)
         K = self.num_tpi
         start, stop = self._iter_window(num_iteration, start_iteration)
+        work = X.shape[0] * max(stop - start, 0) * K
+        if (self.train_ds is not None
+                and work >= self._DEVICE_PREDICT_MIN_WORK):
+            return self._predict_raw_device(X, start, stop, early_stop)
         out = np.zeros((X.shape[0], K))
-        for it in range(start, stop):
+        active = None
+        if early_stop is not None:
+            active = np.ones(X.shape[0], dtype=bool)
+        for i, it in enumerate(range(start, stop)):
+            Xa = X if active is None else X[active]
             for k in range(K):
-                out[:, k] += self.models[it * K + k].predict(X)
+                if active is None:
+                    out[:, k] += self.models[it * K + k].predict(X)
+                else:
+                    out[active, k] += self.models[it * K + k].predict(Xa)
+            if active is not None and (i + 1) % early_stop["round_period"] == 0:
+                if early_stop["kind"] == "binary":
+                    margin = 2.0 * np.abs(out[:, 0])
+                else:
+                    top2 = np.sort(out, axis=1)[:, -2:]
+                    margin = top2[:, 1] - top2[:, 0]
+                active &= margin < early_stop["margin_threshold"]
+                if not active.any():
+                    break
         return out
+
+    def _predict_raw_device(self, X: np.ndarray, start: int, stop: int,
+                            early_stop: Optional[dict] = None) -> np.ndarray:
+        """Batch the whole forest window onto the device and score every
+        row in one jitted scan (the TPU replacement for the reference's
+        per-row Predictor pipeline, src/application/predictor.hpp:28-271)."""
+        import jax.numpy as jnp
+        from ..core.forest import forest_predict_fn, stack_forest
+        from ..core.splitter import bitset_words
+        K = self.num_tpi
+        # unseen/NaN categories bin to one word past the training bitsets,
+        # so every categorical node routes them right (host parity)
+        sentinel = bitset_words(self.B) * 32
+        key = (start, stop, len(self.models), self._model_version)
+        if getattr(self, "_forest_cache_key", None) != key:
+            trees = [self._tree_arrays_np(self.models[it * K + k])
+                     for it in range(start, stop) for k in range(K)]
+            class_ids = np.asarray(
+                [k for _ in range(start, stop) for k in range(K)], np.int32)
+            self._forest_cache = stack_forest(
+                trees, class_ids, min_words=bitset_words(self.B) + 1)
+            self._forest_cache_key = key
+        es_key = (None if early_stop is None
+                  else (early_stop["kind"], early_stop["round_period"],
+                        early_stop["margin_threshold"]))
+        if getattr(self, "_forest_fn_key", "unset") != es_key:
+            self._forest_fn = forest_predict_fn(self.meta, K, early_stop)
+            self._forest_fn_key = es_key
+        from ..utils.timetag import timetag
+        with timetag("predict (bin input)"):
+            vbins = self._bin_for_predict(X, sentinel)
+        with timetag("predict (forest scan)"):
+            out = self._forest_fn(self._forest_cache, jnp.asarray(vbins))
+            res = np.asarray(out, dtype=np.float64)
+        return res
+
+    def _bin_for_predict(self, X: np.ndarray, sentinel: int) -> np.ndarray:
+        """Bin a raw matrix in the training bin space for device traversal.
+        Numerical features use the training mappers verbatim; categorical
+        features use the strict predict mapping (unseen/NaN -> sentinel)."""
+        from ..io.binning import BIN_CATEGORICAL
+        ds = self.train_ds
+        F = ds.num_features
+        out = np.zeros((X.shape[0], F), dtype=np.int32)
+        for inner in range(F):
+            j = int(ds.real_feature_idx[inner])
+            m = ds.bin_mappers[j]
+            col = X[:, j]
+            if m.bin_type == BIN_CATEGORICAL:
+                out[:, inner] = m.value_to_bin_predict(col, sentinel)
+            else:
+                out[:, inner] = m.value_to_bin(col)
+        return out
+
+    def _early_stop_spec(self) -> Optional[dict]:
+        """Margin-based prediction early stop from config (reference:
+        CreatePredictionEarlyStopInstance, prediction_early_stop.cpp:54-88);
+        None unless ``pred_early_stop`` is set and the objective is a
+        classification (margins are meaningless for regression)."""
+        cfg = self.config
+        if cfg is None or not getattr(cfg, "pred_early_stop", False):
+            return None
+        if self.num_tpi > 1:
+            kind = "multiclass"
+        elif self.objective is not None and self.objective.name in (
+                "binary", "cross_entropy", "cross_entropy_lambda"):
+            kind = "binary"
+        else:
+            return None
+        return {"kind": kind,
+                "round_period": int(cfg.pred_early_stop_freq) or 1,
+                "margin_threshold": float(cfg.pred_early_stop_margin)}
 
     def predict(self, X, num_iteration=None, raw_score=False,
                 start_iteration: int = 0) -> np.ndarray:
-        raw = self.predict_raw(X, num_iteration, start_iteration)
+        raw = self.predict_raw(X, num_iteration, start_iteration,
+                               early_stop=self._early_stop_spec())
         if not raw_score and self.objective is not None:
             conv = self.objective.convert_output(
                 raw if self.num_tpi > 1 else raw[:, 0])
@@ -789,10 +1015,15 @@ class GBDT:
     def current_iteration(self) -> int:
         return len(self.models) // self.num_tpi
 
-    def feature_importance(self, importance_type: str = "split") -> np.ndarray:
+    def feature_importance(self, importance_type: str = "split",
+                           start_iteration: int = 0,
+                           num_iteration: int = -1) -> np.ndarray:
         """(reference: GBDT::FeatureImportance, gbdt.cpp:573-600)."""
         imp = np.zeros(self.train_ds.num_total_features)
-        for tree in self.models:
+        K = self.num_tpi
+        n_iter = len(self.models) // K
+        stop = n_iter if num_iteration <= 0 else min(num_iteration, n_iter)
+        for tree in list(self.models)[start_iteration * K: stop * K]:
             nn = max(tree.num_leaves - 1, 0)
             for i in range(nn):
                 f = int(tree.split_feature[i])

@@ -108,7 +108,9 @@ class RF(GBDT):
         lv[:nl][ok] = new_vals[ok]
         return arrs._replace(leaf_value=jnp.asarray(lv))
 
-    def predict_raw(self, X, num_iteration=None, start_iteration: int = 0):
-        raw = super().predict_raw(X, num_iteration, start_iteration)
+    def predict_raw(self, X, num_iteration=None, start_iteration: int = 0,
+                    early_stop=None):
+        raw = super().predict_raw(X, num_iteration, start_iteration,
+                                  early_stop)
         start, stop = self._iter_window(num_iteration, start_iteration)
         return raw / max(stop - start, 1)

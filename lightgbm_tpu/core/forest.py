@@ -1,0 +1,121 @@
+"""Device batch prediction over a whole forest.
+
+The reference predicts row-by-row on the CPU, tree at a time
+(reference: src/boosting/gbdt_prediction.cpp:1-91, tree.h:447-530).  On TPU
+the same work is one jitted call: the forest's per-tree SoA arrays are
+stacked into [T, ...] batches, the input matrix is binned once with the
+training bin mappers (exact — bin-space integer compares are the inverse
+of the host's double threshold compares), and a ``lax.scan`` over trees
+walks every row in parallel.
+
+Margin-based prediction early stop (reference:
+src/boosting/prediction_early_stop.cpp:1-88) is folded into the scan: every
+``round_period`` trees, rows whose margin clears the threshold go inactive
+and stop accumulating.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import numpy as np
+
+from .grower import TreeArrays
+from .meta import DeviceMeta
+
+
+class ForestArrays(NamedTuple):
+    """Stacked bin-space forest: every field is a [T, ...] batch of the
+    corresponding ``TreeArrays`` field (fixed node capacity across trees)."""
+    split_feature: object   # i32 [T, M]
+    threshold_bin: object   # i32 [T, M]
+    default_left: object    # bool [T, M]
+    left_child: object      # i32 [T, M]
+    right_child: object     # i32 [T, M]
+    leaf_value: object      # f32 [T, M+1]
+    num_leaves: object      # i32 [T]
+    cat_bitset: object      # u32 [T, M, W]
+    class_id: object        # i32 [T] (tree t updates score column class_id[t])
+
+
+def stack_forest(trees_np: list, class_ids: np.ndarray,
+                 min_words: int = 0) -> ForestArrays:
+    """Stack per-tree numpy array dicts (from ``GBDT._tree_arrays_np``)
+    into one device-ready batch, padded to the widest tree.
+
+    ``min_words`` pads every category bitset with zero words so an
+    out-of-range sentinel bin (unseen/NaN categories at predict time) tests
+    False and routes right."""
+    import jax.numpy as jnp
+
+    M = max(max(t["split_feature"].shape[0] for t in trees_np), 1)
+    W = max(max(t["cat_bitset"].shape[1] for t in trees_np), min_words)
+    T = len(trees_np)
+
+    def batch(key, shape, dtype, fill=0):
+        out = np.full((T,) + shape, fill, dtype=dtype)
+        for i, t in enumerate(trees_np):
+            a = t[key]
+            out[(i,) + tuple(slice(0, s) for s in a.shape)] = a
+        return jnp.asarray(out)
+
+    return ForestArrays(
+        split_feature=batch("split_feature", (M,), np.int32, -1),
+        threshold_bin=batch("threshold_bin", (M,), np.int32),
+        default_left=batch("default_left", (M,), np.bool_),
+        left_child=batch("left_child", (M,), np.int32),
+        right_child=batch("right_child", (M,), np.int32),
+        leaf_value=batch("leaf_value", (M + 1,), np.float32),
+        num_leaves=jnp.asarray(
+            np.asarray([t["num_leaves"] for t in trees_np], np.int32)),
+        cat_bitset=batch("cat_bitset", (M, W), np.uint32),
+        class_id=jnp.asarray(class_ids.astype(np.int32)),
+    )
+
+
+def forest_predict_fn(meta: DeviceMeta, K: int, early_stop: Optional[dict] = None):
+    """Build ``predict(forest, bins) -> [N, K] f32`` raw scores.
+
+    ``early_stop``: None, or {"kind": "binary"|"multiclass",
+    "round_period": int, "margin_threshold": float} — the reference's
+    CreatePredictionEarlyStopInstance contract
+    (prediction_early_stop.cpp:54-88)."""
+    import jax
+    import jax.numpy as jnp
+
+    from .predict import predict_leaf_bins
+
+    def predict(forest: ForestArrays, bins):
+        N = bins.shape[0]
+        score0 = jnp.zeros((N, K), jnp.float32)
+        active0 = jnp.ones((N,), bool)
+
+        def body(carry, tree):
+            score, active, t = carry
+            (sf, tb, dl, lc, rc, lv, nl, cb, k) = tree
+            arrs = TreeArrays(
+                split_feature=sf, threshold_bin=tb, default_left=dl,
+                left_child=lc, right_child=rc,
+                split_gain=None, internal_value=None, internal_count=None,
+                internal_weight=None,
+                leaf_value=lv, leaf_count=None, leaf_weight=None,
+                num_leaves=nl, cat_bitset=cb)
+            leaf = predict_leaf_bins(arrs, bins, meta)
+            add = jnp.where(active, lv[leaf], 0.0)
+            score = score.at[:, k].add(add)
+            if early_stop is not None:
+                period = int(early_stop.get("round_period", 0)) or 1
+                thr = jnp.float32(early_stop["margin_threshold"])
+                check = ((t + 1) % (period * K)) == 0
+                if early_stop["kind"] == "binary":
+                    margin = 2.0 * jnp.abs(score[:, 0])
+                else:
+                    top2 = jax.lax.top_k(score, 2)[0]
+                    margin = top2[:, 0] - top2[:, 1]
+                active = jnp.where(check, active & (margin < thr), active)
+            return (score, active, t + 1), None
+
+        (score, _, _), _ = jax.lax.scan(
+            body, (score0, active0, jnp.int32(0)), forest)
+        return score
+
+    return jax.jit(predict)

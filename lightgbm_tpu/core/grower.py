@@ -26,9 +26,10 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..io.binning import MISSING_NAN, MISSING_ZERO
-from .histogram import hist_onehot
+from .histogram import expand_bundled, fix_default_bins, hist_onehot
 from .meta import DeviceMeta, SplitConfig
 from .splitter import BestSplit, best_split, leaf_output
 
@@ -83,6 +84,9 @@ class _GrowState(NamedTuple):
     leaf_parent: jnp.ndarray  # i32 [L] node whose child slot is this leaf
     leaf_is_right: jnp.ndarray  # bool [L]
     tree: TreeArrays
+    # CEGB state (zeros / [1,1] dummies when disabled)
+    cegb_coupled: jnp.ndarray = None   # f32 [F] pending coupled penalties
+    cegb_rows: jnp.ndarray = None      # u8 [F, N] 1 = feature unused by row
 
 
 def _empty_tree(L: int, W: int = 1) -> TreeArrays:
@@ -125,9 +129,26 @@ def go_left_node(col, threshold, default_left, is_cat, cat_words,
     return jnp.where(is_cat, cat_go, num_go)
 
 
+class CegbConfig(NamedTuple):
+    """Static CEGB penalties (reference: config.h cegb_* params)."""
+    tradeoff: float = 1.0
+    penalty_split: float = 0.0
+    coupled: tuple = None   # per-ORIGINAL-feature penalties or None
+    lazy: tuple = None
+
+
+def decode_feature_col(colp, f, meta: DeviceMeta):
+    """EFB decode: physical-column bins -> feature-space bins for feature
+    ``f`` (see io/bundling.py).  Identity for unbundled features."""
+    off = meta.feat_offset[f]
+    inb = (colp >= off) & (colp < off + meta.num_bins[f])
+    return jnp.where(inb, colp - off, meta.default_bins[f])
+
+
 def build_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
                   hist_fn=hist_onehot, reduce_fn=None, best_split_fn=None,
-                  subtract_sibling: bool = True):
+                  subtract_sibling: bool = True, B_phys: int = None,
+                  bundled: bool = False, cegb=None, forced=None):
     """Build an *unjitted* ``grow(bins, g, h, sample_mask, feature_mask)``.
 
     bins: uint8/int32 [N, F]; g/h: f32 [N]; sample_mask: f32 [N] (bagging);
@@ -150,35 +171,144 @@ def build_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
       ``reduce_fn`` is lossy per pass (voting-parallel's top-k gate), where
       parent and child passes may keep different feature sets and the
       subtraction would mix them.
+
+    With ``cegb`` (a ``CegbConfig``), the returned ``grow`` takes two extra
+    trailing args — ``coupled_pending`` f32 [F] (tradeoff x coupled penalty,
+    zeroed once a feature is used anywhere in the model) and ``row_unused``
+    u8 [F, N] (1 where the row has never passed a split on that feature;
+    a [1, 1] dummy when lazy penalties are off) — and returns them updated
+    as extra outputs, so CEGB state stays device-resident across trees.
+    The cost model is the reference's CEGB
+    (cost_effective_gradient_boosting.hpp:21-117); one deviation: when a
+    feature's coupled penalty is first paid, other leaves' cached best
+    splits are NOT re-searched (the reference partially re-adjusts them,
+    UpdateLeafBestSplits :63-77) — they refresh when those leaves split.
+
+    ``forced``: optional ``(leaf, feature, threshold_bin)`` int32 arrays of
+    length ``num_leaves - 1`` from ``io.forced_splits.load_forced_splits``
+    — step ``k`` splits ``leaf[k]`` as prescribed when ``feature[k] >= 0``
+    and the split has positive gain on the live histograms; one rejected
+    forced split aborts the rest, like the reference's
+    ``aborted_last_force_split`` (serial_tree_learner.cpp:674-679).
     """
     L = cfg.num_leaves
+    if B_phys is None:
+        B_phys = B
     if reduce_fn is None:
         reduce_fn = lambda x: x
+
+    def hist_leaf(bins, g, h, mask, tg, th, tc):
+        """Histogram the PHYSICAL columns, globally reduce, then (when
+        bundled) expand to per-feature space and reconstruct each member's
+        elided default-bin mass from the leaf totals."""
+        hp = reduce_fn(hist_fn(bins, g, h, mask, B=B_phys))
+        if bundled:
+            hp = expand_bundled(hp, meta, B)
+            hp = fix_default_bins(hp, tg, th, tc, meta)
+        return hp
     if best_split_fn is None:
         def best_split_fn(hist_leaf, sg, sh, sc, min_c, max_c, feature_mask):
             return best_split(hist_leaf, sg, sh, sc, meta, cfg, min_c, max_c,
                               feature_mask=feature_mask)
 
-    def _child_best(hist_leaf, sg, sh, sc, depth, min_c, max_c, feature_mask):
-        bs = best_split_fn(hist_leaf, sg, sh, sc, min_c, max_c, feature_mask)
+    if forced is not None:
+        FL = jnp.asarray(forced[0], jnp.int32)
+        FF = jnp.asarray(forced[1], jnp.int32)
+        FT = jnp.asarray(forced[2], jnp.int32)
+
+        def _forced_split(st, k):
+            """Evaluate step k's prescribed split against the live
+            histograms (reference: GatherInfoForThresholdNumerical,
+            feature_histogram.hpp:292-365 — missing mass joins the left
+            child and default_left is fixed True)."""
+            from .splitter import _split_gains, leaf_split_gain
+            leaf = FL[k]
+            f = jnp.maximum(FF[k], 0)
+            t = FT[k]
+            hist_f = st.hist[leaf, f]                           # [B, 3]
+            bins_r = jnp.arange(hist_f.shape[0], dtype=jnp.int32)
+            nb, db = meta.num_bins[f], meta.default_bins[f]
+            mt = meta.missing_types[f]
+            miss = (((mt == MISSING_NAN) & (bins_r == nb - 1))
+                    | ((mt == MISSING_ZERO) & (bins_r == db)))
+            lmask = (jnp.where(miss, True, bins_r <= t)
+                     & (bins_r < nb)).astype(jnp.float32)
+            lg = jnp.sum(hist_f[:, 0] * lmask)
+            lh = jnp.sum(hist_f[:, 1] * lmask)
+            lc = jnp.sum(hist_f[:, 2] * lmask)
+            pg, ph, pc = st.leaf_g[leaf], st.leaf_h[leaf], st.leaf_c[leaf]
+            rg, rh, rc = pg - lg, ph - lh, pc - lc
+            min_c, max_c = st.leaf_min_c[leaf], st.leaf_max_c[leaf]
+            gain = (_split_gains(lg, lh, rg, rh, cfg, min_c, max_c,
+                                 meta.monotone[f])
+                    - leaf_split_gain(pg, ph, cfg) - cfg.min_gain_to_split)
+            out_l = jnp.clip(leaf_output(lg, lh, cfg), min_c, max_c)
+            out_r = jnp.clip(leaf_output(rg, rh, cfg), min_c, max_c)
+            ok = (FF[k] >= 0) & (gain > 0) & (lc > 0) & (rc > 0)
+            return ok, (gain, lg, lh, lc, out_l, out_r)
+
+    lazy_on = cegb is not None and cegb.lazy is not None
+    if cegb is not None:
+        split_pen = float(cegb.tradeoff * cegb.penalty_split)
+        lazy_vec = (jnp.asarray(np.asarray(cegb.lazy, np.float32)
+                                * cegb.tradeoff) if lazy_on else None)
+
+    def _cegb_pen(sc, coupled_pending, row_unused, leaf_mask):
+        """DeltaGain vector [F] for one leaf (reference:
+        cost_effective_gradient_boosting.hpp:50-61)."""
+        pen = split_pen * sc + coupled_pending
+        if lazy_on:
+            # row_unused stays uint8 in HBM (4x smaller than f32 on
+            # [F, N]); the cast fuses into the matvec
+            unused_cnt = row_unused.astype(jnp.float32) @ leaf_mask  # [F]
+            pen = pen + lazy_vec * unused_cnt
+        return pen
+
+    def _child_best(hist_leaf, sg, sh, sc, depth, min_c, max_c, feature_mask,
+                    pen_vec=None):
+        if pen_vec is not None:
+            bs = best_split(hist_leaf, sg, sh, sc, meta, cfg, min_c, max_c,
+                            feature_mask=feature_mask, penalty_sub=pen_vec)
+        else:
+            bs = best_split_fn(hist_leaf, sg, sh, sc, min_c, max_c,
+                               feature_mask)
         depth_ok = (cfg.max_depth <= 0) | (depth < cfg.max_depth)
         gain = jnp.where(depth_ok, bs.gain, NEG_INF)
         return bs._replace(gain=gain)
 
-    def _split_body(k, st: _GrowState, bins, g, h, sample_mask, feature_mask):
+    def _split_body(k, st: _GrowState, bins, g, h, sample_mask, feature_mask,
+                    fstats=None):
         leaf = jnp.argmax(st.best_gain).astype(jnp.int32)
         new = (k + 1).astype(jnp.int32)
-        f = st.best_feat[leaf]
-        t = st.best_thr[leaf]
-        dl = st.best_dl[leaf]
-        cb = st.best_cb[leaf]
+        if fstats is None:
+            f = st.best_feat[leaf]
+            t = st.best_thr[leaf]
+            dl = st.best_dl[leaf]
+            cb = st.best_cb[leaf]
+            gain_rec = st.best_gain[leaf]
+            lg, lh, lc = st.best_lg[leaf], st.best_lh[leaf], st.best_lc[leaf]
+            out_l, out_r = st.best_lout[leaf], st.best_rout[leaf]
+        else:
+            # forced-split override: replace the argmax choice and its
+            # cached stats with the prescription evaluated in _forced_split
+            fon, fgain, flg, flh, flc, fol, fo_r = fstats
+            leaf = jnp.where(fon, FL[k], leaf)
+            f = jnp.where(fon, jnp.maximum(FF[k], 0), st.best_feat[leaf])
+            t = jnp.where(fon, FT[k], st.best_thr[leaf])
+            dl = jnp.where(fon, True, st.best_dl[leaf])
+            cb = jnp.where(fon, jnp.zeros_like(st.best_cb[leaf]),
+                           st.best_cb[leaf])
+            gain_rec = jnp.where(fon, fgain, st.best_gain[leaf])
+            lg = jnp.where(fon, flg, st.best_lg[leaf])
+            lh = jnp.where(fon, flh, st.best_lh[leaf])
+            lc = jnp.where(fon, flc, st.best_lc[leaf])
+            out_l = jnp.where(fon, fol, st.best_lout[leaf])
+            out_r = jnp.where(fon, fo_r, st.best_rout[leaf])
 
         # ---- child stats ------------------------------------------------
-        lg, lh, lc = st.best_lg[leaf], st.best_lh[leaf], st.best_lc[leaf]
         pg, ph, pc = st.leaf_g[leaf], st.leaf_h[leaf], st.leaf_c[leaf]
         rg, rh, rc = pg - lg, ph - lh, pc - lc
         min_c, max_c = st.leaf_min_c[leaf], st.leaf_max_c[leaf]
-        out_l, out_r = st.best_lout[leaf], st.best_rout[leaf]
 
         # ---- monotone constraint propagation ----------------------------
         mono = meta.monotone[f]
@@ -201,7 +331,7 @@ def build_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
             split_feature=tr.split_feature.at[k].set(f),
             threshold_bin=tr.threshold_bin.at[k].set(t),
             default_left=tr.default_left.at[k].set(dl),
-            split_gain=tr.split_gain.at[k].set(st.best_gain[leaf]),
+            split_gain=tr.split_gain.at[k].set(gain_rec),
             internal_value=tr.internal_value.at[k].set(st.leaf_out[leaf]),
             internal_count=tr.internal_count.at[k].set(pc.astype(jnp.int32)),
             internal_weight=tr.internal_weight.at[k].set(ph),
@@ -212,7 +342,10 @@ def build_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
         )
 
         # ---- partition rows ---------------------------------------------
-        col = jnp.take(bins, f, axis=1).astype(jnp.int32)
+        col = jnp.take(bins, meta.feat2phys[f] if bundled else f,
+                       axis=1).astype(jnp.int32)
+        if bundled:
+            col = decode_feature_col(col, f, meta)
         go_left = go_left_node(col, t, dl, meta.is_categorical[f], cb,
                                meta.missing_types[f], meta.num_bins[f],
                                meta.default_bins[f])
@@ -225,19 +358,37 @@ def build_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
         small = jnp.where(left_smaller, leaf, new)
         large = jnp.where(left_smaller, new, leaf)
         small_mask = (leaf_id == small).astype(jnp.float32) * sample_mask
-        hist_small = reduce_fn(hist_fn(bins, g, h, small_mask, B=B))
+        sg = jnp.where(left_smaller, lg, rg)
+        sh = jnp.where(left_smaller, lh, rh)
+        sc = jnp.where(left_smaller, lc, rc)
+        hist_small = hist_leaf(bins, g, h, small_mask, sg, sh, sc)
         hist = st.hist.at[small].set(hist_small)
         if subtract_sibling:
             hist = hist.at[large].set(parent_hist - hist_small)
         else:
             large_mask = (leaf_id == large).astype(jnp.float32) * sample_mask
             hist = hist.at[large].set(
-                reduce_fn(hist_fn(bins, g, h, large_mask, B=B)))
+                hist_leaf(bins, g, h, large_mask, pg - sg, ph - sh, pc - sc))
 
         # ---- best splits for the two children ---------------------------
         d = st.leaf_depth[leaf] + 1
-        bs_l = _child_best(hist[leaf], lg, lh, lc, d, l_min, l_max, feature_mask)
-        bs_r = _child_best(hist[new], rg, rh, rc, d, r_min, r_max, feature_mask)
+        cegb_coupled, cegb_rows = st.cegb_coupled, st.cegb_rows
+        pen_l = pen_r = None
+        if cegb is not None:
+            # feature f's coupled penalty is paid; rows of this leaf have
+            # now used f (reference: UpdateLeafBestSplits, hpp:63-85)
+            cegb_coupled = cegb_coupled.at[f].set(0.0)
+            if lazy_on:
+                cegb_rows = cegb_rows.at[f].set(
+                    jnp.where(in_leaf, jnp.uint8(0), cegb_rows[f]))
+            pen_l = _cegb_pen(lc, cegb_coupled, cegb_rows,
+                              (leaf_id == leaf).astype(jnp.float32) * sample_mask)
+            pen_r = _cegb_pen(rc, cegb_coupled, cegb_rows,
+                              (leaf_id == new).astype(jnp.float32) * sample_mask)
+        bs_l = _child_best(hist[leaf], lg, lh, lc, d, l_min, l_max,
+                           feature_mask, pen_l)
+        bs_r = _child_best(hist[new], rg, rh, rc, d, r_min, r_max,
+                           feature_mask, pen_r)
 
         def upd(a, i, v):
             return a.at[i].set(v)
@@ -265,21 +416,32 @@ def build_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
             leaf_parent=upd(upd(st.leaf_parent, leaf, k), new, k),
             leaf_is_right=upd(upd(st.leaf_is_right, leaf, False), new, True),
             tree=tr,
+            cegb_coupled=cegb_coupled,
+            cegb_rows=cegb_rows,
         )
 
-    def grow(bins, g, h, sample_mask, feature_mask):
+    def grow(bins, g, h, sample_mask, feature_mask,
+             cegb_coupled=None, cegb_rows=None):
         from .splitter import bitset_words
-        N, F = bins.shape
+        N = bins.shape[0]
         W = bitset_words(B)
         sum_g = reduce_fn(jnp.sum(g * sample_mask))
         sum_h = reduce_fn(jnp.sum(h * sample_mask))
         cnt = reduce_fn(jnp.sum(sample_mask))
 
-        hist0 = reduce_fn(hist_fn(bins, g, h, sample_mask, B=B))
+        Fin = int(meta.num_bins.shape[0])
+        if cegb_coupled is None:
+            cegb_coupled = jnp.zeros((Fin,), jnp.float32)
+        if cegb_rows is None:
+            cegb_rows = jnp.zeros((1, 1), jnp.uint8)
+
+        hist0 = hist_leaf(bins, g, h, sample_mask, sum_g, sum_h, cnt)
         inf = jnp.float32(jnp.inf)
         root_out = leaf_output(sum_g, sum_h, cfg)
+        pen0 = _cegb_pen(cnt, cegb_coupled, cegb_rows, sample_mask) \
+            if cegb is not None else None
         bs0 = _child_best(hist0, sum_g, sum_h, cnt, jnp.int32(0),
-                          -inf, inf, feature_mask)
+                          -inf, inf, feature_mask, pen0)
 
         Lf = jnp.zeros((L,), jnp.float32)
         Li = jnp.zeros((L,), jnp.int32)
@@ -306,28 +468,55 @@ def build_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
             leaf_parent=jnp.full((L,), -1, jnp.int32),
             leaf_is_right=jnp.zeros((L,), bool),
             tree=_empty_tree(L, W),
+            cegb_coupled=cegb_coupled,
+            cegb_rows=cegb_rows,
         )
 
-        def body(k, st):
-            do = jnp.max(st.best_gain) > 0.0
-            return jax.lax.cond(
-                do,
-                lambda s: _split_body(k, s, bins, g, h, sample_mask, feature_mask),
-                lambda s: s,
-                st)
+        if forced is None:
+            def body(k, st):
+                do = jnp.max(st.best_gain) > 0.0
+                return jax.lax.cond(
+                    do,
+                    lambda s: _split_body(k, s, bins, g, h, sample_mask,
+                                          feature_mask),
+                    lambda s: s,
+                    st)
 
-        st = jax.lax.fori_loop(0, L - 1, body, st)
+            st = jax.lax.fori_loop(0, L - 1, body, st)
+        else:
+            def body(k, carry):
+                st, alive = carry
+                ok, fst = _forced_split(st, k)
+                want = FF[k] >= 0
+                fon = ok & alive
+                alive = alive & (~want | ok)
+                do = (jnp.max(st.best_gain) > 0.0) | fon
+                st = jax.lax.cond(
+                    do,
+                    lambda s: _split_body(k, s, bins, g, h, sample_mask,
+                                          feature_mask,
+                                          fstats=(fon,) + fst),
+                    lambda s: s,
+                    st)
+                return st, alive
+
+            st, _ = jax.lax.fori_loop(0, L - 1, body,
+                                      (st, jnp.bool_(True)))
 
         tr = st.tree._replace(
             leaf_value=st.leaf_out,
             leaf_count=st.leaf_c.astype(jnp.int32),
             leaf_weight=st.leaf_h,
         )
+        if cegb is not None:
+            return tr, st.leaf_id, st.cegb_coupled, st.cegb_rows
         return tr, st.leaf_id
 
     return grow
 
 
-def make_grower(meta: DeviceMeta, cfg: SplitConfig, B: int, hist_fn=hist_onehot):
+def make_grower(meta: DeviceMeta, cfg: SplitConfig, B: int, hist_fn=hist_onehot,
+                B_phys: int = None, bundled: bool = False):
     """Jitted single-device grower."""
-    return jax.jit(build_grow_fn(meta, cfg, B, hist_fn))
+    return jax.jit(build_grow_fn(meta, cfg, B, hist_fn, B_phys=B_phys,
+                                 bundled=bundled))

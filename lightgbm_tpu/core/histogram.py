@@ -85,3 +85,36 @@ def hist_subtract(parent, child):
     """Sibling histogram by subtraction (reference:
     src/treelearner/feature_histogram.hpp:75-81, serial_tree_learner.cpp:567)."""
     return parent - child
+
+
+def expand_bundled(hist_phys, meta, B_out: int):
+    """EFB bundle expansion: physical-column histograms -> per-feature
+    histograms (see io/bundling.py for the bin layout).
+
+    hist_phys: f32 [F_phys, B_phys, C]; returns [F, B_out, C] where
+    out[f, b] = hist_phys[feat2phys[f], feat_offset[f] + b] for b within
+    feature f's bins, zero elsewhere.  Histogram-sized (not data-sized), so
+    the gather is cheap relative to the kernel pass it follows.
+    """
+    Fp, Bp, C = hist_phys.shape
+    b = jnp.arange(B_out, dtype=jnp.int32)
+    idx = (meta.feat2phys[:, None] * Bp + meta.feat_offset[:, None]
+           + b[None, :])                                  # [F, B_out]
+    valid = (b[None, :] < meta.num_bins[:, None]) & \
+        (meta.feat_offset[:, None] + b[None, :] < Bp)
+    flat = hist_phys.reshape(Fp * Bp, C)
+    out = flat[jnp.where(valid, idx, 0)]
+    return out * valid[..., None]
+
+
+def fix_default_bins(hist, tg, th, tc, meta):
+    """Reconstruct each bundled member's elided default-bin mass from the
+    leaf totals (reference: Dataset::FixHistogram, src/io/dataset.cpp:
+    1044-1063): hist[f, default_bin_f] += total - sum_b hist[f, b].
+
+    hist: f32 [F, B, 3]; tg/th/tc: scalar leaf totals."""
+    sums = hist.sum(axis=1)                               # [F, 3]
+    totals = jnp.stack([tg, th, tc]).astype(hist.dtype)   # [3]
+    resid = jnp.where(meta.needs_fix[:, None], totals[None, :] - sums, 0.0)
+    F = hist.shape[0]
+    return hist.at[jnp.arange(F), meta.default_bins].add(resid)

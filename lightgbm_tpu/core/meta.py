@@ -24,13 +24,23 @@ from ..io.binning import BIN_CATEGORICAL, MISSING_NAN, MISSING_NONE, MISSING_ZER
 
 
 class DeviceMeta(NamedTuple):
-    """Per-feature metadata as device arrays (all shaped [F] unless noted)."""
+    """Per-feature metadata as device arrays (all shaped [F] unless noted).
+
+    The last three fields carry the EFB bundle mapping (io/bundling.py):
+    feature f lives in physical column ``feat2phys[f]`` at bin offset
+    ``feat_offset[f]``; ``needs_fix[f]`` marks members whose default-bin
+    histogram mass must be reconstructed from leaf totals (the reference's
+    Dataset::FixHistogram, src/io/dataset.cpp:1044-1063).  Identity arrays
+    when the dataset is unbundled."""
     num_bins: "jax.Array"       # int32 — actual bin count per feature
     default_bins: "jax.Array"   # int32 — bin of value 0.0
     missing_types: "jax.Array"  # int32 — MISSING_{NONE,ZERO,NAN}
     monotone: "jax.Array"       # int32 — -1/0/+1 monotone constraint
     penalties: "jax.Array"      # float32 — per-feature gain penalty (feature_contri)
     is_categorical: "jax.Array"  # bool
+    feat2phys: "jax.Array" = None    # int32 — physical X_bin column
+    feat_offset: "jax.Array" = None  # int32 — bin offset inside the column
+    needs_fix: "jax.Array" = None    # bool — default-bin mass elided
 
 
 @dataclass(frozen=True)
@@ -111,6 +121,15 @@ def build_device_meta(dataset, config=None):
             if orig < len(fc):
                 penalties[inner] = float(fc[orig])
     B = _padded_bin_width(int(nbins.max(initial=1)))
+    bundle = getattr(dataset, "bundle", None)
+    if bundle is not None:
+        feat2phys = bundle.feat2phys
+        feat_offset = bundle.feat_offset
+        needs_fix = bundle.needs_fix
+    else:
+        feat2phys = np.arange(F, dtype=np.int32)
+        feat_offset = np.zeros(F, dtype=np.int32)
+        needs_fix = np.zeros(F, dtype=bool)
     meta = DeviceMeta(
         num_bins=jnp.asarray(nbins),
         default_bins=jnp.asarray(default_bins),
@@ -118,5 +137,14 @@ def build_device_meta(dataset, config=None):
         monotone=jnp.asarray(monotone),
         penalties=jnp.asarray(penalties),
         is_categorical=jnp.asarray(is_cat),
+        feat2phys=jnp.asarray(feat2phys),
+        feat_offset=jnp.asarray(feat_offset),
+        needs_fix=jnp.asarray(needs_fix),
     )
     return meta, B
+
+
+def padded_phys_width(dataset) -> int:
+    """Static padded bin width of the PHYSICAL columns — what the
+    histogram kernels must cover (== the split width unless bundled)."""
+    return _padded_bin_width(int(dataset.phys_max_bins().max(initial=1)))

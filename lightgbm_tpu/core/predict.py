@@ -10,13 +10,18 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from .grower import TreeArrays, go_left_bins
+from .grower import TreeArrays, decode_feature_col, go_left_bins
 from .meta import DeviceMeta
 from .splitter import bitset_contains
 
 
-def predict_leaf_bins(tree: TreeArrays, bins, meta: DeviceMeta):
-    """Leaf index per row for binned inputs. bins: [N, F] uint8/int32."""
+def predict_leaf_bins(tree: TreeArrays, bins, meta: DeviceMeta,
+                      phys: bool = False):
+    """Leaf index per row for binned inputs. bins: [N, F] uint8/int32.
+
+    ``phys=True`` reads EFB physical-column layout (training/valid bins of a
+    bundled dataset) and decodes each node's feature bin on the fly;
+    ``phys=False`` expects per-feature (inner) columns."""
     N = bins.shape[0]
     start = jnp.where(tree.num_leaves > 1, 0, ~0)
     node = jnp.full((N,), start, jnp.int32)
@@ -28,8 +33,11 @@ def predict_leaf_bins(tree: TreeArrays, bins, meta: DeviceMeta):
         active = node >= 0
         nd = jnp.maximum(node, 0)
         f = tree.split_feature[nd]
-        col = jnp.take_along_axis(bins, f[:, None].astype(jnp.int32),
+        fcol = meta.feat2phys[f] if phys else f
+        col = jnp.take_along_axis(bins, fcol[:, None].astype(jnp.int32),
                                   axis=1)[:, 0].astype(jnp.int32)
+        if phys:
+            col = decode_feature_col(col, f, meta)
         gl = go_left_bins(col, tree.threshold_bin[nd], tree.default_left[nd],
                           meta.missing_types[f], meta.num_bins[f],
                           meta.default_bins[f])
@@ -44,8 +52,9 @@ def predict_leaf_bins(tree: TreeArrays, bins, meta: DeviceMeta):
     return ~node
 
 
-def add_score_bins(score, tree: TreeArrays, bins, meta: DeviceMeta, shrinkage):
+def add_score_bins(score, tree: TreeArrays, bins, meta: DeviceMeta, shrinkage,
+                   phys: bool = False):
     """score += shrinkage * leaf_value[leaf(row)] (reference:
     src/boosting/score_updater.hpp:84-108)."""
-    leaf = predict_leaf_bins(tree, bins, meta)
+    leaf = predict_leaf_bins(tree, bins, meta, phys=phys)
     return score + shrinkage * tree.leaf_value[leaf]

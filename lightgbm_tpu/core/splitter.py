@@ -268,7 +268,7 @@ def _cat_winner_bitset(cat: dict, f_best, B: int):
 
 def best_split(hist, sum_g, sum_h, cnt, meta: DeviceMeta, cfg: SplitConfig,
                min_constraint, max_constraint, feature_mask=None,
-               has_cat=None) -> BestSplit:
+               has_cat=None, penalty_sub=None) -> BestSplit:
     """Find the best (feature, threshold) split of one leaf.
 
     hist: f32 [F, B, 3]; sum_g/sum_h/cnt: leaf totals (scalars).
@@ -277,6 +277,9 @@ def best_split(hist, sum_g, sum_h, cnt, meta: DeviceMeta, cfg: SplitConfig,
     has_cat: static flag gating the categorical search; None derives it from
     ``meta`` when concrete (callers whose meta is a tracer — e.g. the
     feature-parallel grower's per-device block slice — must pass it).
+    penalty_sub: optional f32 [F] additive gain penalty per feature — CEGB's
+    DeltaGain (reference: cost_effective_gradient_boosting.hpp:50-61),
+    subtracted from every candidate of that feature before the argmax.
     """
     if has_cat is None:
         try:
@@ -374,6 +377,9 @@ def best_split(hist, sum_g, sum_h, cnt, meta: DeviceMeta, cfg: SplitConfig,
         feat_gain = jnp.where(meta.is_categorical, cat_gain, feat_gain)
     if feature_mask is not None:
         feat_gain = jnp.where(feature_mask, feat_gain, NEG_INF)
+    if penalty_sub is not None:
+        feat_gain = jnp.where(feat_gain > NEG_INF,
+                              feat_gain - penalty_sub, NEG_INF)
 
     f_best = jnp.argmax(feat_gain).astype(jnp.int32)
     best_gain = feat_gain[f_best]

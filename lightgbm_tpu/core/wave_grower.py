@@ -32,7 +32,8 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.pallas_hist import C_MAX, hist_pallas_wave
-from .grower import TreeArrays, _empty_tree, go_left_node
+from .grower import TreeArrays, _empty_tree, decode_feature_col, go_left_node
+from .histogram import expand_bundled, fix_default_bins
 from .meta import DeviceMeta, SplitConfig
 from .splitter import best_split, bitset_words, leaf_output
 
@@ -66,13 +67,15 @@ class _WaveState(NamedTuple):
     pend_large: jnp.ndarray     # i32 [P]
     pend_cnt: jnp.ndarray       # i32
     tree: TreeArrays
+    cegb_coupled: jnp.ndarray = None  # f32 [F] CEGB pending coupled penalties
 
 
 def build_wave_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
                        wave_capacity: int = 42, highest="highest",
                        interpret: bool = False, gain_gate: float = 0.0,
                        block_rows: int = 1024, compact: bool = True,
-                       reduce_fn=None):
+                       reduce_fn=None, B_phys: int = None,
+                       bundled: bool = False, cegb=None):
     """Unjitted ``grow(bins_fm, g, h, sample_mask, feature_mask)`` using the
     Pallas wave kernel. Returns (TreeArrays, leaf_id).
 
@@ -104,14 +107,22 @@ def build_wave_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
     near-tied split gains.
     """
     L = cfg.num_leaves
+    if B_phys is None:
+        B_phys = B
+    if cegb is not None and cegb.lazy is not None:
+        raise ValueError("cegb_penalty_feature_lazy needs per-row state the "
+                         "wave path does not carry; use the serial grower")
+    split_pen = float(cegb.tradeoff * cegb.penalty_split) if cegb else 0.0
     P = max(1, min(wave_capacity, C_MAX // 3))
     # gain_gate > 1 would make _split_once never commit while loop_cond
     # stays true — an infinite while_loop on device
     gain_gate = min(max(float(gain_gate), 0.0), 1.0)
 
-    def _scan_leaf(hist_leaf, sg, sh, sc, min_c, max_c, depth, feature_mask):
+    def _scan_leaf(hist_leaf, sg, sh, sc, min_c, max_c, depth, feature_mask,
+                   cegb_coupled):
+        pen = (split_pen * sc + cegb_coupled) if cegb is not None else None
         bs = best_split(hist_leaf, sg, sh, sc, meta, cfg, min_c, max_c,
-                        feature_mask=feature_mask)
+                        feature_mask=feature_mask, penalty_sub=pen)
         depth_ok = (cfg.max_depth <= 0) | (depth < cfg.max_depth)
         return bs._replace(gain=jnp.where(depth_ok, bs.gain, NEG_INF))
 
@@ -151,6 +162,9 @@ def build_wave_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
                                    k, tr.left_child[pn])
             new_rc_ptr = jnp.where(has_parent & st.leaf_is_right[leaf],
                                    k, tr.right_child[pn])
+            cc = st.cegb_coupled
+            if cegb is not None:
+                cc = cc.at[f].set(0.0)
             tr = tr._replace(
                 split_feature=tr.split_feature.at[k].set(f),
                 threshold_bin=tr.threshold_bin.at[k].set(t),
@@ -165,7 +179,10 @@ def build_wave_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
                 cat_bitset=tr.cat_bitset.at[k].set(cb),
             )
 
-            col = bins_fm[f].astype(jnp.int32)
+            col = bins_fm[meta.feat2phys[f] if bundled else f].astype(
+                jnp.int32)
+            if bundled:
+                col = decode_feature_col(col, f, meta)
             go_left = go_left_node(col, t, dl, meta.is_categorical[f], cb,
                                    meta.missing_types[f], meta.num_bins[f],
                                    meta.default_bins[f])
@@ -196,6 +213,7 @@ def build_wave_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
                 pend_large=st.pend_large.at[st.pend_cnt].set(large),
                 pend_cnt=st.pend_cnt + 1,
                 tree=tr,
+                cegb_coupled=cc,
             )
 
         return jax.lax.cond(ok, do, lambda s: s, st)
@@ -255,7 +273,7 @@ def build_wave_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
                         if T >= N:
                             return hist_pallas_wave(
                                 bins_fm, gv, hv, cv, st.leaf_id, slot_leaf,
-                                B=B, block_rows=block_rows, highest=highest,
+                                B=B_phys, block_rows=block_rows, highest=highest,
                                 interpret=interpret)
                         # index build lives inside the branch: full-tier
                         # waves never pay for it
@@ -272,7 +290,7 @@ def build_wave_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
                                            st.leaf_id[idx_t], -2)
                         return hist_pallas_wave(
                             bins_c, vc[:, 0], vc[:, 1], vc[:, 2], leaf_c,
-                            slot_leaf, B=B, block_rows=block_rows,
+                            slot_leaf, B=B_phys, block_rows=block_rows,
                             highest=highest, interpret=interpret)
                     return f
 
@@ -289,19 +307,27 @@ def build_wave_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
                         [tier_call(T) for T in tiers], 0)  # [F, B, C]
             else:
                 hw = hist_pallas_wave(bins_fm, gv, hv, cv, st.leaf_id,
-                                      slot_leaf, B=B, block_rows=block_rows,
-                                      highest=highest,
-                                      interpret=interpret)  # [F, B, C]
+                                      slot_leaf, B=B_phys,
+                                      block_rows=block_rows, highest=highest,
+                                      interpret=interpret)  # [Fp, Bp, C]
             if reduce_fn is not None:
                 # global histograms: every device now sees the same wave
                 # result and takes identical split decisions
                 hw = reduce_fn(hw)
+            if bundled:
+                # physical columns -> per-feature histograms + elided
+                # default-bin reconstruction (io/bundling.py layout)
+                hw = expand_bundled(hw, meta, B)         # [F, B, C]
             Fdim = hw.shape[0]
             ws = hw[:, :, :3 * P].reshape(Fdim, B, P, 3).transpose(2, 0, 1, 3)
 
             smalls = st.pend_small                       # [P]
             larges = st.pend_large
             dead = smalls < 0
+            if bundled:
+                sl = jnp.maximum(smalls, 0)
+                ws = jax.vmap(fix_default_bins, in_axes=(0, 0, 0, 0, None))(
+                    ws, st.leaf_g[sl], st.leaf_h[sl], st.leaf_c[sl], meta)
             no_sib = larges < 0
             parents = jnp.minimum(smalls, jnp.where(no_sib, smalls, larges))
             parents = jnp.maximum(parents, 0)
@@ -317,10 +343,10 @@ def build_wave_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
             valid = cand >= 0
             cl = jnp.where(valid, cand, 0)
             bs = jax.vmap(
-                _scan_leaf, in_axes=(0, 0, 0, 0, 0, 0, 0, None))(
+                _scan_leaf, in_axes=(0, 0, 0, 0, 0, 0, 0, None, None))(
                 hist[cl], st.leaf_g[cl], st.leaf_h[cl], st.leaf_c[cl],
                 st.leaf_min_c[cl], st.leaf_max_c[cl], st.leaf_depth[cl],
-                feature_mask)
+                feature_mask, st.cegb_coupled)
             cl_w = jnp.where(valid, cand, L)
             st = st._replace(
                 hist=hist,
@@ -344,9 +370,14 @@ def build_wave_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
         return jax.lax.cond(st.pend_cnt > 0, do, lambda s: s, st)
 
     # ---------------- driver -------------------------------------------
-    def grow(bins_fm, g, h, sample_mask, feature_mask):
-        F, N = bins_fm.shape
+    def grow(bins_fm, g, h, sample_mask, feature_mask, cegb_coupled=None):
+        N = bins_fm.shape[1]
+        F = int(meta.num_bins.shape[0])
         W = bitset_words(B)
+        if cegb is not None and cegb_coupled is None:
+            cegb_coupled = jnp.zeros((F,), jnp.float32)
+        if cegb is None:
+            cegb_coupled = None
         gv = (g * sample_mask).astype(jnp.float32)
         hv = (h * sample_mask).astype(jnp.float32)
         cv = sample_mask.astype(jnp.float32)
@@ -384,6 +415,7 @@ def build_wave_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
             pend_large=jnp.full((P,), -1, jnp.int32),
             pend_cnt=jnp.int32(1),
             tree=_empty_tree(L, W),
+            cegb_coupled=cegb_coupled,
         )
         # Alternate split and wave phases until no ready leaf has positive
         # gain and nothing is pending.  The first body iteration has no
@@ -412,6 +444,8 @@ def build_wave_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
             leaf_count=st.leaf_c[:L].astype(jnp.int32),
             leaf_weight=st.leaf_h[:L],
         )
+        if cegb is not None:
+            return tr, st.leaf_id, st.cegb_coupled
         return tr, st.leaf_id
 
     return grow

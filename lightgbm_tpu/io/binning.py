@@ -435,6 +435,22 @@ class BinMapper:
                 res = np.where(iv == cat, b, res)
         return int(res[0]) if scalar else res
 
+    def value_to_bin_predict(self, value, sentinel: int) -> np.ndarray:
+        """Prediction-time value→bin for CATEGORICAL features: any value
+        that is NaN, negative or an unseen category maps to ``sentinel`` (a
+        bin index outside every node's category bitset), so bin-space
+        traversal routes it right — exactly the reference's
+        CategoricalDecision, which casts to int and sends negatives/unknowns
+        down the right child before any missing handling (reference:
+        include/LightGBM/tree.h:262-303)."""
+        v = np.atleast_1d(np.asarray(value, dtype=np.float64))
+        res = np.full(v.shape, sentinel, dtype=np.int32)
+        iv = np.where(np.isnan(v) | (v < 0), -1, v).astype(np.int64)
+        for cat, b in self.categorical_2_bin.items():
+            if cat >= 0:
+                res = np.where(iv == cat, b, res)
+        return res
+
     # ------------------------------------------------------------------
     def to_dict(self) -> dict:
         return {

@@ -1,0 +1,178 @@
+"""Exclusive Feature Bundling (EFB) — host-side bundle construction.
+
+Mutually-exclusive sparse features (rarely non-default in the same row) are
+packed into shared physical columns, so the device histograms F_phys ≪ F
+columns per pass (reference: src/io/dataset.cpp:41-263 — GetConflictCount
+:51, FindGroups :91, FastFeatureBundling :169).
+
+Physical bin layout per multi-feature bundle (TPU-first simplification of
+the reference's ``FeatureGroup`` bin offsets, feature_group.h:37-55):
+
+- physical bin 0  = every member at its default (zero) bin;
+- member i owns [offset_i, offset_i + num_bin_i); its feature-space bin b
+  is stored verbatim as ``offset_i + b`` whenever ``b != default_bin_i``.
+
+Decode is branch-free on device: a row whose physical bin falls outside a
+member's range is at that member's default bin.  The member's default-bin
+histogram mass is reconstructed from leaf totals, exactly the reference's
+elided-bin trick (Dataset::FixHistogram, dataset.cpp:1044-1063).
+
+Conflicts (two members non-default in one row) lose the earlier member's
+value to its default — EFB's documented approximation, bounded by
+``max_conflict_rate``.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List
+
+import numpy as np
+
+from ..utils import log
+
+
+@dataclass
+class BundleInfo:
+    """Feature→physical-column mapping for a constructed dataset."""
+    feat2phys: np.ndarray       # i32 [F_inner] physical column per feature
+    feat_offset: np.ndarray     # i32 [F_inner] bin offset inside the column
+    needs_fix: np.ndarray       # bool [F_inner] default-bin mass elided
+    num_phys: int
+    phys_num_bin: np.ndarray    # i32 [num_phys] bins used per column
+    groups: List[List[int]] = field(default_factory=list)
+
+    @classmethod
+    def identity(cls, nbins: np.ndarray) -> "BundleInfo":
+        F = len(nbins)
+        return cls(
+            feat2phys=np.arange(F, dtype=np.int32),
+            feat_offset=np.zeros(F, dtype=np.int32),
+            needs_fix=np.zeros(F, dtype=bool),
+            num_phys=F,
+            phys_num_bin=np.asarray(nbins, dtype=np.int32),
+            groups=[[i] for i in range(F)],
+        )
+
+    @property
+    def is_trivial(self) -> bool:
+        return self.num_phys == len(self.feat2phys) and not self.needs_fix.any()
+
+
+def find_groups(nonzero_masks: List[np.ndarray], nbins: List[int],
+                sparse_rates: List[float], total_sample: int,
+                max_conflict_rate: float, sparse_threshold: float = 0.8,
+                max_bins_per_group: int = 256) -> List[List[int]]:
+    """Greedy conflict-budgeted grouping over a row sample.
+
+    ``nonzero_masks[i]``: bool [S] — sample rows where feature i is
+    non-default.  Features with sparse_rate < ``sparse_threshold`` are kept
+    as singletons (bundling dense features buys nothing and eats the
+    conflict budget; the reference reaches the same outcome through its
+    budget arithmetic, dataset.cpp:110-140).
+
+    Mirrors FindGroups (reference: dataset.cpp:91-167): features visited in
+    descending non-default count, first group with enough remaining budget
+    and bin capacity wins.
+    """
+    F = len(nonzero_masks)
+    budget_total = int(max_conflict_rate * total_sample)
+    candidates = [i for i in range(F) if sparse_rates[i] >= sparse_threshold]
+    cand_set = set(candidates)
+    dense = [i for i in range(F) if i not in cand_set]
+
+    order = sorted(candidates,
+                   key=lambda i: -int(nonzero_masks[i].sum()))
+    group_masks: List[np.ndarray] = []
+    group_bins: List[int] = []
+    group_conflicts: List[int] = []
+    groups: List[List[int]] = []
+    for i in order:
+        mi = nonzero_masks[i]
+        placed = False
+        for gi in range(len(groups)):
+            # bin 0 is the shared all-default bin
+            if group_bins[gi] + nbins[i] > max_bins_per_group:
+                continue
+            conflicts = int((group_masks[gi] & mi).sum())
+            if group_conflicts[gi] + conflicts <= budget_total:
+                groups[gi].append(i)
+                group_masks[gi] |= mi
+                group_bins[gi] += nbins[i]
+                group_conflicts[gi] += conflicts
+                placed = True
+                break
+        if not placed:
+            groups.append([i])
+            group_masks.append(mi.copy())
+            group_bins.append(1 + nbins[i])
+            group_conflicts.append(0)
+    return groups + [[i] for i in dense]
+
+
+def build_bundles(mappers, used_features: np.ndarray, sample: np.ndarray,
+                  total_rows: int, max_conflict_rate: float,
+                  max_bins_per_group: int = 256) -> BundleInfo:
+    """Construct the bundle mapping from the bin-finding row sample.
+
+    ``mappers``: all BinMappers (original feature indexing);
+    ``used_features``: original indices of non-trivial features (inner
+    order); ``sample``: [S, P] raw values used for bin finding.
+    """
+    F = len(used_features)
+    nbins = [mappers[int(j)].num_bin for j in used_features]
+    if F < 2:
+        return BundleInfo.identity(np.asarray(nbins))
+
+    S = sample.shape[0]
+    masks, rates = [], []
+    for inner, j in enumerate(used_features):
+        m = mappers[int(j)]
+        fb = m.value_to_bin(sample[:, int(j)])
+        nz = np.asarray(fb) != m.default_bin
+        masks.append(nz)
+        rates.append(1.0 - float(nz.sum()) / max(S, 1))
+
+    groups = find_groups(masks, nbins, rates, S, max_conflict_rate,
+                         max_bins_per_group=max_bins_per_group)
+    if all(len(g) <= 1 for g in groups):
+        return BundleInfo.identity(np.asarray(nbins))
+
+    feat2phys = np.zeros(F, np.int32)
+    feat_offset = np.zeros(F, np.int32)
+    needs_fix = np.zeros(F, bool)
+    phys_num_bin = []
+    for gp, members in enumerate(groups):
+        if len(members) == 1:
+            i = members[0]
+            feat2phys[i] = gp
+            feat_offset[i] = 0
+            phys_num_bin.append(nbins[i])
+        else:
+            off = 1  # bin 0 = all-default
+            for i in members:
+                feat2phys[i] = gp
+                feat_offset[i] = off
+                needs_fix[i] = True
+                off += nbins[i]
+            phys_num_bin.append(off)
+    n_bundled = sum(len(g) for g in groups if len(g) > 1)
+    log.info("EFB: bundled %d sparse features into %d columns "
+             "(%d physical columns total, was %d)",
+             n_bundled, sum(1 for g in groups if len(g) > 1),
+             len(groups), F)
+    return BundleInfo(
+        feat2phys=feat2phys, feat_offset=feat_offset, needs_fix=needs_fix,
+        num_phys=len(groups),
+        phys_num_bin=np.asarray(phys_num_bin, np.int32),
+        groups=groups,
+    )
+
+
+def encode_column(bundle: BundleInfo, members: List[int], feat_bins: List[np.ndarray],
+                  default_bins: List[int], n: int, dtype) -> np.ndarray:
+    """Encode one multi-member physical column from members' feature bins."""
+    col = np.zeros(n, dtype=dtype)
+    for i, fb, db in zip(members, feat_bins, default_bins):
+        nz = fb != db
+        col[nz] = (bundle.feat_offset[i] + fb[nz]).astype(dtype)
+    return col

@@ -118,13 +118,28 @@ class BinnedDataset:
         self.metadata: Metadata = Metadata()
         self.feature_names: List[str] = []
         self.max_bin: int = 255
-        # EFB bundle info (filled by io.bundling when enabled)
-        self.group_of_feature: Optional[np.ndarray] = None
+        # EFB bundle info (io.bundling.BundleInfo; None = no bundling)
+        self.bundle = None
 
     # ------------------------------------------------------------------
     @property
     def num_features(self) -> int:
+        """Number of used (inner) features — NOT physical columns; with
+        EFB several features share one ``X_bin`` column."""
+        if self.real_feature_idx is not None:
+            return len(self.real_feature_idx)
         return 0 if self.X_bin is None else self.X_bin.shape[1]
+
+    @property
+    def num_phys_features(self) -> int:
+        """Physical ``X_bin`` columns (== num_features unless bundled)."""
+        return 0 if self.X_bin is None else self.X_bin.shape[1]
+
+    def phys_max_bins(self) -> np.ndarray:
+        """Bins per PHYSICAL column (kernel histogram width)."""
+        if self.bundle is not None:
+            return self.bundle.phys_num_bin
+        return self.feature_max_bins()
 
     @property
     def num_total_bin(self) -> int:
@@ -175,6 +190,7 @@ class BinnedDataset:
             ds.bin_offsets = reference.bin_offsets
             ds.feature_names = reference.feature_names
             ds.max_bin = reference.max_bin
+            ds.bundle = reference.bundle
             ds._binarize(data)
             return ds
 
@@ -186,12 +202,15 @@ class BinnedDataset:
                               else rng.sample(n, sample_cnt).astype(np.int64))
         sample = data[sample_indices]
 
+        from ..utils.timetag import timetag
         cat_set = set(int(c) for c in categorical_features)
         ds.bin_mappers = []
         forced = _load_forced_bins(config.forcedbins_filename, p, config.max_bin)
         # min-data filter threshold scaled to the bin-finding sample
         # (reference: dataset_loader.cpp:599 filter_cnt)
         filter_cnt = int(config.min_data_in_leaf * len(sample) / n)
+        bin_finding = timetag("bin finding")
+        bin_finding.__enter__()
         for j in range(p):
             col = sample[:, j]
             # drop "zero" values (|v| <= kZeroThreshold); NaN compares False so
@@ -204,8 +223,18 @@ class BinnedDataset:
                             bt, config.use_missing, config.zero_as_missing,
                             forced.get(j))
             ds.bin_mappers.append(mapper)
+        bin_finding.__exit__()
         ds._finalize_features()
-        ds._binarize(data)
+        if (config.enable_bundle and len(ds.real_feature_idx) >= 2
+                and config.max_bin <= 255
+                and getattr(config, "tree_learner", "serial") == "serial"):
+            from .bundling import build_bundles
+            bundle = build_bundles(ds.bin_mappers, ds.real_feature_idx,
+                                   sample, n, config.max_conflict_rate)
+            if not bundle.is_trivial:
+                ds.bundle = bundle
+        with timetag("binarize"):
+            ds._binarize(data)
         return ds
 
     def _finalize_features(self) -> None:
@@ -220,6 +249,9 @@ class BinnedDataset:
             log.warning("There are no meaningful features, as all feature values are constant.")
 
     def _binarize(self, data: np.ndarray) -> None:
+        if self.bundle is not None:
+            self._binarize_bundled(data)
+            return
         used = self.real_feature_idx
         # size storage by the ACTUAL bin counts: categorical bin finding can
         # exceed max_bin (reference sizes by num_bin, bin.cpp CreateBin)
@@ -259,6 +291,33 @@ class BinnedDataset:
                     m.missing_type, m.num_bin, X[:, inner])
             else:
                 X[:, inner] = m.value_to_bin(data[:, int(j)]).astype(dtype)
+        self.X_bin = X
+
+    def _binarize_bundled(self, data: np.ndarray) -> None:
+        """Binarize into EFB physical columns (see io/bundling.py layout;
+        reference: Dataset::PushOneRow -> FeatureGroup::PushData,
+        dataset.h:333-359)."""
+        from .bundling import encode_column
+        bundle = self.bundle
+        used = self.real_feature_idx
+        widest = int(max(bundle.phys_num_bin.max(initial=0),
+                         self.feature_max_bins().max(initial=0)))
+        dtype = (np.uint8 if widest <= 256
+                 else np.uint16 if widest <= 65536 else np.uint32)
+        X = np.zeros((self.num_data, bundle.num_phys), dtype=dtype)
+        for gp, members in enumerate(bundle.groups):
+            if len(members) == 1:
+                inner = members[0]
+                m = self.bin_mappers[int(used[inner])]
+                X[:, gp] = m.value_to_bin(data[:, int(used[inner])]).astype(dtype)
+                continue
+            mappers = [self.bin_mappers[int(used[inner])]
+                       for inner in members]
+            feat_bins = [np.asarray(m.value_to_bin(data[:, int(used[i])]))
+                         for m, i in zip(mappers, members)]
+            X[:, gp] = encode_column(
+                bundle, members, feat_bins,
+                [m.default_bin for m in mappers], self.num_data, dtype)
         self.X_bin = X
 
     # ------------------------------------------------------------------

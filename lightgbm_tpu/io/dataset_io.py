@@ -32,6 +32,12 @@ def save_dataset(ds: BinnedDataset, path: str) -> None:
         "real_feature_idx": ds.real_feature_idx,
         "bin_offsets": ds.bin_offsets,
     }
+    if ds.bundle is not None:
+        meta["bundle_groups"] = ds.bundle.groups
+        arrays["bundle_feat2phys"] = ds.bundle.feat2phys
+        arrays["bundle_feat_offset"] = ds.bundle.feat_offset
+        arrays["bundle_needs_fix"] = ds.bundle.needs_fix
+        arrays["bundle_phys_num_bin"] = ds.bundle.phys_num_bin
     md = ds.metadata
     for name in ("label", "weights", "init_score"):
         v = getattr(md, name)
@@ -59,6 +65,16 @@ def load_dataset(path: str) -> BinnedDataset:
         ds.used_feature_map = z["used_feature_map"]
         ds.real_feature_idx = z["real_feature_idx"]
         ds.bin_offsets = z["bin_offsets"]
+        if "bundle_feat2phys" in z:
+            from .bundling import BundleInfo
+            ds.bundle = BundleInfo(
+                feat2phys=z["bundle_feat2phys"],
+                feat_offset=z["bundle_feat_offset"],
+                needs_fix=z["bundle_needs_fix"],
+                num_phys=int(ds.X_bin.shape[1]),
+                phys_num_bin=z["bundle_phys_num_bin"],
+                groups=[list(g) for g in meta.get("bundle_groups", [])],
+            )
         ds.metadata = Metadata(ds.num_data)
         if "md_label" in z:
             ds.metadata.set_label(z["md_label"])

@@ -1,0 +1,91 @@
+"""Forced splits: a BFS split prescription loaded from JSON.
+
+The analog of the reference's ``forcedsplits_filename``
+(reference: src/treelearner/serial_tree_learner.cpp:607-770 ForceSplits;
+config.h forcedsplits_filename).  The JSON is a binary tree of
+``{"feature": <original index>, "threshold": <value>, "left": {...},
+"right": {...}}`` nodes applied breadth-first at the start of EVERY tree,
+before gain-driven growth.
+
+The TPU formulation flattens the BFS into three fixed arrays indexed by
+split step ``k`` — (leaf, inner_feature, threshold_bin) — exploiting the
+grower's leaf-numbering invariant (left child keeps the parent's leaf
+index, the right child becomes leaf ``k+1``, core/grower.py TreeArrays).
+The grower consumes them as compile-time constants: step ``k`` splits
+``leaf[k]`` on ``feature[k]`` at ``threshold_bin[k]`` when the JSON
+prescribes one, falling back to best-gain search afterwards.
+
+Deviations from the reference, both documented here on purpose:
+- thresholds are binned with ``value_to_bin`` and rows route left when
+  ``bin <= threshold_bin`` — the framework's single split convention —
+  rather than reproducing GatherInfoForThreshold's strict-< scan;
+- categorical features cannot be forced (the reference allows a single
+  category threshold); a warning is raised and forcing stops there.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..utils import log
+from .binning import BIN_NUMERICAL
+
+
+def load_forced_splits(path: str, ds, num_leaves: int
+                       ) -> Optional[Tuple[np.ndarray, np.ndarray,
+                                           np.ndarray]]:
+    """Parse ``forcedsplits_filename`` into step-indexed arrays.
+
+    Returns ``(leaf, feature, threshold_bin)`` int32 arrays of length
+    ``num_leaves - 1`` padded with -1 where growth is gain-driven, or
+    ``None`` when no file is configured.  ``ds`` is the BinnedDataset
+    whose mappers define the bin space.
+    """
+    if not path:
+        return None
+    if not os.path.exists(path):
+        log.fatal(f"Forced splits file {path} does not exist")
+    with open(path) as fh:
+        root = json.load(fh)
+    if not root:
+        return None
+
+    n = max(num_leaves - 1, 1)
+    fl = np.full(n, -1, np.int32)
+    ff = np.full(n, -1, np.int32)
+    ft = np.zeros(n, np.int32)
+    queue = [(root, 0)]  # (json node, leaf index) — BFS like the reference
+    k = 0
+    while queue and k < n:
+        node, leaf = queue.pop(0)
+        orig = int(node["feature"])
+        thr = float(node["threshold"])
+        if orig < 0 or orig >= ds.num_total_features:
+            log.fatal(f"Forced split feature {orig} out of range")
+        inner = int(ds.used_feature_map[orig])
+        if inner < 0:
+            log.warning("Forced split on unused feature %d ignored; "
+                        "remaining forced splits dropped", orig)
+            break
+        mapper = ds.inner_to_mapper(inner)
+        if mapper.bin_type != BIN_NUMERICAL:
+            log.warning("Forced split on categorical feature %d is not "
+                        "supported; remaining forced splits dropped", orig)
+            break
+        fl[k] = leaf
+        ff[k] = inner
+        ft[k] = int(np.asarray(mapper.value_to_bin(np.asarray([thr])))[0])
+        if isinstance(node.get("left"), dict):
+            queue.append((node["left"], leaf))
+        if isinstance(node.get("right"), dict):
+            queue.append((node["right"], k + 1))
+        k += 1
+    if queue and k >= n:
+        log.warning("Forced splits exceed num_leaves-1=%d; extra nodes "
+                    "ignored", n)
+    if k == 0:
+        return None
+    return fl, ff, ft

@@ -204,9 +204,11 @@ class LoadedGBDT:
     def num_trees(self) -> int:
         return len(self.models)
 
-    def predict_raw(self, X, num_iteration=None, start_iteration: int = 0):
+    def predict_raw(self, X, num_iteration=None, start_iteration: int = 0,
+                    early_stop=None):
         from ..boosting.gbdt import GBDT
-        raw = GBDT.predict_raw(self, X, num_iteration, start_iteration)
+        raw = GBDT.predict_raw(self, X, num_iteration, start_iteration,
+                               early_stop)
         if self.average_output:
             start, stop = GBDT._iter_window(self, num_iteration, start_iteration)
             raw /= max(stop - start, 1)
@@ -222,11 +224,16 @@ def _borrow_gbdt_methods():
     LoadedGBDT.predict = GBDT.predict
     LoadedGBDT.predict_leaf = GBDT.predict_leaf
     LoadedGBDT._iter_window = GBDT._iter_window
+    LoadedGBDT._early_stop_spec = GBDT._early_stop_spec
 
-    def feature_importance(self, importance_type="split"):
+    def feature_importance(self, importance_type="split",
+                           start_iteration=0, num_iteration=-1):
         n = len(self.feature_names) or 1
         imp = np.zeros(n)
-        for tree in self.models:
+        K = self.num_tpi
+        n_iter = len(self.models) // K
+        stop = n_iter if num_iteration <= 0 else min(num_iteration, n_iter)
+        for tree in self.models[start_iteration * K: stop * K]:
             for i in range(max(tree.num_leaves - 1, 0)):
                 f = int(tree.split_feature[i])
                 imp[f] += 1.0 if importance_type == "split" \

@@ -45,8 +45,10 @@ def load_text(path: str, config) -> Tuple[np.ndarray, Optional[np.ndarray],
     """Load a dense text data file.
 
     Returns (X, label, weight, group, feature_names); label/weight/group
-    are None when absent.  Column indices in the config count ALL file
-    columns (label included), like the reference.
+    are None when absent.  ``label_column`` counts ALL file columns;
+    integer weight/group/ignore indices do NOT count the label column
+    (reference: config.h weight_column doc), while ``name:`` specs are
+    absolute header positions.
     """
     if not os.path.exists(path):
         log.fatal(f"Data file {path} does not exist")
@@ -70,10 +72,20 @@ def load_text(path: str, config) -> Tuple[np.ndarray, Optional[np.ndarray],
                                 names, "label")
     if label_col is None:
         label_col = 0
-    weight_col = _resolve_column(getattr(config, "weight_column", ""),
-                                 names, "weight")
-    group_col = _resolve_column(getattr(config, "group_column", ""),
-                                names, "group")
+
+    def skip_label(col: Optional[int], spec) -> Optional[int]:
+        """Integer weight/group/ignore indices do NOT count the label
+        column (reference: config.h weight_column doc — "index starts
+        from 0 and it doesn't count the label column when passing type
+        is int"); name: specs are absolute."""
+        if col is None or str(spec).startswith("name:"):
+            return col
+        return col + 1 if col >= label_col else col
+
+    wspec = getattr(config, "weight_column", "")
+    gspec = getattr(config, "group_column", "")
+    weight_col = skip_label(_resolve_column(wspec, names, "weight"), wspec)
+    group_col = skip_label(_resolve_column(gspec, names, "group"), gspec)
 
     drop = {label_col}
     if weight_col is not None:
@@ -83,7 +95,8 @@ def load_text(path: str, config) -> Tuple[np.ndarray, Optional[np.ndarray],
     ignore = getattr(config, "ignore_column", "")
     if ignore:
         for tok in str(ignore).split(","):
-            c = _resolve_column(tok.strip(), names, "ignore")
+            tok = tok.strip()
+            c = skip_label(_resolve_column(tok, names, "ignore"), tok)
             if c is not None:
                 drop.add(c)
 

@@ -131,10 +131,22 @@ def _hist_wave_kernel(bins_ref, vecs_ref, slot_ref, out_ref, *,
     elif mode == "bf16":
         gh_b = gh.astype(jnp.bfloat16)
 
+    # Feature packing: with B <= 64 a single feature's one-hot only spans B
+    # of the MXU's 128 output rows — concatenating ``pack`` features' one-hot
+    # factors into one [BR, pack*B] operand fills the systolic array, so a
+    # max_bin=63 run really is ~4x cheaper than max_bin=255 (the reference's
+    # GPU backend has the same bins-per-workgroup economics and recommends
+    # 63 bins, docs/GPU-Performance.rst:128-130).
+    pack = max(1, 128 // B) if 128 % B == 0 and FB % max(1, 128 // B) == 0 \
+        else 1
     iota = jax.lax.broadcasted_iota(jnp.int32, (1, B), 1)
-    for f in range(FB):
-        col = bins_ref[f, :].astype(jnp.int32)
-        eq = col[:, None] == iota
+    for f in range(0, FB, pack):
+        if pack == 1:
+            eq = bins_ref[f, :].astype(jnp.int32)[:, None] == iota
+        else:
+            eq = jnp.concatenate(
+                [bins_ref[f + p, :].astype(jnp.int32)[:, None] == iota
+                 for p in range(pack)], axis=1)        # [BR, pack*B]
         if mode == "highest":
             oh = eq.astype(jnp.float32)
             acc = jax.lax.dot_general(
@@ -155,7 +167,11 @@ def _hist_wave_kernel(bins_ref, vecs_ref, slot_ref, out_ref, *,
             acc = jax.lax.dot_general(
                 oh, gh_b, (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
-        out_ref[f] += acc
+        if pack == 1:
+            out_ref[f] += acc
+        else:
+            for p in range(pack):
+                out_ref[f + p] += acc[p * B:(p + 1) * B]
 
 
 def _resolve_mode(highest) -> str:

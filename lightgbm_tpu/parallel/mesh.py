@@ -23,6 +23,12 @@ from ..core.meta import DeviceMeta, SplitConfig
 
 AXIS = "data"
 
+# Recorded network topology (reference: network.cpp Network::Init state).
+# Collectives themselves are emitted by XLA; multi-host bootstrap reads
+# this via ``init_distributed`` — see also capi.LGBM_NetworkInit.
+NETWORK = {"machines": "", "num_machines": 1, "rank": 0,
+           "local_listen_port": 12400}
+
 
 def pad_rows(mesh: Mesh, bins, g, h, mask):
     """Pad the row axis to a multiple of the mesh size with mask=0 rows —
@@ -68,7 +74,8 @@ _ROW_SHARDED = ((P(AXIS), P(AXIS), P(AXIS), P(AXIS), P()), (P(), P(AXIS)))
 
 
 def make_data_parallel_grower(meta: DeviceMeta, cfg: SplitConfig, B: int,
-                              mesh: Mesh, hist_fn=hist_onehot):
+                              mesh: Mesh, hist_fn=hist_onehot,
+                              B_phys=None, bundled: bool = False):
     """Rows sharded; histograms and root stats psum'd — same algorithm as
     single-device growth; trees match up to f32 reduction-order effects on
     near-tied gains (reference: data_parallel_tree_learner.cpp:119-164,246).
@@ -77,7 +84,8 @@ def make_data_parallel_grower(meta: DeviceMeta, cfg: SplitConfig, B: int,
     bins/g/h/sample_mask sharded on axis 0; the tree is replicated, leaf_id
     sharded.
     """
-    grow = build_grow_fn(meta, cfg, B, hist_fn=hist_fn, reduce_fn=_psum)
+    grow = build_grow_fn(meta, cfg, B, hist_fn=hist_fn, reduce_fn=_psum,
+                         B_phys=B_phys, bundled=bundled)
     return _shard_map(grow, mesh, *_ROW_SHARDED)
 
 
@@ -117,6 +125,8 @@ def _pad_meta_block(meta: DeviceMeta, F: int, F_pad: int) -> DeviceMeta:
     def pad(a, fill):
         return jnp.concatenate(
             [a, jnp.full((F_pad - F,), fill, a.dtype)]) if F_pad > F else a
+    # bundle-mapping fields are identity here: the feature-parallel
+    # learner rejects EFB datasets (make_engine_grower raises)
     return DeviceMeta(
         num_bins=pad(meta.num_bins, 1),
         default_bins=pad(meta.default_bins, 0),
@@ -124,6 +134,9 @@ def _pad_meta_block(meta: DeviceMeta, F: int, F_pad: int) -> DeviceMeta:
         monotone=pad(meta.monotone, 0),
         penalties=pad(meta.penalties, 1.0),
         is_categorical=pad(meta.is_categorical, False),
+        feat2phys=jnp.arange(F_pad, dtype=jnp.int32),
+        feat_offset=jnp.zeros(F_pad, jnp.int32),
+        needs_fix=jnp.zeros(F_pad, bool),
     )
 
 
@@ -229,7 +242,8 @@ def build_mesh(tpu_mesh_shape: str = "") -> Mesh:
 
 
 def make_engine_grower(mode: str, meta: DeviceMeta, cfg: SplitConfig, B: int,
-                       mesh: Mesh, wave_kw=None, top_k: int = 20):
+                       mesh: Mesh, wave_kw=None, top_k: int = 20,
+                       B_phys=None, bundled: bool = False):
     """Engine-facing TreeLearner factory for the parallel modes (reference:
     tree_learner.cpp:13-36): wraps the mesh growers behind the serial
     signature ``grow(bins, g, h, mask, fmask) -> (tree, leaf_id)`` on
@@ -245,15 +259,32 @@ def make_engine_grower(mode: str, meta: DeviceMeta, cfg: SplitConfig, B: int,
 
     D = mesh.devices.size
     if mode == "data" and wave_kw is not None:
-        inner = make_data_parallel_wave_grower(meta, cfg, B, mesh, **wave_kw)
+        inner = make_data_parallel_wave_grower(meta, cfg, B, mesh,
+                                               B_phys=B_phys,
+                                               bundled=bundled, **wave_kw)
         feature_major = True
     elif mode == "data":
-        inner = make_data_parallel_grower(meta, cfg, B, mesh)
+        inner = make_data_parallel_grower(meta, cfg, B, mesh,
+                                          B_phys=B_phys, bundled=bundled)
         feature_major = False
     elif mode == "voting":
+        if bundled:
+            # the top-k gate can zero a bundled physical column entirely,
+            # after which fix_default_bins would fabricate the whole leaf
+            # mass at each member's default bin — silently wrong splits
+            raise ValueError(
+                "EFB-bundled datasets are not supported by the voting-"
+                "parallel learner; set enable_bundle=false or use "
+                "tree_learner=data/serial")
         inner = make_voting_parallel_grower(meta, cfg, B, mesh, top_k=top_k)
         feature_major = False
     elif mode == "feature":
+        if bundled:
+            # per-device column slicing assumes identity bundle mapping
+            raise ValueError(
+                "EFB-bundled datasets are not supported by the feature-"
+                "parallel learner; set enable_bundle=false or use "
+                "tree_learner=data/voting/serial")
         # replicated inputs — no padding or resharding needed
         return make_feature_parallel_grower(meta, cfg, B, mesh)
     else:

@@ -369,10 +369,12 @@ class LGBMRanker(LGBMModel):
         saved = self.objective
         if self.objective is None:
             self.objective = "lambdarank"
+        had_eval_at = "eval_at" in self._other_params
         self._other_params.setdefault("eval_at", list(eval_at))
         try:
             super().fit(X, y, group=group, eval_group=eval_group, **kwargs)
         finally:
             self.objective = saved
-            self._other_params.pop("eval_at", None)
+            if not had_eval_at:  # keep a constructor-supplied eval_at for
+                self._other_params.pop("eval_at", None)  # clone()/refits
         return self

@@ -1,0 +1,103 @@
+"""Device batch forest prediction == host per-tree prediction.
+
+The device path (core/forest.py) replaces the reference's CPU Predictor
+pipeline (reference: src/application/predictor.hpp:28-271,
+src/boosting/gbdt_prediction.cpp:1-91); these tests pin it to the host
+numpy traversal on data with NaNs, categoricals and multiclass outputs.
+"""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _train(params, X, y, rounds=12, cat=None):
+    ds = lgb.Dataset(X, label=y,
+                     categorical_feature=cat if cat is not None else "auto",
+                     params=params)
+    return lgb.train(dict(params), ds, num_boost_round=rounds)
+
+
+def test_device_predict_matches_host_binary():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(1500, 8))
+    X[rng.random(X.shape) < 0.05] = np.nan  # exercise missing routing
+    y = (np.nansum(X[:, :3], axis=1) > 0).astype(np.float64)
+    bst = _train({"objective": "binary", "num_leaves": 15, "verbose": -1,
+                  "min_data_in_leaf": 5}, X, y)
+    g = bst._gbdt
+    Xt = rng.normal(size=(400, 8))
+    Xt[rng.random(Xt.shape) < 0.05] = np.nan
+    start, stop = g._iter_window(None, 0)
+    host = np.zeros((Xt.shape[0], 1))
+    for it in range(start, stop):
+        host[:, 0] += g.models[it].predict(Xt)
+    dev = g._predict_raw_device(Xt, start, stop)
+    np.testing.assert_allclose(dev, host, rtol=0, atol=1e-4)
+
+
+def test_device_predict_matches_host_multiclass_categorical():
+    rng = np.random.default_rng(1)
+    n = 1200
+    Xnum = rng.normal(size=(n, 4))
+    Xcat = rng.integers(0, 12, size=(n, 2)).astype(np.float64)
+    X = np.hstack([Xnum, Xcat])
+    y = ((Xnum[:, 0] > 0).astype(int) + (Xcat[:, 0] > 5).astype(int))
+    bst = _train({"objective": "multiclass", "num_class": 3,
+                  "num_leaves": 15, "verbose": -1, "min_data_in_leaf": 5},
+                 X, y.astype(np.float64), cat=[4, 5])
+    g = bst._gbdt
+    Xt = np.hstack([rng.normal(size=(300, 4)),
+                    rng.integers(-1, 14, size=(300, 2)).astype(np.float64)])
+    start, stop = g._iter_window(None, 0)
+    K = g.num_tpi
+    host = np.zeros((Xt.shape[0], K))
+    for it in range(start, stop):
+        for k in range(K):
+            host[:, k] += g.models[it * K + k].predict(Xt)
+    dev = g._predict_raw_device(Xt, start, stop)
+    np.testing.assert_allclose(dev, host, rtol=0, atol=1e-4)
+
+
+def test_prediction_early_stop_converges_to_same_argmax():
+    """Early-stopped margins keep the predicted class (reference contract:
+    prediction_early_stop.cpp stops only when the margin is decisive)."""
+    rng = np.random.default_rng(2)
+    X = rng.normal(size=(1000, 6))
+    y = (X[:, 0] + X[:, 1] > 0).astype(np.float64)
+    bst = _train({"objective": "binary", "num_leaves": 31, "verbose": -1,
+                  "min_data_in_leaf": 5}, X, y, rounds=30)
+    g = bst._gbdt
+    Xt = rng.normal(size=(500, 6))
+    full = g.predict(Xt)
+    es = {"kind": "binary", "round_period": 5, "margin_threshold": 4.0}
+    raw_es = g.predict_raw(Xt, early_stop=es)
+    np.testing.assert_array_equal((full > 0.5),
+                                  (raw_es[:, 0] > 0.0))
+    # device path agrees with host path under early stop
+    dev_es = g._predict_raw_device(Xt, *g._iter_window(None, 0),
+                                   early_stop=es)
+    np.testing.assert_allclose(dev_es, raw_es, rtol=0, atol=1e-4)
+
+
+def test_booster_predict_uses_device_on_large_work(monkeypatch):
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(2000, 5))
+    y = (X[:, 0] > 0).astype(np.float64)
+    bst = _train({"objective": "binary", "num_leaves": 7, "verbose": -1},
+                 X, y, rounds=8)
+    g = bst._gbdt
+    monkeypatch.setattr(type(g), "_DEVICE_PREDICT_MIN_WORK", 1)
+    called = {}
+    orig = type(g)._predict_raw_device
+
+    def spy(self, *a, **kw):
+        called["yes"] = True
+        return orig(self, *a, **kw)
+
+    monkeypatch.setattr(type(g), "_predict_raw_device", spy)
+    p_dev = bst.predict(X)
+    assert called.get("yes")
+    monkeypatch.setattr(type(g), "_DEVICE_PREDICT_MIN_WORK", 10**18)
+    p_host = bst.predict(X)
+    np.testing.assert_allclose(p_dev, p_host, rtol=0, atol=1e-5)
