@@ -1,0 +1,301 @@
+"""C API surface tests (reference: include/LightGBM/c_api.h, tested via
+python-package's basic.py usage patterns and tests/c_api_test)."""
+import ctypes
+import json
+import os
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu import capi
+
+PARAMS = ("objective=binary num_leaves=7 min_data_in_leaf=5 "
+          "max_bin=63 verbose=-1 seed=3")
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(7)
+    X = rng.normal(size=(400, 8))
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float64)
+    return X, y
+
+
+@pytest.fixture(scope="module")
+def booster(data):
+    X, y = data
+    h = capi.Ref()
+    assert capi.LGBM_DatasetCreateFromMat(
+        X, capi.C_API_DTYPE_FLOAT64, 400, 8, 1,
+        "max_bin=63 min_data_in_leaf=5", None, h) == 0, \
+        capi.LGBM_GetLastError()
+    assert capi.LGBM_DatasetSetField(
+        h, "label", y.astype(np.float32), 400, capi.C_API_DTYPE_FLOAT32) == 0
+    bh = capi.Ref()
+    assert capi.LGBM_BoosterCreate(h, PARAMS, bh) == 0, \
+        capi.LGBM_GetLastError()
+    fin = capi.Ref()
+    for _ in range(8):
+        assert capi.LGBM_BoosterUpdateOneIter(bh, fin) == 0, \
+            capi.LGBM_GetLastError()
+    return h, bh
+
+
+def test_dataset_handle_introspection(booster, data):
+    h, _ = booster
+    n = ctypes.c_int64(0)
+    assert capi.LGBM_DatasetGetNumData(h, n) == 0 and n.value == 400
+    f = capi.Ref()
+    assert capi.LGBM_DatasetGetNumFeature(h, f) == 0 and f.value == 8
+    names = capi.Ref()
+    assert capi.LGBM_DatasetGetFeatureNames(h, names) == 0
+    assert names.value[0] == "Column_0"
+    ln, buf, t = capi.Ref(), np.zeros(400, np.float32), capi.Ref()
+    assert capi.LGBM_DatasetGetField(h, "label", ln, buf, t) == 0
+    assert ln.value == 400 and t.value == capi.C_API_DTYPE_FLOAT32
+    np.testing.assert_array_equal(buf, data[1].astype(np.float32))
+
+
+def test_booster_counters_and_eval(booster):
+    _, bh = booster
+    it = capi.Ref()
+    assert capi.LGBM_BoosterGetCurrentIteration(bh, it) == 0
+    assert it.value == 8
+    nc = capi.Ref()
+    assert capi.LGBM_BoosterGetNumClasses(bh, nc) == 0 and nc.value == 1
+    k = capi.Ref()
+    assert capi.LGBM_BoosterNumModelPerIteration(bh, k) == 0 and k.value == 1
+    tot = capi.Ref()
+    assert capi.LGBM_BoosterNumberOfTotalModel(bh, tot) == 0
+    assert tot.value == 8
+    cnt = capi.Ref()
+    assert capi.LGBM_BoosterGetEvalCounts(bh, cnt) == 0
+    ln, names = capi.Ref(), capi.Ref()
+    assert capi.LGBM_BoosterGetEvalNames(bh, ln, names) == 0
+    assert ln.value == cnt.value
+    vals = np.zeros(max(cnt.value, 1))
+    vl = capi.Ref()
+    assert capi.LGBM_BoosterGetEval(bh, 0, vl, vals) == 0
+    assert vl.value == cnt.value
+
+
+def test_predict_variants_agree(booster, data):
+    X, _ = data
+    _, bh = booster
+    ol = capi.Ref()
+    dense = np.zeros(400)
+    assert capi.LGBM_BoosterPredictForMat(
+        bh, X, capi.C_API_DTYPE_FLOAT64, 400, 8, 1,
+        capi.C_API_PREDICT_NORMAL, 0, -1, "", ol, dense) == 0
+    # CSR of the same matrix
+    from scipy.sparse import csc_matrix, csr_matrix
+    sp = csr_matrix(X)
+    out_csr = np.zeros(400)
+    assert capi.LGBM_BoosterPredictForCSR(
+        bh, sp.indptr, capi.C_API_DTYPE_INT32, sp.indices, sp.data,
+        capi.C_API_DTYPE_FLOAT64, len(sp.indptr), sp.nnz, 8,
+        capi.C_API_PREDICT_NORMAL, 0, -1, "", ol, out_csr) == 0
+    np.testing.assert_allclose(out_csr, dense, rtol=1e-12)
+    spc = csc_matrix(X)
+    out_csc = np.zeros(400)
+    assert capi.LGBM_BoosterPredictForCSC(
+        bh, spc.indptr, capi.C_API_DTYPE_INT32, spc.indices, spc.data,
+        capi.C_API_DTYPE_FLOAT64, len(spc.indptr), spc.nnz, 400,
+        capi.C_API_PREDICT_NORMAL, 0, -1, "", ol, out_csc) == 0
+    np.testing.assert_allclose(out_csc, dense, rtol=1e-12)
+    # single row
+    one = np.zeros(1)
+    assert capi.LGBM_BoosterPredictForMatSingleRow(
+        bh, X[3], capi.C_API_DTYPE_FLOAT64, 8, 1,
+        capi.C_API_PREDICT_NORMAL, 0, -1, "", ol, one) == 0
+    np.testing.assert_allclose(one[0], dense[3], rtol=1e-12)
+    # raw score differs from transformed
+    raw = np.zeros(400)
+    assert capi.LGBM_BoosterPredictForMat(
+        bh, X, capi.C_API_DTYPE_FLOAT64, 400, 8, 1,
+        capi.C_API_PREDICT_RAW_SCORE, 0, -1, "", ol, raw) == 0
+    np.testing.assert_allclose(1.0 / (1.0 + np.exp(-raw)), dense, rtol=1e-6)
+
+
+def test_calc_num_predict(booster):
+    _, bh = booster
+    n = capi.Ref()
+    assert capi.LGBM_BoosterCalcNumPredict(
+        bh, 10, capi.C_API_PREDICT_NORMAL, 0, -1, n) == 0
+    assert n.value == 10
+    assert capi.LGBM_BoosterCalcNumPredict(
+        bh, 10, capi.C_API_PREDICT_LEAF_INDEX, 0, -1, n) == 0
+    assert n.value == 80
+    assert capi.LGBM_BoosterCalcNumPredict(
+        bh, 10, capi.C_API_PREDICT_CONTRIB, 0, -1, n) == 0
+    assert n.value == 90
+
+
+def test_save_load_dump(booster, tmp_path):
+    _, bh = booster
+    sl, ss = capi.Ref(), capi.Ref()
+    assert capi.LGBM_BoosterSaveModelToString(bh, 0, -1, 0, sl, ss) == 0
+    assert sl.value == len(ss.value) and "tree" in ss.value
+    path = str(tmp_path / "model.txt")
+    assert capi.LGBM_BoosterSaveModel(bh, 0, -1, path) == 0
+    ni, nh = capi.Ref(), capi.Ref()
+    assert capi.LGBM_BoosterCreateFromModelfile(path, ni, nh) == 0
+    assert ni.value == 8
+    jl, js = capi.Ref(), capi.Ref()
+    assert capi.LGBM_BoosterDumpModel(bh, 0, -1, 0, jl, js) == 0
+    dumped = json.loads(js.value)
+    assert dumped["num_tree_per_iteration"] == 1
+    assert len(dumped["tree_info"]) == 8
+    assert capi.LGBM_BoosterFree(nh) == 0
+
+
+def test_feature_importance_and_leaf_value(booster):
+    _, bh = booster
+    imp = np.zeros(8)
+    assert capi.LGBM_BoosterFeatureImportance(bh, -1, 0, imp) == 0
+    assert imp.sum() > 0  # split counts
+    v = capi.Ref()
+    assert capi.LGBM_BoosterGetLeafValue(bh, 0, 0, v) == 0
+    assert np.isfinite(v.value)
+    assert capi.LGBM_BoosterSetLeafValue(bh, 0, 0, v.value) == 0
+
+
+def test_error_path_sets_last_error():
+    bad = capi.Ref(999999)
+    out = capi.Ref()
+    assert capi.LGBM_BoosterGetCurrentIteration(bad, out) == -1
+    assert "invalid" in capi.LGBM_GetLastError()
+
+
+def test_push_rows_and_subset(data):
+    X, y = data
+    ref_h = capi.Ref()
+    assert capi.LGBM_DatasetCreateFromMat(
+        X, capi.C_API_DTYPE_FLOAT64, 400, 8, 1,
+        "max_bin=63 min_data_in_leaf=5", None, ref_h) == 0
+    push_h = capi.Ref()
+    assert capi.LGBM_DatasetCreateByReference(ref_h, 400, push_h) == 0
+    assert capi.LGBM_DatasetPushRows(
+        push_h, X[:250], capi.C_API_DTYPE_FLOAT64, 250, 8, 0) == 0
+    assert capi.LGBM_DatasetSetField(
+        push_h, "label", y.astype(np.float32), 400,
+        capi.C_API_DTYPE_FLOAT32) == 0
+    assert capi.LGBM_DatasetPushRows(
+        push_h, X[250:], capi.C_API_DTYPE_FLOAT64, 150, 8, 250) == 0
+    n = capi.Ref()
+    assert capi.LGBM_DatasetGetNumData(push_h, n) == 0 and n.value == 400
+    sub_h = capi.Ref()
+    idx = np.arange(0, 400, 2, dtype=np.int32)
+    assert capi.LGBM_DatasetGetSubset(ref_h, idx, len(idx), "", sub_h) == 0, \
+        capi.LGBM_GetLastError()
+    assert capi.LGBM_DatasetGetNumData(sub_h, n) == 0 and n.value == 200
+    for h in (ref_h, push_h, sub_h):
+        assert capi.LGBM_DatasetFree(h) == 0
+
+
+def test_merge_and_shuffle(data):
+    X, y = data
+
+    def make_booster(iters):
+        h, bh = capi.Ref(), capi.Ref()
+        assert capi.LGBM_DatasetCreateFromMat(
+            X, capi.C_API_DTYPE_FLOAT64, 400, 8, 1,
+            "max_bin=63 min_data_in_leaf=5", None, h) == 0
+        assert capi.LGBM_DatasetSetField(
+            h, "label", y.astype(np.float32), 400,
+            capi.C_API_DTYPE_FLOAT32) == 0
+        assert capi.LGBM_BoosterCreate(h, PARAMS, bh) == 0
+        fin = capi.Ref()
+        for _ in range(iters):
+            assert capi.LGBM_BoosterUpdateOneIter(bh, fin) == 0
+        return bh
+
+    a, b = make_booster(3), make_booster(2)
+    assert capi.LGBM_BoosterMerge(a, b) == 0, capi.LGBM_GetLastError()
+    tot = capi.Ref()
+    assert capi.LGBM_BoosterNumberOfTotalModel(a, tot) == 0
+    assert tot.value == 5
+    assert capi.LGBM_BoosterShuffleModels(a, 0, -1) == 0, \
+        capi.LGBM_GetLastError()
+    assert capi.LGBM_BoosterNumberOfTotalModel(a, tot) == 0
+    assert tot.value == 5
+
+
+def test_custom_objective_update(data):
+    X, y = data
+    h, bh = capi.Ref(), capi.Ref()
+    assert capi.LGBM_DatasetCreateFromMat(
+        X, capi.C_API_DTYPE_FLOAT64, 400, 8, 1,
+        "max_bin=63 min_data_in_leaf=5", None, h) == 0
+    assert capi.LGBM_DatasetSetField(
+        h, "label", y.astype(np.float32), 400, capi.C_API_DTYPE_FLOAT32) == 0
+    assert capi.LGBM_BoosterCreate(
+        h, "objective=none num_leaves=7 min_data_in_leaf=5 max_bin=63 "
+        "verbose=-1", bh) == 0, capi.LGBM_GetLastError()
+    fin = capi.Ref()
+    score = np.zeros(400)
+    for _ in range(3):
+        p = 1.0 / (1.0 + np.exp(-score))
+        grad = (p - y).astype(np.float32)
+        hess = (p * (1 - p)).astype(np.float32)
+        assert capi.LGBM_BoosterUpdateOneIterCustom(bh, grad, hess, fin) == 0, \
+            capi.LGBM_GetLastError()
+        ol = capi.Ref()
+        assert capi.LGBM_BoosterPredictForMat(
+            bh, X, capi.C_API_DTYPE_FLOAT64, 400, 8, 1,
+            capi.C_API_PREDICT_RAW_SCORE, 0, -1, "", ol, score) == 0
+    from sklearn.metrics import roc_auc_score
+    assert roc_auc_score(y, score) > 0.8
+
+
+def test_network_init_records_topology():
+    assert capi.LGBM_NetworkInit("127.0.0.1:121 127.0.0.1:122", 121, 120,
+                                 2) == 0
+    from lightgbm_tpu.parallel import mesh
+    assert mesh.NETWORK["num_machines"] == 2
+    assert capi.LGBM_NetworkFree() == 0
+    assert mesh.NETWORK["num_machines"] == 1
+
+
+def test_dataset_from_file_and_predict_for_file(tmp_path, data):
+    X, y = data
+    train = str(tmp_path / "train.tsv")
+    np.savetxt(train, np.column_stack([y, X]), delimiter="\t", fmt="%.8g")
+    h = capi.Ref()
+    assert capi.LGBM_DatasetCreateFromFile(
+        train, "max_bin=63 min_data_in_leaf=5 label_column=0", None, h) == 0, \
+        capi.LGBM_GetLastError()
+    n = capi.Ref()
+    assert capi.LGBM_DatasetGetNumData(h, n) == 0 and n.value == 400
+    bh = capi.Ref()
+    assert capi.LGBM_BoosterCreate(h, PARAMS, bh) == 0
+    fin = capi.Ref()
+    for _ in range(3):
+        assert capi.LGBM_BoosterUpdateOneIter(bh, fin) == 0
+    # prediction files carry the same layout as training data (label col 0)
+    pred_in = str(tmp_path / "pred.tsv")
+    np.savetxt(pred_in, np.column_stack([y, X]), delimiter="\t", fmt="%.8g")
+    pred_out = str(tmp_path / "pred_out.txt")
+    assert capi.LGBM_BoosterPredictForFile(
+        bh, pred_in, 0, capi.C_API_PREDICT_NORMAL, 0, -1, "", pred_out) == 0, \
+        capi.LGBM_GetLastError()
+    got = np.loadtxt(pred_out)
+    ol = capi.Ref()
+    want = np.zeros(400)
+    assert capi.LGBM_BoosterPredictForMat(
+        bh, X, capi.C_API_DTYPE_FLOAT64, 400, 8, 1,
+        capi.C_API_PREDICT_NORMAL, 0, -1, "", ol, want) == 0
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_dataset_dump_text(tmp_path, data):
+    X, y = data
+    h = capi.Ref()
+    assert capi.LGBM_DatasetCreateFromMat(
+        X, capi.C_API_DTYPE_FLOAT64, 400, 8, 1,
+        "max_bin=63 min_data_in_leaf=5", None, h) == 0
+    out = str(tmp_path / "dump.txt")
+    assert capi.LGBM_DatasetDumpText(h, out) == 0, capi.LGBM_GetLastError()
+    lines = open(out).read().splitlines()
+    assert lines[0] == "num_data: 400"
+    assert any(line.startswith("feature 0 num_bin=") for line in lines)
