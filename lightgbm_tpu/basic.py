@@ -460,12 +460,24 @@ class Booster:
         return self
 
     def free_network(self) -> "Booster":
+        from .parallel.distributed import shutdown
+        shutdown()  # tears down jax.distributed AND resets NETWORK
         return self
 
     def set_network(self, machines, local_listen_port: int = 12400,
                     listen_time_out: int = 120, num_machines: int = 1) -> "Booster":
-        """Multi-host training is configured through JAX distributed
-        initialization (parallel/), not TCP machine lists."""
-        log.warning("set_network is a no-op: use jax.distributed / the "
-                    "parallel module for multi-host training")
+        """Record the machine topology; like the reference, the network
+        itself comes up when a Booster binds to training data — here via
+        ``parallel.distributed.init_distributed`` (jax.distributed) instead
+        of the reference's TCP linkers (reference: basic.py set_network ->
+        Network::Init, network.cpp:24-74)."""
+        from .parallel import mesh as _mesh
+        from .parallel.distributed import parse_machine_list
+        if not isinstance(machines, str):
+            machines = ",".join(str(m) for m in machines)
+        hosts = parse_machine_list(machines, default_port=local_listen_port)
+        _mesh.NETWORK.update(machines=",".join(hosts),
+                             num_machines=int(num_machines),
+                             local_listen_port=int(local_listen_port),
+                             time_out=int(listen_time_out))
         return self

@@ -58,7 +58,127 @@ class _TreeList(list):
         return super().__iter__()
 
 
-class GBDT:
+class PredictorBase:
+    """Prediction + forest-introspection surface shared by the trainer
+    (``GBDT``) and file-loaded boosters (``io.model_io.LoadedGBDT``).
+    Subclasses provide ``models``/``num_tpi``/``objective``/``config``;
+    the device fast path only engages when ``train_ds`` is present
+    (reference split: GBDT vs Predictor, src/application/predictor.hpp)."""
+
+    def _iter_window(self, num_iteration: Optional[int],
+                     start_iteration: int = 0) -> Tuple[int, int]:
+        """Resolve (start, stop) boosting-iteration bounds."""
+        n_iters = len(self.models) // self.num_tpi
+        stop = n_iters if num_iteration is None or num_iteration <= 0 \
+            else min(start_iteration + num_iteration, n_iters)
+        return start_iteration, stop
+
+    # device prediction kicks in above this many (rows x trees): below it,
+    # host numpy wins on dispatch+binning overhead
+    _DEVICE_PREDICT_MIN_WORK = 2_000_000
+
+    def predict_raw(self, X: np.ndarray, num_iteration: Optional[int] = None,
+                    start_iteration: int = 0,
+                    early_stop: Optional[dict] = None) -> np.ndarray:
+        X = np.ascontiguousarray(X, dtype=np.float64)
+        K = self.num_tpi
+        start, stop = self._iter_window(num_iteration, start_iteration)
+        work = X.shape[0] * max(stop - start, 0) * K
+        if (self.train_ds is not None
+                and work >= self._DEVICE_PREDICT_MIN_WORK):
+            return self._predict_raw_device(X, start, stop, early_stop)
+        out = np.zeros((X.shape[0], K))
+        active = None
+        if early_stop is not None:
+            active = np.ones(X.shape[0], dtype=bool)
+        for i, it in enumerate(range(start, stop)):
+            Xa = X if active is None else X[active]
+            for k in range(K):
+                if active is None:
+                    out[:, k] += self.models[it * K + k].predict(X)
+                else:
+                    out[active, k] += self.models[it * K + k].predict(Xa)
+            if active is not None and (i + 1) % early_stop["round_period"] == 0:
+                if early_stop["kind"] == "binary":
+                    margin = 2.0 * np.abs(out[:, 0])
+                else:
+                    top2 = np.sort(out, axis=1)[:, -2:]
+                    margin = top2[:, 1] - top2[:, 0]
+                active &= margin < early_stop["margin_threshold"]
+                if not active.any():
+                    break
+        return out
+
+    def _early_stop_spec(self) -> Optional[dict]:
+        """Margin-based prediction early stop from config (reference:
+        CreatePredictionEarlyStopInstance, prediction_early_stop.cpp:54-88);
+        None unless ``pred_early_stop`` is set and the objective is a
+        classification (margins are meaningless for regression)."""
+        cfg = self.config
+        if cfg is None or not getattr(cfg, "pred_early_stop", False):
+            return None
+        if self.num_tpi > 1:
+            kind = "multiclass"
+        elif self.objective is not None and self.objective.name in (
+                "binary", "cross_entropy", "cross_entropy_lambda"):
+            kind = "binary"
+        else:
+            return None
+        return {"kind": kind,
+                "round_period": int(cfg.pred_early_stop_freq) or 1,
+                "margin_threshold": float(cfg.pred_early_stop_margin)}
+
+    def predict(self, X, num_iteration=None, raw_score=False,
+                start_iteration: int = 0) -> np.ndarray:
+        raw = self.predict_raw(X, num_iteration, start_iteration,
+                               early_stop=self._early_stop_spec())
+        if not raw_score and self.objective is not None:
+            conv = self.objective.convert_output(
+                raw if self.num_tpi > 1 else raw[:, 0])
+            return np.asarray(conv)
+        return raw if self.num_tpi > 1 else raw[:, 0]
+
+    def predict_leaf(self, X, num_iteration=None,
+                     start_iteration: int = 0) -> np.ndarray:
+        X = np.ascontiguousarray(X, dtype=np.float64)
+        K = self.num_tpi
+        start, stop = self._iter_window(num_iteration, start_iteration)
+        cols = []
+        for it in range(start, stop):
+            for k in range(K):
+                cols.append(self.models[it * K + k].predict_leaf(X))
+        return np.stack(cols, axis=1) if cols else np.zeros((X.shape[0], 0))
+
+    @property
+    def num_trees(self) -> int:
+        return len(self.models)
+
+    def current_iteration(self) -> int:
+        return len(self.models) // self.num_tpi
+
+    def feature_importance(self, importance_type: str = "split",
+                           start_iteration: int = 0,
+                           num_iteration: int = -1) -> np.ndarray:
+        """(reference: GBDT::FeatureImportance, gbdt.cpp:573-600)."""
+        n = (self.train_ds.num_total_features if self.train_ds is not None
+             else (len(getattr(self, "feature_names", [])) or 1))
+        imp = np.zeros(n)
+        K = self.num_tpi
+        n_iter = len(self.models) // K
+        stop = n_iter if num_iteration <= 0 else min(num_iteration, n_iter)
+        for tree in list(self.models)[start_iteration * K: stop * K]:
+            nn = max(tree.num_leaves - 1, 0)
+            for i in range(nn):
+                f = int(tree.split_feature[i])
+                if importance_type == "split":
+                    imp[f] += 1.0
+                else:
+                    imp[f] += max(0.0, float(tree.split_gain[i]))
+        return imp
+
+
+
+class GBDT(PredictorBase):
     """Gradient Boosting Decision Tree trainer."""
 
     # subclasses that inspect/rewrite the newest trees every iteration
@@ -186,22 +306,47 @@ class GBDT:
             log.warning("cegb_penalty_feature_lazy needs per-row state; "
                         "falling back to the XLA serial grower")
             wave_ok = False
+
+        tl = getattr(config, "tree_learner", "serial")
+
+        # ---- by-node feature sampling (reference: col_sampler.hpp) ------
+        bynode = None
+        bf = float(getattr(config, "feature_fraction_bynode", 1.0))
+        if bf < 1.0:
+            if tl != "serial":
+                log.warning("feature_fraction_bynode is ignored with "
+                            "tree_learner=%s (supported on the serial "
+                            "learner only)", tl)
+            else:
+                bynode = bf
+                if wave_ok:
+                    log.info("feature_fraction_bynode set: using the XLA "
+                             "serial grower (per-node masks need the "
+                             "one-split-at-a-time loop)")
+                    wave_ok = False
+        self._bynode_on = bynode is not None
         self.uses_wave = bool(wave_ok)
 
         # ---- parallel tree learners (reference: tree_learner.cpp:13-36) --
-        tl = getattr(config, "tree_learner", "serial")
         if forced is not None and tl != "serial":
             log.warning("forcedsplits_filename is ignored with "
                         "tree_learner=%s (supported on the serial "
                         "learner only)", tl)
             forced = None
         if tl != "serial" and train_ds.num_features > 0:
-            from ..parallel.mesh import build_mesh, make_engine_grower
-            if int(getattr(config, "num_machines", 1)) > 1:
-                log.warning(
-                    "num_machines > 1 (multi-host) is not wired up; using "
-                    "the %d local devices of this process instead",
-                    len(jax.devices()))
+            from ..parallel.mesh import NETWORK, build_mesh, make_engine_grower
+            if (int(getattr(config, "num_machines", 1)) > 1
+                    or int(NETWORK.get("num_machines", 1)) > 1):
+                # bring up the global runtime so build_mesh sees every
+                # host's chips (reference: Network::Init before learner
+                # construction, application.cpp:54-66)
+                from ..parallel.distributed import init_distributed
+                init_distributed(config,
+                                 machines=NETWORK.get("machines", ""),
+                                 num_machines=int(NETWORK.get("num_machines", 1)),
+                                 local_listen_port=int(NETWORK.get(
+                                     "local_listen_port", 12400)),
+                                 time_out=NETWORK.get("time_out"))
             mesh = build_mesh(config.tpu_mesh_shape)
             wave_kw = None
             if self.uses_wave:
@@ -245,7 +390,8 @@ class GBDT:
             self._grow_raw = build_grow_fn(self.meta, self.split_cfg, self.B,
                                            B_phys=self.B_phys,
                                            bundled=self._bundled,
-                                           cegb=cegb_cfg, forced=forced)
+                                           cegb=cegb_cfg, forced=forced,
+                                           bynode=bynode)
             self._grow_bins = self._bins
         self._grow = jax.jit(self._grow_raw)
         if self._cegb_on:
@@ -316,9 +462,11 @@ class GBDT:
             self._grad_fn = None
 
         grow_raw = self._grow_raw
+        bynode_on = getattr(self, "_bynode_on", False)
 
         @functools.partial(jax.jit, static_argnames=("k",))
-        def grow_apply(bins, g, h, bag_mask, feature_mask, score, lr, k):
+        def grow_apply(bins, g, h, bag_mask, feature_mask, score, lr, k,
+                       seed=None):
             """grow + shrink + train-score update for class k, one call.
 
             The leaf values are zeroed ON DEVICE when the tree failed to
@@ -326,8 +474,12 @@ class GBDT:
             host can check the leaf count one iteration late — that lag-1
             check is what lets the next iteration's growth overlap the
             device->host fetch instead of serializing on it."""
-            arrs, leaf_id = grow_raw(bins, g[:, k], h[:, k], bag_mask,
-                                     feature_mask)
+            if bynode_on:
+                arrs, leaf_id = grow_raw(bins, g[:, k], h[:, k], bag_mask,
+                                         feature_mask, tree_seed=seed)
+            else:
+                arrs, leaf_id = grow_raw(bins, g[:, k], h[:, k], bag_mask,
+                                         feature_mask)
             grew = arrs.num_leaves > 1
             lv = jnp.where(grew, arrs.leaf_value * lr, 0.0)
             arrs = arrs._replace(
@@ -533,19 +685,37 @@ class GBDT:
         return 0.0
 
     def _bagging(self, it: int, g, h):
-        """Row-subsample mask refresh (reference: gbdt.cpp:160-276). May
+        """Row-subsample mask refresh (reference: gbdt.cpp:160-276),
+        including the balanced pos/neg variant (gbdt.cpp:166-197). May
         return modified gradients (GOSS amplification)."""
         import jax.numpy as jnp
         c = self.config
         N = self.train_ds.num_data
-        if c.bagging_freq <= 0 or c.bagging_fraction >= 1.0:
+        pos_f = float(getattr(c, "pos_bagging_fraction", 1.0))
+        neg_f = float(getattr(c, "neg_bagging_fraction", 1.0))
+        balanced = pos_f < 1.0 or neg_f < 1.0
+        if c.bagging_freq <= 0 or (c.bagging_fraction >= 1.0
+                                   and not balanced):
             return g, h
         if it % c.bagging_freq != 0:
             return g, h
-        cnt = int(c.bagging_fraction * N)
-        idx = self._rng.permutation(N)[:cnt]
-        mask = np.zeros(N, dtype=bool)
-        mask[idx] = True
+        if balanced:
+            # per-class fractions; requires 0/1 labels like the reference
+            # (gbdt.cpp:130-136 NeedsBalancedBagging label check)
+            label = self.train_ds.metadata.label
+            if label is None or not np.all((label == 0) | (label == 1)):
+                log.fatal("pos/neg_bagging_fraction requires binary (0/1) "
+                          "labels")
+            mask = np.zeros(N, dtype=bool)
+            for cls, frac in ((1, pos_f), (0, neg_f)):
+                rows = np.flatnonzero(label == cls)
+                take = self._rng.permutation(len(rows))[:int(frac * len(rows))]
+                mask[rows[take]] = True
+        else:
+            cnt = int(c.bagging_fraction * N)
+            idx = self._rng.permutation(N)[:cnt]
+            mask = np.zeros(N, dtype=bool)
+            mask[idx] = True
         self._bag_mask_host = mask
         self._bag_mask = jnp.asarray(mask.astype(np.float32))
         return g, h
@@ -614,10 +784,12 @@ class GBDT:
                     # slow path: leaf refit needs host residuals between
                     # growth and shrinkage (serial_tree_learner.cpp:855-893);
                     # CEGB threads penalty state through the call
+                    grow_kw = ({"tree_seed": jnp.uint32(self.iter_ * K + k)}
+                               if getattr(self, "_bynode_on", False) else {})
                     with timetag("tree growth"):
                         res = self._grow(self._grow_bins, g[:, k], h[:, k],
                                          self._bag_mask, feature_mask,
-                                         *self._cegb_state)
+                                         *self._cegb_state, **grow_kw)
                         sync(res[1])
                     if self._cegb_on:
                         arrs, leaf_id = res[0], res[1]
@@ -630,7 +802,8 @@ class GBDT:
                         arrs, leaf_id, new_score = self._grow_apply(
                             self._grow_bins, g, h, self._bag_mask,
                             feature_mask, self._train_score,
-                            jnp.float32(self.shrinkage_rate), k)
+                            jnp.float32(self.shrinkage_rate), k,
+                            seed=jnp.uint32(self.iter_ * K + k))
                         sync(new_score)
                     if lag_ok:
                         nl_dev = arrs.num_leaves
@@ -871,50 +1044,6 @@ class GBDT:
         return s[:, 0] if self.num_tpi == 1 else s
 
     # ------------------------------------------------------------------
-    def _iter_window(self, num_iteration: Optional[int],
-                     start_iteration: int = 0) -> Tuple[int, int]:
-        """Resolve (start, stop) boosting-iteration bounds."""
-        n_iters = len(self.models) // self.num_tpi
-        stop = n_iters if num_iteration is None or num_iteration <= 0 \
-            else min(start_iteration + num_iteration, n_iters)
-        return start_iteration, stop
-
-    # device prediction kicks in above this many (rows x trees): below it,
-    # host numpy wins on dispatch+binning overhead
-    _DEVICE_PREDICT_MIN_WORK = 2_000_000
-
-    def predict_raw(self, X: np.ndarray, num_iteration: Optional[int] = None,
-                    start_iteration: int = 0,
-                    early_stop: Optional[dict] = None) -> np.ndarray:
-        X = np.ascontiguousarray(X, dtype=np.float64)
-        K = self.num_tpi
-        start, stop = self._iter_window(num_iteration, start_iteration)
-        work = X.shape[0] * max(stop - start, 0) * K
-        if (self.train_ds is not None
-                and work >= self._DEVICE_PREDICT_MIN_WORK):
-            return self._predict_raw_device(X, start, stop, early_stop)
-        out = np.zeros((X.shape[0], K))
-        active = None
-        if early_stop is not None:
-            active = np.ones(X.shape[0], dtype=bool)
-        for i, it in enumerate(range(start, stop)):
-            Xa = X if active is None else X[active]
-            for k in range(K):
-                if active is None:
-                    out[:, k] += self.models[it * K + k].predict(X)
-                else:
-                    out[active, k] += self.models[it * K + k].predict(Xa)
-            if active is not None and (i + 1) % early_stop["round_period"] == 0:
-                if early_stop["kind"] == "binary":
-                    margin = 2.0 * np.abs(out[:, 0])
-                else:
-                    top2 = np.sort(out, axis=1)[:, -2:]
-                    margin = top2[:, 1] - top2[:, 0]
-                active &= margin < early_stop["margin_threshold"]
-                if not active.any():
-                    break
-        return out
-
     def _predict_raw_device(self, X: np.ndarray, start: int, stop: int,
                             early_stop: Optional[dict] = None) -> np.ndarray:
         """Batch the whole forest window onto the device and score every
@@ -968,70 +1097,7 @@ class GBDT:
                 out[:, inner] = m.value_to_bin(col)
         return out
 
-    def _early_stop_spec(self) -> Optional[dict]:
-        """Margin-based prediction early stop from config (reference:
-        CreatePredictionEarlyStopInstance, prediction_early_stop.cpp:54-88);
-        None unless ``pred_early_stop`` is set and the objective is a
-        classification (margins are meaningless for regression)."""
-        cfg = self.config
-        if cfg is None or not getattr(cfg, "pred_early_stop", False):
-            return None
-        if self.num_tpi > 1:
-            kind = "multiclass"
-        elif self.objective is not None and self.objective.name in (
-                "binary", "cross_entropy", "cross_entropy_lambda"):
-            kind = "binary"
-        else:
-            return None
-        return {"kind": kind,
-                "round_period": int(cfg.pred_early_stop_freq) or 1,
-                "margin_threshold": float(cfg.pred_early_stop_margin)}
 
-    def predict(self, X, num_iteration=None, raw_score=False,
-                start_iteration: int = 0) -> np.ndarray:
-        raw = self.predict_raw(X, num_iteration, start_iteration,
-                               early_stop=self._early_stop_spec())
-        if not raw_score and self.objective is not None:
-            conv = self.objective.convert_output(
-                raw if self.num_tpi > 1 else raw[:, 0])
-            return np.asarray(conv)
-        return raw if self.num_tpi > 1 else raw[:, 0]
-
-    def predict_leaf(self, X, num_iteration=None,
-                     start_iteration: int = 0) -> np.ndarray:
-        X = np.ascontiguousarray(X, dtype=np.float64)
-        K = self.num_tpi
-        start, stop = self._iter_window(num_iteration, start_iteration)
-        cols = []
-        for it in range(start, stop):
-            for k in range(K):
-                cols.append(self.models[it * K + k].predict_leaf(X))
-        return np.stack(cols, axis=1) if cols else np.zeros((X.shape[0], 0))
-
-    @property
-    def num_trees(self) -> int:
-        return len(self.models)
-
-    def current_iteration(self) -> int:
-        return len(self.models) // self.num_tpi
-
-    def feature_importance(self, importance_type: str = "split",
-                           start_iteration: int = 0,
-                           num_iteration: int = -1) -> np.ndarray:
-        """(reference: GBDT::FeatureImportance, gbdt.cpp:573-600)."""
-        imp = np.zeros(self.train_ds.num_total_features)
-        K = self.num_tpi
-        n_iter = len(self.models) // K
-        stop = n_iter if num_iteration <= 0 else min(num_iteration, n_iter)
-        for tree in list(self.models)[start_iteration * K: stop * K]:
-            nn = max(tree.num_leaves - 1, 0)
-            for i in range(nn):
-                f = int(tree.split_feature[i])
-                if importance_type == "split":
-                    imp[f] += 1.0
-                else:
-                    imp[f] += max(0.0, float(tree.split_gain[i]))
-        return imp
 
 
 def _constant_tree(output: float) -> Tree:

@@ -87,6 +87,7 @@ class _GrowState(NamedTuple):
     # CEGB state (zeros / [1,1] dummies when disabled)
     cegb_coupled: jnp.ndarray = None   # f32 [F] pending coupled penalties
     cegb_rows: jnp.ndarray = None      # u8 [F, N] 1 = feature unused by row
+    bykey: jnp.ndarray = None          # PRNG key for by-node feature masks
 
 
 def _empty_tree(L: int, W: int = 1) -> TreeArrays:
@@ -148,7 +149,8 @@ def decode_feature_col(colp, f, meta: DeviceMeta):
 def build_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
                   hist_fn=hist_onehot, reduce_fn=None, best_split_fn=None,
                   subtract_sibling: bool = True, B_phys: int = None,
-                  bundled: bool = False, cegb=None, forced=None):
+                  bundled: bool = False, cegb=None, forced=None,
+                  bynode: float = None):
     """Build an *unjitted* ``grow(bins, g, h, sample_mask, feature_mask)``.
 
     bins: uint8/int32 [N, F]; g/h: f32 [N]; sample_mask: f32 [N] (bagging);
@@ -184,6 +186,11 @@ def build_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
     splits are NOT re-searched (the reference partially re-adjusts them,
     UpdateLeafBestSplits :63-77) — they refresh when those leaves split.
 
+    ``bynode``: feature_fraction_bynode < 1.0 — every candidate node draws
+    its own feature subset (reference: col_sampler_.GetByNode,
+    serial_tree_learner.cpp:404) from a per-tree PRNG key; ``grow`` then
+    takes a trailing ``tree_seed`` int32 so masks differ across trees.
+
     ``forced``: optional ``(leaf, feature, threshold_bin)`` int32 arrays of
     length ``num_leaves - 1`` from ``io.forced_splits.load_forced_splits``
     — step ``k`` splits ``leaf[k]`` as prescribed when ``feature[k] >= 0``
@@ -210,6 +217,16 @@ def build_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
         def best_split_fn(hist_leaf, sg, sh, sc, min_c, max_c, feature_mask):
             return best_split(hist_leaf, sg, sh, sc, meta, cfg, min_c, max_c,
                               feature_mask=feature_mask)
+
+    if bynode is not None:
+        Fn = int(meta.num_bins.shape[0])
+        bcnt = max(1, int(round(float(bynode) * Fn)))
+
+        def _bynode_mask(key):
+            """Exactly ``bcnt`` features, sampled without replacement."""
+            r = jax.random.uniform(key, (Fn,))
+            th = jax.lax.top_k(r, bcnt)[0][-1]
+            return r >= th
 
     if forced is not None:
         FL = jnp.asarray(forced[0], jnp.int32)
@@ -385,10 +402,16 @@ def build_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
                               (leaf_id == leaf).astype(jnp.float32) * sample_mask)
             pen_r = _cegb_pen(rc, cegb_coupled, cegb_rows,
                               (leaf_id == new).astype(jnp.float32) * sample_mask)
+        fmask_l = fmask_r = feature_mask
+        if bynode is not None:
+            fmask_l = feature_mask & _bynode_mask(
+                jax.random.fold_in(st.bykey, 2 * k))
+            fmask_r = feature_mask & _bynode_mask(
+                jax.random.fold_in(st.bykey, 2 * k + 1))
         bs_l = _child_best(hist[leaf], lg, lh, lc, d, l_min, l_max,
-                           feature_mask, pen_l)
+                           fmask_l, pen_l)
         bs_r = _child_best(hist[new], rg, rh, rc, d, r_min, r_max,
-                           feature_mask, pen_r)
+                           fmask_r, pen_r)
 
         def upd(a, i, v):
             return a.at[i].set(v)
@@ -421,10 +444,17 @@ def build_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
         )
 
     def grow(bins, g, h, sample_mask, feature_mask,
-             cegb_coupled=None, cegb_rows=None):
+             cegb_coupled=None, cegb_rows=None, tree_seed=None):
         from .splitter import bitset_words
         N = bins.shape[0]
         W = bitset_words(B)
+        bykey = None
+        root_fmask = feature_mask
+        if bynode is not None:
+            bykey = jax.random.PRNGKey(
+                tree_seed if tree_seed is not None else 0)
+            root_fmask = feature_mask & _bynode_mask(
+                jax.random.fold_in(bykey, 2 * (L - 1)))
         sum_g = reduce_fn(jnp.sum(g * sample_mask))
         sum_h = reduce_fn(jnp.sum(h * sample_mask))
         cnt = reduce_fn(jnp.sum(sample_mask))
@@ -441,7 +471,7 @@ def build_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
         pen0 = _cegb_pen(cnt, cegb_coupled, cegb_rows, sample_mask) \
             if cegb is not None else None
         bs0 = _child_best(hist0, sum_g, sum_h, cnt, jnp.int32(0),
-                          -inf, inf, feature_mask, pen0)
+                          -inf, inf, root_fmask, pen0)
 
         Lf = jnp.zeros((L,), jnp.float32)
         Li = jnp.zeros((L,), jnp.int32)
@@ -470,6 +500,7 @@ def build_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
             tree=_empty_tree(L, W),
             cegb_coupled=cegb_coupled,
             cegb_rows=cegb_rows,
+            bykey=bykey,
         )
 
         if forced is None:

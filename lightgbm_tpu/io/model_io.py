@@ -13,6 +13,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..boosting.gbdt import PredictorBase
 from ..core.tree import Tree
 from ..utils import log
 
@@ -179,9 +180,12 @@ def _parse_tree_block(lines: Dict[str, str]) -> Tree:
     )
 
 
-class LoadedGBDT:
+class LoadedGBDT(PredictorBase):
     """Prediction-only booster built from a model file (the reference
-    reconstructs a full GBDT; prediction needs only the trees + objective)."""
+    reconstructs a full GBDT; prediction needs only the trees + objective).
+    The whole prediction surface is inherited from ``PredictorBase`` —
+    with ``train_ds = None`` the device fast path is skipped and trees are
+    walked in value space on the host."""
 
     def __init__(self, models: List[Tree], num_tpi: int, objective,
                  feature_names: List[str], feature_infos: List[str],
@@ -197,53 +201,14 @@ class LoadedGBDT:
         self.metrics = []
         self.best_iteration = -1
 
-    def current_iteration(self) -> int:
-        return len(self.models) // self.num_tpi
-
-    @property
-    def num_trees(self) -> int:
-        return len(self.models)
-
     def predict_raw(self, X, num_iteration=None, start_iteration: int = 0,
                     early_stop=None):
-        from ..boosting.gbdt import GBDT
-        raw = GBDT.predict_raw(self, X, num_iteration, start_iteration,
-                               early_stop)
+        raw = super().predict_raw(X, num_iteration, start_iteration,
+                                  early_stop)
         if self.average_output:
-            start, stop = GBDT._iter_window(self, num_iteration, start_iteration)
+            start, stop = self._iter_window(num_iteration, start_iteration)
             raw /= max(stop - start, 1)
         return raw
-
-    predict = None  # assigned below (borrow GBDT implementations)
-    predict_leaf = None
-    feature_importance = None
-
-
-def _borrow_gbdt_methods():
-    from ..boosting.gbdt import GBDT
-    LoadedGBDT.predict = GBDT.predict
-    LoadedGBDT.predict_leaf = GBDT.predict_leaf
-    LoadedGBDT._iter_window = GBDT._iter_window
-    LoadedGBDT._early_stop_spec = GBDT._early_stop_spec
-
-    def feature_importance(self, importance_type="split",
-                           start_iteration=0, num_iteration=-1):
-        n = len(self.feature_names) or 1
-        imp = np.zeros(n)
-        K = self.num_tpi
-        n_iter = len(self.models) // K
-        stop = n_iter if num_iteration <= 0 else min(num_iteration, n_iter)
-        for tree in self.models[start_iteration * K: stop * K]:
-            for i in range(max(tree.num_leaves - 1, 0)):
-                f = int(tree.split_feature[i])
-                imp[f] += 1.0 if importance_type == "split" \
-                    else max(0.0, float(tree.split_gain[i]))
-        return imp
-
-    LoadedGBDT.feature_importance = feature_importance
-
-
-_borrow_gbdt_methods()
 
 
 def load_model_string(model_str: str):

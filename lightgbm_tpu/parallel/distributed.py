@@ -1,0 +1,163 @@
+"""Multi-host bootstrap: the Network::Init analog over jax.distributed.
+
+The reference brings up its own TCP mesh — parse a machine list, bind a
+listen port, link every pair of workers, then run Bruck/recursive-halving
+collectives over the sockets (reference: src/network/network.cpp:24-74
+Network::Init, linkers.cpp, socket_wrapper.hpp).  On TPU pods none of
+that socket stack exists to port: collectives are XLA programs riding
+ICI/DCN, and the only host-side job is PROCESS BOOTSTRAP — every host
+must call ``jax.distributed.initialize`` with the same coordinator so
+``jax.devices()`` becomes the global device list.  After that, the
+existing mesh growers (``parallel/mesh.py``) scale to multi-host
+unchanged: ``build_mesh`` sees every chip in the pod, ``shard_map`` +
+``psum`` compile to cross-host collectives, and the reference's
+ReduceScatter/AllGather calls have no host analog at all.
+
+Config mapping (reference: config.h "Network Parameters"):
+
+- ``machines`` ("ip1:port1,ip2:port2,...") or ``machine_list_filename``
+  (one host per line) — the FIRST entry is the coordinator, matching the
+  reference's rank-0 convention;
+- ``num_machines`` — process count; must equal the machine list length;
+- ``local_listen_port`` — used only to derive the coordinator port when
+  the machine list omits one.
+
+The reference's ``LGBM_NetworkInit``/``set_network`` route here via
+``mesh.NETWORK``.  ``init_distributed`` is idempotent and a no-op for
+``num_machines <= 1``.
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+from ..utils import log
+from . import mesh as _mesh
+
+_initialized = False
+
+
+def parse_machine_list(machines: str = "",
+                       machine_list_filename: str = "",
+                       default_port: int = 12400) -> List[str]:
+    """Normalize both machine-list forms to ["host:port", ...]
+    (reference: Network::Init's two sources, config.h machines /
+    machine_list_filename)."""
+    entries: List[str] = []
+    if machines:
+        entries = [tok.strip() for tok in machines.replace("\n", ",").split(",")
+                   if tok.strip()]
+    elif machine_list_filename:
+        if not os.path.exists(machine_list_filename):
+            log.fatal(f"Machine list file {machine_list_filename} "
+                      "does not exist")
+        with open(machine_list_filename) as fh:
+            entries = [ln.strip().replace(" ", ":") for ln in fh
+                       if ln.strip()]
+    return [e if ":" in e else f"{e}:{default_port}" for e in entries]
+
+
+def process_id(hosts=()) -> Optional[int]:
+    """This host's rank, or None when it must come from cluster
+    auto-detection.  Resolution order: explicit rank recorded via the
+    C API / set_network, rank env vars, then matching this host's
+    addresses against the machine list (the reference's approach:
+    Network::Init finds the local machine in the list,
+    network.cpp:50-60)."""
+    if _mesh.NETWORK.get("rank"):
+        return int(_mesh.NETWORK["rank"])
+    for var in ("JAX_PROCESS_ID", "LGBM_TPU_RANK"):
+        if os.environ.get(var):
+            return int(os.environ[var])
+    if hosts:
+        import socket
+        local = {socket.gethostname()}
+        try:
+            name, aliases, addrs = socket.gethostbyname_ex(
+                socket.gethostname())
+            local |= {name, *aliases, *addrs, "localhost", "127.0.0.1"}
+        except OSError:
+            pass
+        for i, h in enumerate(hosts):
+            if h.rsplit(":", 1)[0] in local:
+                return i
+    return None
+
+
+def init_distributed(config=None, *, machines: str = "",
+                     machine_list_filename: str = "",
+                     num_machines: int = 1,
+                     local_listen_port: int = 12400,
+                     rank: Optional[int] = None,
+                     time_out: Optional[int] = None) -> bool:
+    """Bootstrap the multi-host runtime; True when running distributed.
+
+    Call on EVERY host before constructing a Booster (the driver script
+    runs once per host, like the reference CLI under mpirun —
+    docs/Parallel-Learning-Guide analog).  Single-machine configs return
+    False without touching jax.distributed.
+    """
+    global _initialized
+    if config is not None:
+        machines = machines or getattr(config, "machines", "")
+        machine_list_filename = (machine_list_filename
+                                 or getattr(config, "machine_list_filename", ""))
+        num_machines = max(num_machines,
+                           int(getattr(config, "num_machines", 1)))
+        local_listen_port = int(getattr(config, "local_listen_port",
+                                        local_listen_port))
+        if time_out is None:
+            time_out = int(getattr(config, "time_out", 120))
+    hosts = parse_machine_list(machines, machine_list_filename,
+                               local_listen_port)
+    if num_machines <= 1 and len(hosts) <= 1:
+        return False
+    if hosts and num_machines > 1 and len(hosts) != num_machines:
+        log.fatal(f"num_machines={num_machines} but the machine list has "
+                  f"{len(hosts)} entries")
+    num_machines = max(num_machines, len(hosts))
+    if _initialized:
+        return True
+
+    import jax
+
+    pid = process_id(hosts) if rank is None else int(rank)
+    kwargs = {"num_processes": num_machines}
+    if pid is not None:
+        # unknown rank stays unset so jax's cluster auto-detection (TPU
+        # metadata, SLURM, ...) can resolve it
+        kwargs["process_id"] = pid
+    if hosts:
+        kwargs["coordinator_address"] = hosts[0]
+    if time_out:
+        # the reference's listen/connect time_out (minutes, config.h:845)
+        # becomes the coordinator handshake bound — a dead host fails the
+        # job instead of hanging it (its only failure-detection story, and
+        # ours: SURVEY.md §5)
+        kwargs["initialization_timeout"] = int(time_out) * 60
+    log.info("Initializing distributed runtime: %d processes, rank %s, "
+             "coordinator %s", num_machines,
+             "<auto>" if pid is None else pid,
+             kwargs.get("coordinator_address", "<from environment>"))
+    # jax.distributed resolves coordinator/rank from cluster env vars
+    # (TPU metadata, SLURM, ...) when not given explicitly
+    jax.distributed.initialize(**kwargs)
+    _initialized = True
+    _mesh.NETWORK.update(machines=",".join(hosts),
+                         num_machines=num_machines,
+                         rank=jax.process_index(),
+                         local_listen_port=local_listen_port)
+    log.info("Distributed runtime up: %d global devices across %d hosts",
+             len(jax.devices()), num_machines)
+    return True
+
+
+def shutdown() -> None:
+    """Network::Dispose analog (reference: network.cpp:76-84)."""
+    global _initialized
+    if _initialized:
+        import jax
+
+        jax.distributed.shutdown()
+        _initialized = False
+    _mesh.NETWORK.update(machines="", num_machines=1, rank=0)

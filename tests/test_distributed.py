@@ -1,0 +1,73 @@
+"""Multi-host bootstrap plumbing (reference: network.cpp Network::Init,
+config.h network parameters). Actual multi-process bring-up needs real
+hosts; these cover the config surface and single-host no-op guarantees."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.parallel import distributed, mesh
+
+
+def test_parse_machine_list_forms(tmp_path):
+    got = distributed.parse_machine_list("10.0.0.1:121,10.0.0.2:122")
+    assert got == ["10.0.0.1:121", "10.0.0.2:122"]
+    # missing ports get the default
+    got = distributed.parse_machine_list("hostA,hostB", default_port=9000)
+    assert got == ["hostA:9000", "hostB:9000"]
+    # file form, one "ip port" per line like the reference's mlist
+    p = tmp_path / "mlist.txt"
+    p.write_text("10.0.0.1 121\n10.0.0.2 122\n")
+    got = distributed.parse_machine_list(machine_list_filename=str(p))
+    assert got == ["10.0.0.1:121", "10.0.0.2:122"]
+
+
+def test_single_machine_is_noop():
+    assert distributed.init_distributed(num_machines=1) is False
+    cfg = lgb.Config.from_params({"verbose": -1})
+    assert distributed.init_distributed(cfg) is False
+
+
+def test_machine_count_mismatch_is_fatal():
+    with pytest.raises(lgb.LightGBMError, match="machine list"):
+        distributed.init_distributed(machines="a:1,b:2,c:3", num_machines=2)
+
+
+def test_missing_machine_list_file_is_fatal(tmp_path):
+    with pytest.raises(lgb.LightGBMError, match="does not exist"):
+        distributed.parse_machine_list(
+            machine_list_filename=str(tmp_path / "nope.txt"))
+
+
+def test_set_network_records_topology():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(200, 4))
+    y = (X[:, 0] > 0).astype(np.float64)
+    p = {"objective": "binary", "num_leaves": 7, "verbose": -1,
+         "min_data_in_leaf": 5}
+    bst = lgb.train(p, lgb.Dataset(X, label=y, params=p), 1)
+    try:
+        bst.set_network(["10.1.1.1:121", "10.1.1.2:121"], num_machines=2)
+        assert mesh.NETWORK["num_machines"] == 2
+        assert mesh.NETWORK["machines"] == "10.1.1.1:121,10.1.1.2:121"
+        bst.free_network()
+    finally:
+        mesh.NETWORK.update(machines="", num_machines=1, rank=0)
+
+
+def test_process_id_resolution(monkeypatch):
+    monkeypatch.setitem(mesh.NETWORK, "rank", 0)
+    monkeypatch.setenv("LGBM_TPU_RANK", "3")
+    assert distributed.process_id() == 3
+    monkeypatch.setitem(mesh.NETWORK, "rank", 1)
+    assert distributed.process_id() == 1
+
+
+def test_process_id_from_machine_list(monkeypatch):
+    monkeypatch.setitem(mesh.NETWORK, "rank", 0)
+    monkeypatch.delenv("JAX_PROCESS_ID", raising=False)
+    monkeypatch.delenv("LGBM_TPU_RANK", raising=False)
+    # local host appears second -> rank 1 (reference: Network::Init finds
+    # the local machine in the list)
+    assert distributed.process_id(["10.9.9.9:12400", "localhost:12400"]) == 1
+    # unknown everywhere -> None, deferring to jax cluster auto-detection
+    assert distributed.process_id(["10.9.9.8:1", "10.9.9.9:1"]) is None

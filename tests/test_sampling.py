@@ -1,0 +1,76 @@
+"""Balanced (pos/neg) bagging and by-node feature sampling
+(reference: gbdt.cpp:160-276 balanced bagging; col_sampler.hpp GetByNode)."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _data(n=900, seed=2):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 6))
+    y = (X[:, 0] + 0.5 * X[:, 1] + rng.logistic(size=n) * 0.3 > 0.8)
+    return X, y.astype(np.float64)
+
+
+BASE = {"objective": "binary", "num_leaves": 15, "verbose": -1,
+        "min_data_in_leaf": 5}
+
+
+def test_balanced_bagging_mask_respects_class_fractions():
+    X, y = _data()
+    p = dict(BASE, pos_bagging_fraction=0.2, neg_bagging_fraction=0.9,
+             bagging_freq=1)
+    ds = lgb.Dataset(X, label=y, params=p)
+    bst = lgb.train(p, ds, num_boost_round=3)
+    mask = bst._gbdt._bag_mask_host
+    pos, neg = y == 1, y == 0
+    assert mask[pos].sum() == int(0.2 * pos.sum())
+    assert mask[neg].sum() == int(0.9 * neg.sum())
+    from sklearn.metrics import roc_auc_score
+    assert roc_auc_score(y, bst.predict(X)) > 0.7
+
+
+def test_balanced_bagging_requires_binary_labels():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(300, 4))
+    y = rng.normal(size=300)  # regression labels
+    p = {"objective": "regression", "verbose": -1, "num_leaves": 7,
+         "min_data_in_leaf": 5, "pos_bagging_fraction": 0.5,
+         "bagging_freq": 1}
+    ds = lgb.Dataset(X, label=y, params=p)
+    with pytest.raises(lgb.LightGBMError, match="binary"):
+        lgb.train(p, ds, num_boost_round=2)
+
+
+def test_feature_fraction_bynode_varies_within_tree():
+    X, y = _data()
+    # one feature per node: a single tree must still mix features, which
+    # per-TREE sampling (feature_fraction) cannot do at this fraction
+    p = dict(BASE, feature_fraction_bynode=1.0 / 6, num_leaves=31)
+    ds = lgb.Dataset(X, label=y, params=p)
+    bst = lgb.train(p, ds, num_boost_round=2)
+    tree0 = bst.dump_model()["tree_info"][0]["tree_structure"]
+    feats = set()
+
+    def walk(node):
+        if "split_feature" in node:
+            feats.add(node["split_feature"])
+            walk(node["left_child"])
+            walk(node["right_child"])
+
+    walk(tree0)
+    assert len(feats) > 1
+    from sklearn.metrics import roc_auc_score
+    assert roc_auc_score(y, bst.predict(X)) > 0.6
+
+
+def test_feature_fraction_bynode_deterministic():
+    X, y = _data()
+    p = dict(BASE, feature_fraction_bynode=0.5)
+
+    def run():
+        ds = lgb.Dataset(X, label=y, params=p)
+        return lgb.train(p, ds, num_boost_round=3).predict(X)
+
+    np.testing.assert_array_equal(run(), run())
