@@ -35,6 +35,44 @@ def _feature_names_of(data) -> Optional[List[str]]:
     return None
 
 
+def _is_pandas_df(data) -> bool:
+    return hasattr(data, "columns") and hasattr(data, "dtypes")
+
+
+def _data_from_pandas(df, categorical_feature="auto",
+                      pandas_categorical=None):
+    """DataFrame -> (f64 matrix, names, categorical_feature,
+    pandas_categorical).  category-dtype columns become their integer
+    codes with NaN for missing; at predict/valid time the codes are
+    aligned to the TRAIN-time category lists so the same string maps to
+    the same code (reference: basic.py:313-354 _data_from_pandas)."""
+    import pandas as pd
+    cat_cols = [c for c in df.columns
+                if isinstance(df[c].dtype, pd.CategoricalDtype)]
+    unordered = [c for c in cat_cols if not df[c].cat.ordered]
+    if cat_cols:
+        df = df.copy()  # one copy covers both mutation passes below
+    if pandas_categorical is None:  # train dataset defines the mapping
+        pandas_categorical = [list(df[c].cat.categories) for c in cat_cols]
+    else:
+        if len(cat_cols) != len(pandas_categorical):
+            raise LightGBMError("train and valid dataset "
+                                "categorical_feature do not match.")
+        for c, cats in zip(cat_cols, pandas_categorical):
+            if list(df[c].cat.categories) != list(cats):
+                df[c] = df[c].cat.set_categories(cats)
+    if cat_cols:
+        for c in cat_cols:
+            codes = df[c].cat.codes.to_numpy().astype(np.float64)
+            codes[codes == -1] = np.nan  # unseen/missing -> NaN
+            df[c] = codes
+    names = [str(c) for c in df.columns]
+    if categorical_feature == "auto":
+        categorical_feature = [names.index(str(c)) for c in unordered]
+    mat = np.ascontiguousarray(df.to_numpy(dtype=np.float64))
+    return mat, names, categorical_feature, pandas_categorical
+
+
 class Dataset:
     """Training/validation dataset with lazy construction
     (reference: basic.py:712-1664)."""
@@ -79,8 +117,21 @@ class Dataset:
             return self
         if self.data is None:
             raise LightGBMError("Cannot construct Dataset: raw data was freed")
-        mat = _to_matrix(self.data)
-        names = _feature_names_of(self.data)
+        self.pandas_categorical = getattr(self, "pandas_categorical", None)
+        if _is_pandas_df(self.data):
+            ref_pc = (getattr(self.reference.construct(),
+                              "pandas_categorical", None)
+                      if self.reference is not None else None)
+            mat, names, auto_cat, self.pandas_categorical = \
+                _data_from_pandas(self.data, self.categorical_feature,
+                                  ref_pc)
+            if self.categorical_feature == "auto" and auto_cat:
+                # keep "auto" when no category-dtype columns exist so the
+                # params['categorical_feature'] fallback still applies
+                self.categorical_feature = auto_cat
+        else:
+            mat = _to_matrix(self.data)
+            names = _feature_names_of(self.data)
         if isinstance(self.feature_name, (list, tuple)):
             names = list(self.feature_name)
         if names is None:
@@ -242,9 +293,13 @@ class Booster:
         elif model_file is not None:
             from .io.model_io import load_model_file
             self._gbdt, self.config = load_model_file(model_file)
+            self.pandas_categorical = getattr(self._gbdt,
+                                              "pandas_categorical", None)
         elif model_str is not None:
             from .io.model_io import load_model_string
             self._gbdt, self.config = load_model_string(model_str)
+            self.pandas_categorical = getattr(self._gbdt,
+                                              "pandas_categorical", None)
         else:
             raise TypeError("Need at least one training dataset or model "
                             "file or model string to create Booster instance")
@@ -263,6 +318,8 @@ class Booster:
         metrics = create_metrics(self.config)
         self._gbdt = create_boosting(self.config)
         self._gbdt.init(self.config, train_set._handle, objective, metrics)
+        self.pandas_categorical = getattr(train_set, "pandas_categorical",
+                                          None)
 
     def add_valid(self, data: Dataset, name: str) -> "Booster":
         if not isinstance(data, Dataset):
@@ -388,7 +445,13 @@ class Booster:
                 raw_score: bool = False, pred_leaf: bool = False,
                 pred_contrib: bool = False, start_iteration: int = 0,
                 **kwargs) -> np.ndarray:
-        mat = _to_matrix(data)
+        if _is_pandas_df(data) and getattr(self, "pandas_categorical",
+                                           None) is not None:
+            mat, _, _, _ = _data_from_pandas(
+                data, categorical_feature=None,
+                pandas_categorical=self.pandas_categorical)
+        else:
+            mat = _to_matrix(data)
         if num_iteration is None:
             num_iteration = self.best_iteration if self.best_iteration > 0 else -1
         if pred_leaf:
@@ -412,7 +475,23 @@ class Booster:
         from .io.model_io import model_to_string
         if num_iteration is None:
             num_iteration = self.best_iteration if self.best_iteration > 0 else -1
-        return model_to_string(self._gbdt, num_iteration, start_iteration)
+        txt = model_to_string(self._gbdt, num_iteration, start_iteration)
+        pc = getattr(self, "pandas_categorical", None)
+        if pc is not None:
+            # appended like the reference python package so string/file
+            # round-trips keep the category->code mapping
+            # (reference: basic.py:367 _dump_pandas_categorical); omitted
+            # when absent to stay byte-identical with reference CLI files
+            import json as _json
+
+            def _np_default(o):  # numpy category values (int64/float64/...)
+                if hasattr(o, "item"):
+                    return o.item()
+                raise TypeError(f"{type(o).__name__} is not JSON "
+                                "serializable")
+            txt += ("\npandas_categorical:"
+                    + _json.dumps(pc, default=_np_default) + "\n")
+        return txt
 
     def dump_model(self, num_iteration: Optional[int] = None,
                    start_iteration: int = 0) -> Dict[str, Any]:
@@ -458,6 +537,112 @@ class Booster:
     def free_dataset(self) -> "Booster":
         self.train_set = None
         return self
+
+    # ------------------------------------------------------------------
+    # pickling / copying: serialize through the model text, like the
+    # reference Booster's __getstate__ (reference: basic.py:1875-1904 —
+    # the handle cannot cross processes; the model string can). The
+    # unpickled booster is prediction-ready; training state is not
+    # carried (same as the reference unless free_raw_data=False).
+    def __getstate__(self) -> Dict[str, Any]:
+        state = {"params": self.params,
+                 "best_iteration": self.best_iteration,
+                 "best_score": self.best_score,
+                 "model_str": self.model_to_string(num_iteration=-1)}
+        return state
+
+    def __setstate__(self, state: Dict[str, Any]) -> None:
+        from .io.model_io import load_model_string
+        self.params = state.get("params", {})
+        self.best_iteration = state.get("best_iteration", -1)
+        self.best_score = state.get("best_score", {})
+        self._train_data_name = "training"
+        self.train_set = None
+        self.valid_sets = []
+        self._gbdt, self.config = load_model_string(state["model_str"])
+        self.pandas_categorical = getattr(self._gbdt, "pandas_categorical",
+                                          None)
+
+    def __copy__(self) -> "Booster":
+        return self.__deepcopy__(None)
+
+    def __deepcopy__(self, memo) -> "Booster":
+        new = Booster(model_str=self.model_to_string(num_iteration=-1))
+        new.params = dict(self.params)
+        new.best_iteration = self.best_iteration
+        return new
+
+    def get_split_value_histogram(self, feature, bins=None,
+                                  xgboost_style: bool = False):
+        """Histogram of split threshold values used for ``feature`` across
+        the forest (reference: basic.py:2583 Booster.
+        get_split_value_histogram)."""
+        if isinstance(feature, str):
+            feature = self.feature_name().index(feature)
+        values = []
+        for tree in self._gbdt.models:
+            nn = max(tree.num_leaves - 1, 0)
+            for i in range(nn):
+                if (int(tree.split_feature[i]) == feature
+                        and not tree.is_categorical(i)):
+                    values.append(float(tree.threshold[i]))
+        values = np.asarray(values, np.float64)
+        if bins is None or (isinstance(bins, int) and bins > len(values)):
+            bins = max(len(values), 1)
+        hist, edges = np.histogram(values, bins=bins)
+        if not xgboost_style:
+            return hist, edges
+        import pandas as pd
+        mask = hist != 0
+        return pd.DataFrame({"SplitValue": edges[1:][mask],
+                             "Count": hist[mask]})
+
+    def trees_to_dataframe(self):
+        """One row per node/leaf of every tree (reference: basic.py:2757
+        Booster.trees_to_dataframe)."""
+        import pandas as pd
+        names = self.feature_name()
+        rows = []
+
+        def walk(tree, ti, node, depth, parent):
+            if node >= 0:  # internal
+                idx = f"{ti}-S{node}"
+                f = int(tree.split_feature[node])
+                rows.append(dict(
+                    tree_index=ti, node_depth=depth, node_index=idx,
+                    left_child=_child_name(tree, ti, tree.left_child[node]),
+                    right_child=_child_name(tree, ti, tree.right_child[node]),
+                    parent_index=parent,
+                    split_feature=names[f] if f < len(names) else str(f),
+                    split_gain=float(tree.split_gain[node]),
+                    threshold=float(tree.threshold[node]),
+                    decision_type="==" if tree.is_categorical(node)
+                    else "<=",
+                    missing_direction="left"
+                    if (tree.decision_type[node] & 2) else "right",
+                    value=float(tree.internal_value[node]),
+                    weight=float(tree.internal_weight[node]),
+                    count=int(tree.internal_count[node])))
+                walk(tree, ti, int(tree.left_child[node]), depth + 1, idx)
+                walk(tree, ti, int(tree.right_child[node]), depth + 1, idx)
+            else:
+                leaf = ~node
+                rows.append(dict(
+                    tree_index=ti, node_depth=depth,
+                    node_index=f"{ti}-L{leaf}", left_child=None,
+                    right_child=None, parent_index=parent,
+                    split_feature=None, split_gain=None, threshold=None,
+                    decision_type=None, missing_direction=None,
+                    value=float(tree.leaf_value[leaf]),
+                    weight=float(tree.leaf_weight[leaf]),
+                    count=int(tree.leaf_count[leaf])))
+
+        def _child_name(tree, ti, child):
+            return f"{ti}-S{child}" if child >= 0 else f"{ti}-L{~child}"
+
+        for ti, tree in enumerate(self._gbdt.models):
+            walk(tree, ti, 0 if tree.num_leaves > 1 else ~0, 1, None)
+        return pd.DataFrame(rows)
 
     def free_network(self) -> "Booster":
         from .parallel.distributed import shutdown

@@ -118,6 +118,7 @@ _MULTI_VALUE = {
     "valid", "metric", "monotone_constraints", "feature_contri", "label_gain",
     "eval_at", "auc_mu_weights", "cegb_penalty_feature_lazy", "cegb_penalty_feature_coupled",
     "ignore_column", "categorical_feature", "interaction_constraints",
+    "max_bin_by_feature",
 }
 
 _OBJECTIVE_ALIASES = {
@@ -203,6 +204,7 @@ class Config:
     top_k: int = 20
     monotone_constraints: List[int] = field(default_factory=list)
     feature_contri: List[float] = field(default_factory=list)
+    max_bin_by_feature: List[int] = field(default_factory=list)
     forcedsplits_filename: str = ""
     forcedbins_filename: str = ""
     refit_decay_rate: float = 0.9

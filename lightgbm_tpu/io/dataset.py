@@ -201,6 +201,11 @@ class BinnedDataset:
             sample_indices = (np.arange(n, dtype=np.int64) if sample_cnt >= n
                               else rng.sample(n, sample_cnt).astype(np.int64))
         sample = data[sample_indices]
+        # multi-host: pool every host's sample so all processes derive
+        # identical mappers; sample-vs-data ratios below must then use the
+        # GLOBAL row count (no-op single-host; parallel/distributed.py)
+        from ..parallel.distributed import global_bin_sample
+        sample, n_global = global_bin_sample(sample, n)
 
         from ..utils.timetag import timetag
         cat_set = set(int(c) for c in categorical_features)
@@ -208,7 +213,14 @@ class BinnedDataset:
         forced = _load_forced_bins(config.forcedbins_filename, p, config.max_bin)
         # min-data filter threshold scaled to the bin-finding sample
         # (reference: dataset_loader.cpp:599 filter_cnt)
-        filter_cnt = int(config.min_data_in_leaf * len(sample) / n)
+        filter_cnt = int(config.min_data_in_leaf * len(sample) / n_global)
+        mbf = [int(v) for v in (config.max_bin_by_feature or [])]
+        if mbf:
+            # reference: dataset_loader.cpp:438-441
+            log.check(len(mbf) == p, "max_bin_by_feature should be the "
+                      "same size as feature number")
+            log.check(min(mbf) > 1,
+                      "max_bin_by_feature values should be greater than 1")
         bin_finding = timetag("bin finding")
         bin_finding.__enter__()
         for j in range(p):
@@ -218,7 +230,8 @@ class BinnedDataset:
             non_zero = col[~((col > -1e-35) & (col <= 1e-35))]
             mapper = BinMapper()
             bt = BIN_CATEGORICAL if j in cat_set else BIN_NUMERICAL
-            mapper.find_bin(non_zero, len(sample), config.max_bin,
+            mapper.find_bin(non_zero, len(sample),
+                            mbf[j] if mbf else config.max_bin,
                             config.min_data_in_bin, filter_cnt,
                             bt, config.use_missing, config.zero_as_missing,
                             forced.get(j))
@@ -230,7 +243,8 @@ class BinnedDataset:
                 and getattr(config, "tree_learner", "serial") == "serial"):
             from .bundling import build_bundles
             bundle = build_bundles(ds.bin_mappers, ds.real_feature_idx,
-                                   sample, n, config.max_conflict_rate)
+                                   sample, n_global,
+                                   config.max_conflict_rate)
             if not bundle.is_trivial:
                 ds.bundle = bundle
         with timetag("binarize"):

@@ -216,6 +216,21 @@ def load_model_string(model_str: str):
     from ..config import Config
     from ..objective import create_objective
 
+    # trailing pandas category mapping appended by Booster.model_to_string
+    # (reference: basic.py:377 _load_pandas_categorical)
+    pandas_categorical = None
+    key = "\npandas_categorical:"
+    kpos = model_str.rfind(key)
+    if kpos >= 0:
+        import json as _json
+        rest = model_str[kpos + len(key):].splitlines()
+        try:
+            pandas_categorical = _json.loads(rest[0].strip()) if rest \
+                else None
+        except ValueError:
+            pandas_categorical = None
+        model_str = model_str[:kpos]
+
     header: Dict[str, str] = {}
     pos = model_str.find("\nTree=")
     head_part = model_str[:pos] if pos >= 0 else model_str
@@ -261,6 +276,7 @@ def load_model_string(model_str: str):
     feature_infos = header.get("feature_infos", "").split()
     gbdt = LoadedGBDT(models, num_tpi, objective, feature_names,
                       feature_infos, average_output)
+    gbdt.pandas_categorical = pandas_categorical
     config = Config.from_params({"objective": obj_str.split()[0]}
                                 if obj_str and obj_str != "custom" else {})
     return gbdt, config

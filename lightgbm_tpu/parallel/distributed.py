@@ -161,3 +161,45 @@ def shutdown() -> None:
         jax.distributed.shutdown()
         _initialized = False
     _mesh.NETWORK.update(machines="", num_machines=1, rank=0)
+
+
+def global_bin_sample(sample, num_local_rows=None):
+    """Distributed bin finding: make every host derive IDENTICAL bin
+    mappers by gathering all hosts' bin-finding row samples before
+    GreedyFindBin runs (the reference syncs per-feature bin bounds found
+    from per-host samples over Network::Allgather,
+    dataset_loader.cpp:807-1042; gathering the samples themselves is the
+    collective-cheap TPU equivalent — the sample is small and the result
+    is exactly the single-host mapper on the pooled sample).
+
+    Returns ``(pooled_sample, global_num_rows)`` so callers can scale
+    sample-vs-dataset ratios (bin filter counts) by the GLOBAL row count.
+    No-op (identity sample, local rows) outside an initialized multi-host
+    runtime.  Handles unequal per-host sample sizes by padding to the max
+    and slicing per true count after the gather.
+    """
+    import numpy as np
+
+    if num_local_rows is None:
+        num_local_rows = len(sample)
+    if not _initialized:
+        return sample, int(num_local_rows)
+    import jax
+
+    if jax.process_count() <= 1:
+        return sample, int(num_local_rows)
+    import jax.numpy as jnp
+    from jax.experimental import multihost_utils
+
+    n, f = sample.shape
+    counts = np.asarray(multihost_utils.process_allgather(
+        jnp.asarray([n, int(num_local_rows)], jnp.int64))).reshape(-1, 2)
+    m = int(counts[:, 0].max())
+    padded = np.full((m, f), np.nan, dtype=sample.dtype)
+    padded[:n] = sample
+    gathered = np.asarray(multihost_utils.process_allgather(
+        jnp.asarray(padded)))
+    gathered = gathered.reshape(len(counts), m, f)
+    pooled = np.concatenate([gathered[p, :counts[p, 0]]
+                             for p in range(len(counts))])
+    return pooled.astype(sample.dtype), int(counts[:, 1].sum())

@@ -1,0 +1,146 @@
+"""Public-API extras mirrored from the reference python package tests
+(reference: tests/python_package_test/test_engine.py: save_load_copy_pickle,
+get_split_value_histogram, trees_to_dataframe, max_bin_by_feature,
+pandas_categorical)."""
+import copy
+import pickle
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import lightgbm_tpu as lgb
+
+PARAMS = {"objective": "binary", "num_leaves": 15, "verbose": -1,
+          "min_data_in_leaf": 5}
+
+
+def _train(n=600, seed=4, extra=None, rounds=5):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 6))
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float64)
+    p = dict(PARAMS, **(extra or {}))
+    bst = lgb.train(p, lgb.Dataset(X, label=y, params=p), rounds)
+    return bst, X, y
+
+
+def test_pickle_and_copy_roundtrip():
+    bst, X, y = _train()
+    want = bst.predict(X)
+    re = pickle.loads(pickle.dumps(bst))
+    np.testing.assert_allclose(re.predict(X), want, rtol=1e-6)
+    assert re.current_iteration() == bst.current_iteration()
+    dup = copy.deepcopy(bst)
+    np.testing.assert_allclose(dup.predict(X), want, rtol=1e-6)
+    shallow = copy.copy(bst)
+    np.testing.assert_allclose(shallow.predict(X), want, rtol=1e-6)
+
+
+def test_get_split_value_histogram():
+    bst, X, y = _train(rounds=8)
+    hist, edges = bst.get_split_value_histogram(0)
+    assert hist.sum() == int(bst.feature_importance("split")[0])
+    assert len(edges) == len(hist) + 1
+    df = bst.get_split_value_histogram("Column_0", xgboost_style=True)
+    assert list(df.columns) == ["SplitValue", "Count"]
+    assert df["Count"].sum() == hist.sum()
+
+
+def test_trees_to_dataframe():
+    bst, X, y = _train(rounds=3)
+    df = bst.trees_to_dataframe()
+    # one leaf more than splits per tree
+    for ti in range(3):
+        sub = df[df.tree_index == ti]
+        leaves = sub[sub.split_feature.isna()]
+        splits = sub[~sub.split_feature.isna()]
+        assert len(leaves) == len(splits) + 1
+        # counts are conserved: root count equals each leaf-count sum
+        root = sub[sub.node_depth == 1].iloc[0]
+        assert leaves["count"].sum() == root["count"]
+    assert df.node_index.is_unique
+
+
+def test_max_bin_by_feature():
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(500, 3))
+    y = (X[:, 0] > 0).astype(np.float64)
+    p = dict(PARAMS, max_bin_by_feature=[4, 64, 255])
+    ds = lgb.Dataset(X, label=y, params=p)
+    ds.construct()
+    nb = [m.num_bin for m in ds._handle.bin_mappers]
+    assert nb[0] <= 5 and nb[1] <= 65  # +1 potential NaN bin
+    assert nb[1] > nb[0]
+    p_bad = dict(PARAMS, max_bin_by_feature=[4, 64])
+    with pytest.raises(lgb.LightGBMError, match="same size"):
+        lgb.Dataset(X, label=y, params=p_bad).construct()
+
+
+def test_pandas_categorical_roundtrip():
+    rng = np.random.default_rng(6)
+    n = 800
+    colors = rng.choice(["red", "green", "blue", "teal"], n)
+    x1 = rng.normal(size=n)
+    y = ((colors == "red") | (colors == "teal") * (x1 > 0)).astype(float)
+    df = pd.DataFrame({"c": pd.Categorical(colors), "x": x1})
+    p = dict(PARAMS, min_data_in_leaf=5)
+    bst = lgb.train(p, lgb.Dataset(df, label=y, params=p), 10)
+    pred = bst.predict(df)
+    from sklearn.metrics import roc_auc_score
+    assert roc_auc_score(y, pred) > 0.9
+    # category order differs at predict time: the TRAIN mapping must win
+    df2 = df.copy()
+    df2["c"] = df2["c"].cat.set_categories(["teal", "blue", "green", "red"])
+    np.testing.assert_allclose(bst.predict(df2), pred, rtol=1e-9)
+    # unseen category routes like missing, not like category 0
+    df3 = df.copy().astype({"c": str})
+    df3.loc[:, "c"] = "violet"
+    df3["c"] = pd.Categorical(df3["c"])
+    p3 = bst.predict(df3)
+    assert np.isfinite(p3).all()
+    # mapping survives the model text round-trip
+    re = lgb.Booster(model_str=bst.model_to_string())
+    assert re.pandas_categorical == bst.pandas_categorical
+    np.testing.assert_allclose(re.predict(df2), pred, rtol=1e-6)
+
+
+def test_pandas_plain_dataframe_unchanged():
+    rng = np.random.default_rng(8)
+    X = rng.normal(size=(400, 4))
+    y = (X[:, 0] > 0).astype(np.float64)
+    df = pd.DataFrame(X, columns=[f"f{i}" for i in range(4)])
+    bst = lgb.train(PARAMS, lgb.Dataset(df, label=y, params=PARAMS), 3)
+    np.testing.assert_allclose(bst.predict(df), bst.predict(X), rtol=1e-9)
+    assert bst.feature_name() == ["f0", "f1", "f2", "f3"]
+
+
+def test_pandas_int_categories_json_roundtrip():
+    """Numpy-int category values must survive the model-text JSON line
+    (regression: json.dumps on np.int64)."""
+    rng = np.random.default_rng(12)
+    n = 400
+    codes = rng.integers(10, 14, n)
+    df = pd.DataFrame({"c": pd.Categorical(codes), "x": rng.normal(size=n)})
+    y = (codes % 2).astype(float)
+    bst = lgb.train(PARAMS, lgb.Dataset(df, label=y, params=PARAMS), 3)
+    txt = bst.model_to_string()          # would raise before the fix
+    re = lgb.Booster(model_str=txt)
+    np.testing.assert_allclose(re.predict(df), bst.predict(df), rtol=1e-6)
+    # pickling also goes through the JSON path
+    re2 = pickle.loads(pickle.dumps(bst))
+    assert re2.pandas_categorical == bst.pandas_categorical
+    np.testing.assert_allclose(re2.predict(df), bst.predict(df), rtol=1e-6)
+
+
+def test_params_categorical_fallback_with_plain_dataframe():
+    """categorical_feature passed via params must survive the pandas path
+    when the frame has no category-dtype columns."""
+    rng = np.random.default_rng(13)
+    X = rng.integers(0, 5, size=(500, 3)).astype(float)
+    y = (X[:, 2] % 2).astype(float)
+    p = dict(PARAMS, categorical_feature=[2], min_data_in_leaf=5)
+    df = pd.DataFrame(X, columns=["a", "b", "c"])
+    ds = lgb.Dataset(df, label=y, params=p)
+    ds.construct()
+    from lightgbm_tpu.io.binning import BIN_CATEGORICAL
+    assert ds._handle.bin_mappers[2].bin_type == BIN_CATEGORICAL

@@ -71,3 +71,10 @@ def test_process_id_from_machine_list(monkeypatch):
     assert distributed.process_id(["10.9.9.9:12400", "localhost:12400"]) == 1
     # unknown everywhere -> None, deferring to jax cluster auto-detection
     assert distributed.process_id(["10.9.9.8:1", "10.9.9.9:1"]) is None
+
+
+def test_global_bin_sample_single_host_identity():
+    s = np.random.default_rng(0).normal(size=(50, 3))
+    out, n_global = distributed.global_bin_sample(s, 200)
+    assert out is s  # no-op outside an initialized multi-host runtime
+    assert n_global == 200
