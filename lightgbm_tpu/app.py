@@ -94,6 +94,11 @@ def run_predict(cfg: Config, params: Dict) -> None:
     if not cfg.input_model:
         log.fatal("task=predict needs input_model")
     bst = Booster(model_file=cfg.input_model)
+    # prediction-time knobs (pred_early_stop*) come from the CLI config,
+    # not the minimal config parsed from the model
+    bst.reset_parameter({"pred_early_stop": cfg.pred_early_stop,
+                         "pred_early_stop_freq": cfg.pred_early_stop_freq,
+                         "pred_early_stop_margin": cfg.pred_early_stop_margin})
     X, _, _, _, _ = load_text(cfg.data, cfg)
     num_it = cfg.num_iteration_predict if cfg.num_iteration_predict > 0 else None
     pred = bst.predict(X, num_iteration=num_it,

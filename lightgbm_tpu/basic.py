@@ -529,9 +529,13 @@ class Booster:
     def reset_parameter(self, params: Dict[str, Any]) -> "Booster":
         self.params.update(params)
         self.config.update(params)
-        if self._gbdt is not None and self._gbdt.config is not None:
+        if self._gbdt is not None:
+            # file-loaded boosters start with config=None; adopting the
+            # updated Booster config is what lets prediction-time knobs
+            # (pred_early_stop*) reach them
             self._gbdt.config = self.config
-            self._gbdt.shrinkage_rate = float(self.config.learning_rate)
+            if self._gbdt.train_ds is not None:
+                self._gbdt.shrinkage_rate = float(self.config.learning_rate)
         return self
 
     def free_dataset(self) -> "Booster":

@@ -209,3 +209,27 @@ def test_bundled_dataset_voting_parallel_rejected():
          "tree_learner": "voting", "min_data_in_leaf": 5}
     with pytest.raises(Exception, match="bundle"):
         lgb.train(p, ds, num_boost_round=2)
+
+
+def test_reference_cli_efb_auc_parity():
+    """Reference-CLI oracle on bundled sparse data: the reference binary
+    (enable_bundle=true, 15 trees, num_leaves=15, lr=0.1,
+    min_data_in_leaf=20) reaches valid AUC 0.91748 on
+    tests/fixtures/sparse.{train,test}; our EFB path must land within
+    0.01 while actually bundling."""
+    import os
+    fix = os.path.join(os.path.dirname(__file__), "fixtures")
+    tr = np.loadtxt(os.path.join(fix, "sparse.train"))
+    te = np.loadtxt(os.path.join(fix, "sparse.test"))
+    p = {"objective": "binary", "metric": "auc", "num_leaves": 15,
+         "learning_rate": 0.1, "min_data_in_leaf": 20,
+         "enable_bundle": True, "verbose": -1}
+    ds = lgb.Dataset(tr[:, 1:], label=tr[:, 0], params=p)
+    dv = lgb.Dataset(te[:, 1:], label=te[:, 0], reference=ds)
+    res = {}
+    bst = lgb.train(p, ds, 15, valid_sets=[dv], valid_names=["valid"],
+                    callbacks=[lgb.record_evaluation(res)])
+    assert ds._handle.bundle is not None  # EFB actually engaged
+    assert ds._handle.X_bin.shape[1] < 33
+    got = res["valid"]["auc"][-1]
+    assert abs(got - 0.91748) < 0.01, got

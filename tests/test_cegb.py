@@ -87,3 +87,33 @@ def test_bad_penalty_length_raises():
     ds = lgb.Dataset(X, label=y, params=p)
     with pytest.raises(Exception):
         lgb.train(p, ds, num_boost_round=2)
+
+
+def test_reference_cli_cegb_parity():
+    """Reference-CLI oracle (tests/fixtures/ref_cegb_model.txt:
+    binary example, num_trees=5, num_leaves=31, min_data_in_leaf=20,
+    lr=0.1, cegb_penalty_split=0.02): the per-tree leaf counts under the
+    split penalty must match the reference exactly, and the split
+    structure of the first tree must agree."""
+    import os
+    fix = os.path.join(os.path.dirname(__file__), "fixtures")
+    ref_txt = open(os.path.join(fix, "ref_cegb_model.txt")).read()
+
+    raw = np.loadtxt(
+        "/root/reference/examples/binary_classification/binary.train")
+    y, X = raw[:, 0], raw[:, 1:]
+    p = {"objective": "binary", "num_leaves": 31, "learning_rate": 0.1,
+         "min_data_in_leaf": 20, "verbose": -1,
+         "cegb_penalty_split": 0.02, "cegb_tradeoff": 1.0}
+    bst = lgb.train(p, lgb.Dataset(X, label=y, params=p), 5)
+    ours = bst.model_to_string()
+
+    def grab(txt, key):
+        return [ln.split("=", 1)[1] for ln in txt.splitlines()
+                if ln.startswith(key + "=")]
+
+    ref_nl = grab(ref_txt, "num_leaves")  # one line per tree, no header
+    our_nl = grab(ours, "num_leaves")
+    assert our_nl == ref_nl, (our_nl, ref_nl)
+    assert grab(ours, "split_feature")[0] == grab(ref_txt,
+                                                  "split_feature")[0]

@@ -79,3 +79,38 @@ def test_missing_file_is_fatal(tmp_path):
     ds = lgb.Dataset(X, label=y, params=p)
     with pytest.raises(lgb.LightGBMError):
         lgb.train(p, ds, num_boost_round=2)
+
+
+def test_reference_cli_forced_splits_parity():
+    """Reference-CLI oracle: the captured model in tests/fixtures was
+    trained by the reference binary with tests/fixtures/forced_splits.json
+    on examples/binary_classification (num_trees=5, num_leaves=15,
+    min_data_in_leaf=20, lr=0.1). Our run under the identical config must
+    force the same BFS prefix — features AND (bin-boundary) thresholds —
+    on every tree."""
+    import os
+    fix = os.path.join(os.path.dirname(__file__), "fixtures")
+    ref_txt = open(os.path.join(fix, "ref_forced_splits_model.txt")).read()
+
+    raw = np.loadtxt(
+        "/root/reference/examples/binary_classification/binary.train")
+    y, X = raw[:, 0], raw[:, 1:]
+    p = {"objective": "binary", "num_leaves": 15, "learning_rate": 0.1,
+         "min_data_in_leaf": 20, "verbose": -1,
+         "forcedsplits_filename": os.path.join(fix, "forced_splits.json")}
+    bst = lgb.train(p, lgb.Dataset(X, label=y, params=p), 5)
+    ours = bst.model_to_string()
+
+    def split_rows(txt, key):
+        return [ln.split("=", 1)[1].split() for ln in txt.splitlines()
+                if ln.startswith(key + "=")]
+
+    ref_feats = split_rows(ref_txt, "split_feature")
+    our_feats = split_rows(ours, "split_feature")
+    ref_thr = split_rows(ref_txt, "threshold")
+    our_thr = split_rows(ours, "threshold")
+    assert len(our_feats) == len(ref_feats) == 5
+    for rf, of, rt, ot in zip(ref_feats, our_feats, ref_thr, our_thr):
+        assert of[:3] == rf[:3] == ["25", "10", "4"]
+        np.testing.assert_allclose([float(v) for v in ot[:3]],
+                                   [float(v) for v in rt[:3]], rtol=1e-9)

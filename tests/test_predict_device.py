@@ -101,3 +101,33 @@ def test_booster_predict_uses_device_on_large_work(monkeypatch):
     monkeypatch.setattr(type(g), "_DEVICE_PREDICT_MIN_WORK", 10**18)
     p_host = bst.predict(X)
     np.testing.assert_allclose(p_dev, p_host, rtol=0, atol=1e-5)
+
+
+def test_reference_cli_pred_early_stop_parity(tmp_path):
+    """Reference-CLI oracle: predictions with pred_early_stop=true,
+    freq=5, margin=1.5 over the reference-trained 20-tree model
+    (fixtures ref_plain20_model.txt / ref_pred_early_stop.txt) must match
+    our CLI predict on the same model byte-for-byte in value."""
+    import os
+    import subprocess
+    import sys
+    fix = os.path.join(os.path.dirname(__file__), "fixtures")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = str(tmp_path / "pred.txt")
+    conf = tmp_path / "p.conf"
+    conf.write_text(
+        "task = predict\n"
+        "data = /root/reference/examples/binary_classification/binary.test\n"
+        f"input_model = {fix}/ref_plain20_model.txt\n"
+        f"output_result = {out}\n"
+        "pred_early_stop = true\npred_early_stop_freq = 5\n"
+        "pred_early_stop_margin = 1.5\nverbosity = -1\n")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run([sys.executable, "-m", "lightgbm_tpu",
+                        f"config={conf}"], env=env, capture_output=True,
+                       text=True, timeout=420)
+    assert r.returncode == 0, r.stderr[-1500:]
+    ours = np.loadtxt(out)
+    ref = np.loadtxt(os.path.join(fix, "ref_pred_early_stop.txt"))
+    np.testing.assert_allclose(ours, ref, rtol=1e-6, atol=1e-9)
