@@ -219,7 +219,7 @@ def build_wave_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
         return jax.lax.cond(ok, do, lambda s: s, st)
 
     # ---------------- wave phase ---------------------------------------
-    def _wave(st: _WaveState, bins_fm, gv, hv, cv, feature_mask):
+    def _wave(st: _WaveState, bins_fm, bins_rm, gv, hv, cv, feature_mask):
         def do(st: _WaveState) -> _WaveState:
             c_idx = jnp.arange(C_MAX) // 3
             slot_leaf = jnp.where(c_idx < P, st.pend_small[jnp.minimum(c_idx, P - 1)],
@@ -282,7 +282,11 @@ def build_wave_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
                             jnp.where(active, pos - 1, N)
                         ].set(arange_n, mode="drop")
                         idx_t = idx[:T]
-                        bins_c = jnp.take(bins_fm, idx_t, axis=1)
+                        # gather from the ROW-major copy: one contiguous
+                        # F-byte read per index instead of F strided
+                        # single-byte touches on the [F, N] layout, then
+                        # one fast tiled transpose back to feature-major
+                        bins_c = jnp.take(bins_rm, idx_t, axis=0).T
                         vc = vecs3[idx_t]                # ONE packed gather
                         # tail slots repeat row 0: leaf -2 misses every
                         # channel slot, so their values never contribute
@@ -428,6 +432,12 @@ def build_wave_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
             can_split = (jnp.max(ready) > 0.0) & (st.tree.num_leaves < L)
             return (st.pend_cnt > 0) | can_split
 
+        # row-major twin of the resident feature-major bins: materialized
+        # once per tree (a ~50us transpose at 1M rows), it turns every
+        # compaction gather from F strided byte-touches per row into one
+        # contiguous F-byte read (see _wave)
+        bins_rm = jnp.transpose(bins_fm) if compact else bins_fm
+
         def loop_body(st):
             ready = jnp.where(st.hist_ready[:L], st.best_gain[:L], NEG_INF)
             phase_max = jnp.max(ready)
@@ -435,7 +445,7 @@ def build_wave_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
             def split_body(_, st):
                 return _split_once(st, bins_fm, feature_mask, phase_max)
             st = jax.lax.fori_loop(0, P, split_body, st)
-            return _wave(st, bins_fm, gv, hv, cv, feature_mask)
+            return _wave(st, bins_fm, bins_rm, gv, hv, cv, feature_mask)
 
         st = jax.lax.while_loop(loop_cond, loop_body, st)
 

@@ -151,23 +151,13 @@ def plot_split_value_histogram(booster, feature, bins=None, ax=None,
     import matplotlib.pyplot as plt
 
     bst = _to_booster(booster)
-    names = bst.feature_name()
-    if isinstance(feature, str):
-        if feature not in names:
-            raise ValueError(f"feature {feature!r} not found")
-        fidx = names.index(feature)
-    else:
-        fidx = int(feature)
-    values = []
-    for tree in bst._gbdt.models:
-        nn = max(tree.num_leaves - 1, 0)
-        for i in range(nn):
-            if int(tree.split_feature[i]) == fidx and not tree.is_categorical(i):
-                values.append(float(tree.threshold[i]))
-    if not values:
+    if isinstance(feature, str) and feature not in bst.feature_name():
+        raise ValueError(f"feature {feature!r} not found")
+    hist, bin_edges = bst.get_split_value_histogram(
+        feature, bins="auto" if bins is None else bins)
+    if hist.sum() == 0:
         raise ValueError("Cannot plot split value histogram, the feature "
                          "was never used for splitting.")
-    hist, bin_edges = np.histogram(values, bins=bins or "auto")
     if ax is None:
         if figsize is not None:
             _check_not_tuple_of_2_elements(figsize, "figsize")

@@ -1,0 +1,66 @@
+"""Plotting smoke tests (reference: tests/python_package_test/
+test_plotting.py — Axes contents, not pixels)."""
+import matplotlib
+
+matplotlib.use("Agg")  # headless
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+PARAMS = {"objective": "binary", "num_leaves": 15, "verbose": -1,
+          "min_data_in_leaf": 5, "metric": ["auc", "binary_logloss"]}
+
+
+@pytest.fixture(scope="module")
+def trained():
+    rng = np.random.default_rng(5)
+    X = rng.normal(size=(500, 6))
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float64)
+    ds = lgb.Dataset(X, label=y, params=PARAMS)
+    dv = lgb.Dataset(X[:200], label=y[:200], reference=ds)
+    res = {}
+    bst = lgb.train(PARAMS, ds, 8, valid_sets=[dv], valid_names=["v"],
+                    callbacks=[lgb.record_evaluation(res)])
+    return bst, res
+
+
+def test_plot_importance(trained):
+    bst, _ = trained
+    ax = lgb.plot_importance(bst)
+    assert ax.get_xlabel() == "Feature importance"
+    assert len(ax.patches) > 0  # one bar per nonzero-importance feature
+    ax2 = lgb.plot_importance(bst, importance_type="gain", max_num_features=3)
+    assert len(ax2.patches) <= 3
+
+
+def test_plot_metric(trained):
+    _, res = trained
+    ax = lgb.plot_metric(res, metric="auc")
+    assert len(ax.get_lines()) == 1
+    assert len(ax.get_lines()[0].get_ydata()) == 8
+
+
+def test_plot_split_value_histogram(trained):
+    bst, _ = trained
+    ax = lgb.plot_split_value_histogram(bst, feature=0)
+    assert len(ax.patches) > 0
+
+
+def test_plot_tree_and_digraph(trained):
+    bst, _ = trained
+    try:
+        g = lgb.create_tree_digraph(bst, tree_index=0)
+    except ImportError:
+        pytest.skip("graphviz not installed")
+    src = g.source if hasattr(g, "source") else str(g)
+    assert "leaf" in src.lower()
+
+
+def test_plot_importance_on_loaded_model(trained, tmp_path):
+    bst, _ = trained
+    path = str(tmp_path / "m.txt")
+    bst.save_model(path)
+    ax = lgb.plot_importance(lgb.Booster(model_file=path))
+    assert len(ax.patches) > 0

@@ -1,0 +1,87 @@
+"""Generate docs/PARAMETERS.md from the Config dataclass + alias table.
+
+The reference generates Parameters.rst from config.h with
+helpers/parameter_generator.py — one annotated source of truth.  This is
+the same property for the TPU build: ``lightgbm_tpu/config.py`` defines
+every field, default, and alias; this script renders them, grouped by the
+dataclass's section comments, with inline ``#`` comments as descriptions.
+
+Run: python tools/gen_param_docs.py   (rewrites docs/PARAMETERS.md)
+"""
+from __future__ import annotations
+
+import inspect
+import os
+import re
+import sys
+from collections import defaultdict
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from lightgbm_tpu import config as cfgmod
+from lightgbm_tpu.config import _ALIASES, _MULTI_VALUE, Config
+
+
+def parse_sections():
+    """(section, name, default_repr, comment) in declaration order."""
+    src = inspect.getsource(Config)
+    section = "Other"
+    rows = []
+    for line in src.splitlines():
+        s = line.strip()
+        m = re.match(r"# ---- (.+?) ----", s)
+        if m:
+            section = m.group(1)
+            continue
+        m = re.match(r"(\w+):\s*[\w\[\]\.]+\s*=\s*(.+?)(?:\s*#\s*(.*))?$", s)
+        if m and not s.startswith("#"):
+            name, default, comment = m.groups()
+            default = default.strip()
+            if default.startswith("field(default_factory=list)"):
+                default = "[]"
+            elif "default_factory=lambda" in default:
+                inner = re.search(r"lambda:\s*(.+?)\)\s*$", default)
+                default = inner.group(1) if inner else default
+            rows.append((section, name, default, comment or ""))
+    return rows
+
+
+def main() -> None:
+    rows = parse_sections()
+    aliases = defaultdict(list)
+    for a, canon in _ALIASES.items():
+        aliases[canon].append(a)
+
+    out = ["# Parameters", "",
+           "Generated from `lightgbm_tpu/config.py` by "
+           "`tools/gen_param_docs.py` — the single source of truth for "
+           "names, defaults, and aliases (the analog of the reference's "
+           "`Parameters.rst` generated from `config.h`). Parameter names "
+           "and aliases match LightGBM v2.3.2; see `README.md` for the "
+           "TPU-specific additions (`tpu_*`).", ""]
+    cur = None
+    for section, name, default, comment in rows:
+        if section != cur:
+            out += [f"## {section}", ""]
+            cur = section
+        bits = [f"- **`{name}`** = `{default}`"]
+        if name in _MULTI_VALUE:
+            bits.append("(comma-separated list)")
+        if comment:
+            bits.append(f"— {comment}")
+        out.append(" ".join(bits))
+        al = sorted(aliases.get(name, []))
+        if al:
+            out.append(f"  - aliases: " + ", ".join(f"`{a}`" for a in al))
+    out.append("")
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "docs", "PARAMETERS.md")
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as fh:
+        fh.write("\n".join(out))
+    print(f"wrote {path}: {sum(1 for r in rows)} parameters, "
+          f"{sum(len(v) for v in aliases.values())} aliases")
+
+
+if __name__ == "__main__":
+    main()
