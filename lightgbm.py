@@ -1,0 +1,16 @@
+"""Drop-in import shim: ``import lightgbm as lgb`` resolves to the
+TPU-native framework, so reference scripts and the reference's
+``examples/python-guide`` run without edits.
+
+Everything is re-exported from :mod:`lightgbm_tpu`; see that package for
+the actual implementation.  If the real LightGBM wheel is ever installed
+in the same environment it will shadow or be shadowed by this module
+depending on ``sys.path`` order — this repo's image does not ship it.
+"""
+from lightgbm_tpu import *  # noqa: F401,F403
+from lightgbm_tpu import __version__, basic, callback, engine, plotting, sklearn  # noqa: F401
+
+try:  # mirror the reference's submodule layout for qualified imports
+    from lightgbm_tpu import capi as c_api  # noqa: F401
+except ImportError:  # pragma: no cover
+    pass

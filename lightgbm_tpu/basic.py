@@ -425,7 +425,13 @@ class Booster:
             out.append((ds_name, mname, value, hib))
         if feval is not None:
             def run_feval(score, dataset, tag):
-                res = feval(score, dataset)
+                # custom metrics receive TRANSFORMED predictions, like the
+                # reference (feval(self.__inner_predict(i), data) where
+                # GetPredict applies the objective's ConvertOutput)
+                obj = self._gbdt.objective
+                preds = np.asarray(obj.convert_output(score)) \
+                    if obj is not None else score
+                res = feval(preds, dataset)
                 if res is None:
                     return
                 entries = res if isinstance(res, list) else [res]

@@ -26,6 +26,24 @@ from ..utils import log
 
 K_EPSILON = 1e-15
 
+# Process-wide cache of jitted closures. Every Booster used to build
+# fresh closures, so XLA re-traced and re-compiled the whole grower per
+# fit — ~40-60s each, which made cv()/GridSearchCV (one Booster per fold
+# per candidate) compile-bound. Keyed on the content-cached DeviceMeta's
+# identity (core/meta.py _META_CACHE) plus every static knob, identical
+# configurations now share one compiled grower.
+_JIT_CACHE: Dict = {}
+
+
+def _cached_jit(key, builder):
+    fn = _JIT_CACHE.get(key)
+    if fn is None:
+        if len(_JIT_CACHE) >= 64:
+            _JIT_CACHE.clear()
+        fn = builder()
+        _JIT_CACHE[key] = fn
+    return fn
+
 
 class _DeferredTree:
     """A trained tree still living on device as ``TreeArrays``.
@@ -255,6 +273,8 @@ class GBDT(PredictorBase):
         import jax
         import jax.numpy as jnp
 
+        self._raw_cached = False  # set True when _grow_raw is _JIT_CACHE'd
+
         # ---- CEGB (reference: cost_effective_gradient_boosting.hpp) -----
         self._cegb_on = False
         self._cegb_state = []
@@ -375,25 +395,56 @@ class GBDT(PredictorBase):
             return
         if self.uses_wave:
             from ..core.wave_grower import build_wave_grow_fn
-            self._grow_raw = build_wave_grow_fn(
-                self.meta, self.split_cfg, self.B,
-                wave_capacity=int(config.tpu_wave_capacity),
-                highest=self._hist_mode(config),
-                gain_gate=float(config.tpu_wave_gain_gate),
-                block_rows=int(config.tpu_block_rows),
-                B_phys=self.B_phys, bundled=self._bundled, cegb=cegb_cfg)
+
+            def build_wave():
+                return build_wave_grow_fn(
+                    self.meta, self.split_cfg, self.B,
+                    wave_capacity=int(config.tpu_wave_capacity),
+                    highest=self._hist_mode(config),
+                    gain_gate=float(config.tpu_wave_gain_gate),
+                    block_rows=int(config.tpu_block_rows),
+                    B_phys=self.B_phys, bundled=self._bundled,
+                    cegb=cegb_cfg)
+            if cegb_cfg is None:
+                key = ("wave", id(self.meta), self.split_cfg, self.B,
+                       self.B_phys, self._bundled,
+                       int(config.tpu_wave_capacity),
+                       self._hist_mode(config),
+                       float(config.tpu_wave_gain_gate),
+                       int(config.tpu_block_rows))
+                self._grow_raw = _cached_jit(key, build_wave)
+                self._raw_cached = True
+            else:
+                self._grow_raw = build_wave()
             # feature-major resident copy for the Pallas kernel layout
             self._grow_bins = jnp.asarray(
                 np.ascontiguousarray(train_ds.X_bin.T))
         else:
             from ..core.grower import build_grow_fn
-            self._grow_raw = build_grow_fn(self.meta, self.split_cfg, self.B,
-                                           B_phys=self.B_phys,
-                                           bundled=self._bundled,
-                                           cegb=cegb_cfg, forced=forced,
-                                           bynode=bynode)
+
+            def build_xla():
+                return build_grow_fn(self.meta, self.split_cfg, self.B,
+                                     B_phys=self.B_phys,
+                                     bundled=self._bundled,
+                                     cegb=cegb_cfg, forced=forced,
+                                     bynode=bynode)
+            if cegb_cfg is None and forced is None and bynode is None:
+                key = ("xla", id(self.meta), self.split_cfg, self.B,
+                       self.B_phys, self._bundled)
+                self._grow_raw = _cached_jit(key, build_xla)
+                self._raw_cached = True
+            else:
+                self._grow_raw = build_xla()
             self._grow_bins = self._bins
-        self._grow = jax.jit(self._grow_raw)
+        # id(raw) is a safe key ONLY while the cache itself keeps the raw
+        # closure alive — i.e. when it came from _cached_jit above;
+        # transient closures (cegb/forced/bynode) must not be id-keyed or
+        # a recycled address could alias a different grower
+        if self._raw_cached:
+            self._grow = _cached_jit(("jit", id(self._grow_raw)),
+                                     lambda: jax.jit(self._grow_raw))
+        else:
+            self._grow = jax.jit(self._grow_raw)
         if self._cegb_on:
             F = train_ds.num_features
             coupled0 = np.zeros(F, np.float32)
@@ -432,19 +483,27 @@ class GBDT(PredictorBase):
         import jax
         import jax.numpy as jnp
 
-        @jax.jit
-        def apply_leaf(score_col, leaf_id, leaf_values):
-            return score_col + leaf_values[leaf_id]
+        def build_apply_leaf():
+            @jax.jit
+            def apply_leaf(score_col, leaf_id, leaf_values):
+                return score_col + leaf_values[leaf_id]
+            return apply_leaf
 
         bundled = self._bundled
+        meta = self.meta
 
-        @jax.jit
-        def traverse_add(score_col, tree: TreeArrays, bins):
-            leaf = predict_leaf_bins(tree, bins, self.meta, phys=bundled)
-            return score_col + tree.leaf_value[leaf]
+        def build_traverse_add():
+            @jax.jit
+            def traverse_add(score_col, tree: TreeArrays, bins):
+                leaf = predict_leaf_bins(tree, bins, meta, phys=bundled)
+                return score_col + tree.leaf_value[leaf]
+            return traverse_add
 
-        self._apply_leaf = apply_leaf
-        self._traverse_add = traverse_add
+        # cached closures pin their captured meta, so id(meta) keys
+        # cannot alias a recycled address
+        self._apply_leaf = _cached_jit(("apply_leaf",), build_apply_leaf)
+        self._traverse_add = _cached_jit(
+            ("traverse_add", id(meta), bundled), build_traverse_add)
 
         objective = self.objective
         K = self.num_tpi
@@ -464,38 +523,50 @@ class GBDT(PredictorBase):
         grow_raw = self._grow_raw
         bynode_on = getattr(self, "_bynode_on", False)
 
-        @functools.partial(jax.jit, static_argnames=("k",))
-        def grow_apply(bins, g, h, bag_mask, feature_mask, score, lr, k,
-                       seed=None):
-            """grow + shrink + train-score update for class k, one call.
+        def build_grow_apply():
+            @functools.partial(jax.jit, static_argnames=("k",))
+            def grow_apply(bins, g, h, bag_mask, feature_mask, score, lr, k,
+                           seed=None):
+                """grow + shrink + train-score update for class k, one call.
 
-            The leaf values are zeroed ON DEVICE when the tree failed to
-            split (num_leaves <= 1), so the score update is a no-op and the
-            host can check the leaf count one iteration late — that lag-1
-            check is what lets the next iteration's growth overlap the
-            device->host fetch instead of serializing on it."""
-            if bynode_on:
-                arrs, leaf_id = grow_raw(bins, g[:, k], h[:, k], bag_mask,
-                                         feature_mask, tree_seed=seed)
-            else:
-                arrs, leaf_id = grow_raw(bins, g[:, k], h[:, k], bag_mask,
-                                         feature_mask)
-            grew = arrs.num_leaves > 1
-            lv = jnp.where(grew, arrs.leaf_value * lr, 0.0)
-            arrs = arrs._replace(
-                leaf_value=lv,
-                internal_value=jnp.where(grew, arrs.internal_value * lr, 0.0))
-            new_score = score.at[:, k].add(lv[leaf_id])
-            return arrs, leaf_id, new_score
+                The leaf values are zeroed ON DEVICE when the tree failed
+                to split (num_leaves <= 1), so the score update is a no-op
+                and the host can check the leaf count one iteration late —
+                that lag-1 check is what lets the next iteration's growth
+                overlap the device->host fetch instead of serializing on
+                it."""
+                if bynode_on:
+                    arrs, leaf_id = grow_raw(bins, g[:, k], h[:, k],
+                                             bag_mask, feature_mask,
+                                             tree_seed=seed)
+                else:
+                    arrs, leaf_id = grow_raw(bins, g[:, k], h[:, k],
+                                             bag_mask, feature_mask)
+                grew = arrs.num_leaves > 1
+                lv = jnp.where(grew, arrs.leaf_value * lr, 0.0)
+                arrs = arrs._replace(
+                    leaf_value=lv,
+                    internal_value=jnp.where(grew,
+                                             arrs.internal_value * lr, 0.0))
+                new_score = score.at[:, k].add(lv[leaf_id])
+                return arrs, leaf_id, new_score
+            return grow_apply
 
-        self._grow_apply = grow_apply
+        if getattr(self, "_raw_cached", False):
+            self._grow_apply = _cached_jit(
+                ("grow_apply", id(grow_raw), bynode_on), build_grow_apply)
+        else:
+            self._grow_apply = build_grow_apply()
 
-        @functools.partial(jax.jit, static_argnames=("k",))
-        def valid_apply(vscore, arrs, vbins, k):
-            leaf = predict_leaf_bins(arrs, vbins, self.meta, phys=bundled)
-            return vscore.at[:, k].add(arrs.leaf_value[leaf])
+        def build_valid_apply():
+            @functools.partial(jax.jit, static_argnames=("k",))
+            def valid_apply(vscore, arrs, vbins, k):
+                leaf = predict_leaf_bins(arrs, vbins, meta, phys=bundled)
+                return vscore.at[:, k].add(arrs.leaf_value[leaf])
+            return valid_apply
 
-        self._valid_apply = valid_apply
+        self._valid_apply = _cached_jit(
+            ("valid_apply", id(meta), bundled), build_valid_apply)
 
     # ------------------------------------------------------------------
     def _materialize_trees(self) -> None:

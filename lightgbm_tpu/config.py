@@ -427,6 +427,14 @@ def _coerce(fld: dataclasses.Field, value: Any):
             return []
         if isinstance(value, str):
             items = [x for x in value.replace(",", " ").split() if x]
+        elif isinstance(value, (set, frozenset)):
+            # sets are a documented reference idiom: {'l2', 'l1'}; sort
+            # for a deterministic order — numerically when the values are
+            # numeric (eval_at={5,10,20} must stay [5,10,20])
+            try:
+                items = sorted(value, key=float)
+            except (TypeError, ValueError):
+                items = sorted(value, key=str)
         elif isinstance(value, (list, tuple)):
             items = list(value)
         else:

@@ -92,6 +92,9 @@ def _padded_bin_width(max_num_bin: int) -> int:
     return b
 
 
+_META_CACHE: dict = {}
+
+
 def build_device_meta(dataset, config=None):
     """Build (DeviceMeta, B) from a constructed ``BinnedDataset``.
 
@@ -130,6 +133,18 @@ def build_device_meta(dataset, config=None):
         feat2phys = np.arange(F, dtype=np.int32)
         feat_offset = np.zeros(F, dtype=np.int32)
         needs_fix = np.zeros(F, dtype=bool)
+    # Content-cached: equal datasets (e.g. GridSearchCV re-binning the
+    # same matrix per clone) get the SAME DeviceMeta object back, which
+    # keeps downstream jitted-closure caches (boosting/gbdt.py _JIT_CACHE)
+    # hitting instead of recompiling per Booster.
+    key = (nbins.tobytes(), default_bins.tobytes(), missing.tobytes(),
+           monotone.tobytes(), penalties.tobytes(), is_cat.tobytes(),
+           np.asarray(feat2phys).tobytes(),
+           np.asarray(feat_offset).tobytes(),
+           np.asarray(needs_fix).tobytes(), B)
+    hit = _META_CACHE.get(key)
+    if hit is not None:
+        return hit
     meta = DeviceMeta(
         num_bins=jnp.asarray(nbins),
         default_bins=jnp.asarray(default_bins),
@@ -141,6 +156,9 @@ def build_device_meta(dataset, config=None):
         feat_offset=jnp.asarray(feat_offset),
         needs_fix=jnp.asarray(needs_fix),
     )
+    if len(_META_CACHE) >= 32:
+        _META_CACHE.clear()
+    _META_CACHE[key] = (meta, B)
     return meta, B
 
 

@@ -151,8 +151,30 @@ class LGBMModel:
         params = self._process_params()
         if self._objective is None:
             self._objective = params.get("objective")
+        feval = None
         if eval_metric is not None:
-            params["metric"] = eval_metric
+            if isinstance(eval_metric, (set, frozenset)):
+                metrics = sorted(eval_metric, key=str)  # deterministic
+            elif isinstance(eval_metric, (list, tuple)):
+                metrics = list(eval_metric)
+            else:
+                metrics = [eval_metric]
+            name_metrics = [m for m in metrics if not callable(m)]
+            fn_metrics = [m for m in metrics if callable(m)]
+            if name_metrics:
+                params["metric"] = name_metrics
+            if fn_metrics:
+                # sklearn-style callables take (y_true, y_pred); the
+                # engine feval convention is (preds, dataset) with preds
+                # already objective-transformed (reference:
+                # sklearn.py _EvalFunctionWrapper)
+                def feval(preds, dataset):
+                    y_true = np.asarray(dataset.get_label())
+                    out = []
+                    for f in fn_metrics:
+                        r = f(y_true, preds)
+                        out.extend(r if isinstance(r, list) else [r])
+                    return out
         X = np.asarray(X, dtype=np.float64)
         y = np.asarray(y, dtype=np.float64).ravel()
         self._n_features = X.shape[1]
@@ -189,6 +211,7 @@ class LGBMModel:
             early_stopping_rounds=early_stopping_rounds,
             evals_result=self._evals_result,
             verbose_eval=verbose,
+            feval=feval,
             feature_name=feature_name,
             categorical_feature=categorical_feature,
             callbacks=callbacks)

@@ -144,3 +144,31 @@ def test_params_categorical_fallback_with_plain_dataframe():
     ds.construct()
     from lightgbm_tpu.io.binning import BIN_CATEGORICAL
     assert ds._handle.bin_mappers[2].bin_type == BIN_CATEGORICAL
+
+
+def test_lightgbm_import_shim():
+    """Reference scripts do `import lightgbm as lgb` — the shim must
+    expose the same surface as lightgbm_tpu."""
+    import lightgbm as ref_style
+    assert ref_style.Dataset is lgb.Dataset
+    assert ref_style.Booster is lgb.Booster
+    assert ref_style.train is lgb.train
+    assert ref_style.LGBMClassifier is lgb.LGBMClassifier
+    assert hasattr(ref_style, "plot_importance")
+    assert hasattr(ref_style, "cv")
+
+
+def test_sklearn_estimator_pickles():
+    """Fitted sklearn wrappers must pickle (reference:
+    test_sklearn.py joblib round-trips) — exercises Booster.__getstate__
+    inside the estimator."""
+    rng = np.random.default_rng(21)
+    X = rng.normal(size=(300, 5))
+    y = (X[:, 0] > 0).astype(int)
+    clf = lgb.LGBMClassifier(n_estimators=4, num_leaves=7,
+                             min_child_samples=5, verbose=-1)
+    clf.fit(X, y)
+    re = pickle.loads(pickle.dumps(clf))
+    np.testing.assert_allclose(re.predict_proba(X), clf.predict_proba(X),
+                               rtol=1e-6)
+    assert (re.predict(X) == clf.predict(X)).all()

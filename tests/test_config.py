@@ -80,3 +80,12 @@ def test_config_file(tmp_path):
     assert cfg.task == "train"
     assert cfg.num_iterations == 7
     assert cfg.learning_rate == 0.2
+
+
+def test_multi_value_params_accept_sets():
+    """The reference python-guide passes metric={'l2', 'l1'} — sets must
+    coerce like lists (order made deterministic by sorting)."""
+    cfg = Config.from_params({"metric": {"l2", "l1"}})
+    assert cfg.metric == ["l1", "l2"]
+    cfg2 = Config.from_params({"eval_at": (1, 3)})
+    assert cfg2.eval_at == [1, 3]

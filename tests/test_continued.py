@@ -1,5 +1,7 @@
 """Continued training, init_model, and refit
 (reference: boosting.cpp:35-69, gbdt.cpp:298-321, basic.py:2547)."""
+import os
+
 import numpy as np
 import pytest
 
@@ -142,3 +144,42 @@ def test_init_model_with_now_trivial_feature():
         leaf = np.asarray(predict_leaf_bins(arrs, gb._bins, gb.meta))
         score += np.asarray(arrs.leaf_value)[leaf]
     np.testing.assert_allclose(score, want, atol=1e-5)
+
+
+REF_CLI = "/tmp/refsrc/lightgbm"
+
+
+@pytest.mark.skipif(not os.path.exists(REF_CLI),
+                    reason="reference CLI binary not built")
+def test_continue_training_from_reference_model(tmp_path):
+    """init_model pointing at a model the REFERENCE binary trained: our
+    engine must resume boosting from its scores and improve the metric
+    (reference: boosting.cpp:35-69 LoadFileToBoosting + input_model)."""
+    import subprocess
+    conf = tmp_path / "t.conf"
+    model = str(tmp_path / "ref5.txt")
+    conf.write_text(
+        "task = train\nobjective = binary\n"
+        "data = /root/reference/examples/binary_classification/binary.train\n"
+        "num_trees = 5\nnum_leaves = 31\nlearning_rate = 0.1\n"
+        "min_data_in_leaf = 20\n"
+        f"output_model = {model}\nverbosity = -1\n")
+    r = subprocess.run([REF_CLI, f"config={conf}"], capture_output=True,
+                       text=True, timeout=300, cwd=str(tmp_path))
+    assert r.returncode == 0, r.stderr[-1000:]
+
+    raw = np.loadtxt(
+        "/root/reference/examples/binary_classification/binary.train")
+    raw_t = np.loadtxt(
+        "/root/reference/examples/binary_classification/binary.test")
+    y, X = raw[:, 0], raw[:, 1:]
+    p = {"objective": "binary", "num_leaves": 31, "learning_rate": 0.1,
+         "min_data_in_leaf": 20, "metric": "auc", "verbose": -1}
+    ds = lgb.Dataset(X, label=y, params=p)
+    bst = lgb.train(p, ds, 10, init_model=model)
+    assert bst.num_trees() == 15  # 5 loaded + 10 new
+    from sklearn.metrics import roc_auc_score
+    auc5 = roc_auc_score(raw_t[:, 0],
+                         lgb.Booster(model_file=model).predict(raw_t[:, 1:]))
+    auc15 = roc_auc_score(raw_t[:, 0], bst.predict(raw_t[:, 1:]))
+    assert auc15 > auc5 + 0.01, (auc5, auc15)

@@ -64,3 +64,37 @@ def test_dataset_binary_save_load(tmp_path):
     ds2 = load_dataset(p)
     np.testing.assert_array_equal(ds2.X_bin, ds.construct()._handle.X_bin)
     np.testing.assert_allclose(ds2.metadata.label, y.astype(np.float32))
+
+
+REF_CLI = "/tmp/refsrc/lightgbm"
+
+
+@pytest.mark.skipif(not os.path.exists(REF_CLI),
+                    reason="reference CLI binary not built")
+def test_reference_cli_loads_our_model(tmp_path):
+    """Cross-compat in the HARD direction: the reference binary must load
+    a model file we wrote and reproduce our predictions (proves the v3
+    text format is semantically complete, not just parseable by us)."""
+    import subprocess
+    raw = np.loadtxt(
+        "/root/reference/examples/binary_classification/binary.train")
+    y, X = raw[:, 0], raw[:, 1:]
+    p = {"objective": "binary", "num_leaves": 31, "learning_rate": 0.1,
+         "min_data_in_leaf": 20, "verbose": -1}
+    bst = lgb.train(p, lgb.Dataset(X, label=y, params=p), 10)
+    model = str(tmp_path / "ours.txt")
+    bst.save_model(model)
+    out = str(tmp_path / "ref_pred.txt")
+    conf = tmp_path / "pred.conf"
+    conf.write_text(
+        "task = predict\n"
+        "data = /root/reference/examples/binary_classification/binary.test\n"
+        f"input_model = {model}\noutput_result = {out}\nverbosity = -1\n")
+    r = subprocess.run([REF_CLI, f"config={conf}"], capture_output=True,
+                       text=True, timeout=300, cwd=str(tmp_path))
+    assert r.returncode == 0, r.stderr[-1500:]
+    ref_pred = np.loadtxt(out)
+    raw_t = np.loadtxt(
+        "/root/reference/examples/binary_classification/binary.test")
+    ours = bst.predict(raw_t[:, 1:])
+    np.testing.assert_allclose(ref_pred, ours, rtol=1e-6, atol=1e-9)

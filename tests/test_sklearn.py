@@ -131,3 +131,53 @@ def test_plotting_smoke(tmp_path):
     assert ax3 is not None
     g = lgb.create_tree_digraph(clf, tree_index=0)
     assert "leaf" in g.source
+
+
+def test_callable_eval_metric():
+    import lightgbm_tpu as lgb
+    """Custom sklearn-style eval functions (reference:
+    examples/python-guide/sklearn_example.py rmsle/rae) reach the eval
+    loop with transformed predictions, singly or in lists."""
+    rng = np.random.default_rng(17)
+    X = rng.normal(size=(400, 5))
+    y = X[:, 0] * 2 + rng.normal(size=400) * 0.1
+
+    def rmsle_like(y_true, y_pred):
+        return "custom_rmse", float(np.sqrt(np.mean((y_true - y_pred) ** 2))), False
+
+    calls = []
+
+    def spy(y_true, y_pred):
+        calls.append(len(y_pred))
+        return [rmsle_like(y_true, y_pred)]
+
+    reg = lgb.LGBMRegressor(n_estimators=4, num_leaves=7,
+                            min_child_samples=5, verbose=-1)
+    reg.fit(X, y, eval_set=[(X[:100], y[:100])], eval_metric=spy)
+    assert calls and all(c == 100 for c in calls)
+    assert "custom_rmse" in reg.evals_result_["valid_0"]
+    # mixing a named metric with a callable
+    reg2 = lgb.LGBMRegressor(n_estimators=3, num_leaves=7,
+                             min_child_samples=5, verbose=-1)
+    reg2.fit(X, y, eval_set=[(X[:100], y[:100])],
+             eval_metric=["l1", rmsle_like])
+    assert "l1" in reg2.evals_result_["valid_0"]
+    assert "custom_rmse" in reg2.evals_result_["valid_0"]
+
+
+def test_classifier_callable_eval_metric_gets_probabilities():
+    import lightgbm_tpu as lgb
+    rng = np.random.default_rng(18)
+    X = rng.normal(size=(400, 4))
+    y = (X[:, 0] > 0).astype(int)
+    seen = {}
+
+    def check_probs(y_true, y_pred):
+        seen["range"] = (float(y_pred.min()), float(y_pred.max()))
+        return "dummy", 0.0, False
+
+    clf = lgb.LGBMClassifier(n_estimators=3, num_leaves=7,
+                             min_child_samples=5, verbose=-1)
+    clf.fit(X, y, eval_set=[(X, y)], eval_metric=check_probs)
+    lo, hi = seen["range"]
+    assert 0.0 <= lo and hi <= 1.0  # transformed, not raw margins

@@ -193,3 +193,27 @@ def test_pred_contrib_start_iteration():
     np.testing.assert_allclose(contrib.sum(axis=1), raw, rtol=1e-5, atol=1e-6)
     full = bst.predict(sub, pred_contrib=True)
     assert not np.allclose(contrib, full)
+
+
+def test_jit_cache_reuses_compiled_growers():
+    """Identical datasets + configs share one compiled grower across
+    Boosters (cv/grid-search would otherwise recompile per fit)."""
+    from lightgbm_tpu.boosting import gbdt as gbdt_mod
+    from lightgbm_tpu.core import meta as meta_mod
+    gbdt_mod._JIT_CACHE.clear()   # isolate from suite-order cache state
+    meta_mod._META_CACHE.clear()
+    rng = np.random.default_rng(23)
+    X = rng.normal(size=(300, 4))
+    y = (X[:, 0] > 0).astype(np.float64)
+    p = {"objective": "binary", "num_leaves": 7, "verbose": -1,
+         "min_data_in_leaf": 5}
+    b1 = lgb.train(p, lgb.Dataset(X, label=y, params=p), 2)
+    n_entries = len(gbdt_mod._JIT_CACHE)
+    b2 = lgb.train(p, lgb.Dataset(X, label=y, params=p), 2)
+    assert len(gbdt_mod._JIT_CACHE) == n_entries  # all hits, no new keys
+    assert b1._gbdt._grow_raw is b2._gbdt._grow_raw
+    np.testing.assert_allclose(b1.predict(X), b2.predict(X), rtol=1e-12)
+    # a different static config builds (and caches) a distinct grower
+    p2 = dict(p, num_leaves=15)
+    b3 = lgb.train(p2, lgb.Dataset(X, label=y, params=p2), 2)
+    assert b3._gbdt._grow_raw is not b1._gbdt._grow_raw
