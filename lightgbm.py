@@ -8,7 +8,7 @@ in the same environment it will shadow or be shadowed by this module
 depending on ``sys.path`` order — this repo's image does not ship it.
 """
 from lightgbm_tpu import *  # noqa: F401,F403
-from lightgbm_tpu import __version__, basic, callback, engine, plotting, sklearn  # noqa: F401
+from lightgbm_tpu import __version__, basic, callback, compat, engine, plotting, sklearn  # noqa: F401
 
 try:  # mirror the reference's submodule layout for qualified imports
     from lightgbm_tpu import capi as c_api  # noqa: F401

@@ -490,13 +490,10 @@ class Booster:
             # when absent to stay byte-identical with reference CLI files
             import json as _json
 
-            def _np_default(o):  # numpy category values (int64/float64/...)
-                if hasattr(o, "item"):
-                    return o.item()
-                raise TypeError(f"{type(o).__name__} is not JSON "
-                                "serializable")
+            from .compat import json_default_with_numpy
             txt += ("\npandas_categorical:"
-                    + _json.dumps(pc, default=_np_default) + "\n")
+                    + _json.dumps(pc, default=json_default_with_numpy)
+                    + "\n")
         return txt
 
     def dump_model(self, num_iteration: Optional[int] = None,

@@ -1,0 +1,55 @@
+"""Optional-dependency detection flags (reference:
+python-package/lightgbm/compat.py) — the guide scripts branch on these
+(``lgb.compat.MATPLOTLIB_INSTALLED`` etc.)."""
+from __future__ import annotations
+
+
+def json_default_with_numpy(obj):
+    """JSON serializer fallback for numpy scalars/arrays
+    (reference: compat.py:51-60)."""
+    if hasattr(obj, "item") and not hasattr(obj, "__len__"):
+        return obj.item()
+    if hasattr(obj, "tolist"):
+        return obj.tolist()
+    raise TypeError(f"{type(obj).__name__} is not JSON serializable")
+
+
+try:
+    from pandas import DataFrame, Series  # noqa: F401
+
+    PANDAS_INSTALLED = True
+except ImportError:  # pragma: no cover
+    PANDAS_INSTALLED = False
+
+    class DataFrame:  # type: ignore[no-redef]
+        pass
+
+    class Series:  # type: ignore[no-redef]
+        pass
+
+try:
+    import matplotlib  # noqa: F401
+
+    MATPLOTLIB_INSTALLED = True
+except ImportError:  # pragma: no cover
+    MATPLOTLIB_INSTALLED = False
+
+try:
+    import graphviz  # noqa: F401
+
+    GRAPHVIZ_INSTALLED = True
+except ImportError:  # pragma: no cover
+    GRAPHVIZ_INSTALLED = False
+
+DATATABLE_INSTALLED = False  # datatable is not shipped in this image
+
+try:
+    import sklearn  # noqa: F401
+
+    SKLEARN_INSTALLED = True
+except ImportError:  # pragma: no cover
+    SKLEARN_INSTALLED = False
+
+
+class LGBMDeprecationWarning(UserWarning):
+    """(reference: compat.py:161)."""

@@ -172,3 +172,21 @@ def test_sklearn_estimator_pickles():
     np.testing.assert_allclose(re.predict_proba(X), clf.predict_proba(X),
                                rtol=1e-6)
     assert (re.predict(X) == clf.predict(X)).all()
+
+
+def test_compat_module_flags():
+    import importlib.util
+
+    from lightgbm_tpu import compat
+    for flag, mod in (("PANDAS_INSTALLED", "pandas"),
+                      ("MATPLOTLIB_INSTALLED", "matplotlib"),
+                      ("SKLEARN_INSTALLED", "sklearn"),
+                      ("GRAPHVIZ_INSTALLED", "graphviz")):
+        assert getattr(compat, flag) == bool(
+            importlib.util.find_spec(mod))
+    import lightgbm
+    assert lightgbm.compat is compat
+    import json
+    assert json.dumps({"v": np.int64(3), "a": np.array([1, 2])},
+                      default=compat.json_default_with_numpy) \
+        == '{"v": 3, "a": [1, 2]}'
