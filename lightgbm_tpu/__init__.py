@@ -12,14 +12,26 @@ The public API mirrors the reference Python package
 
 import os as _os
 
-# The container's sitecustomize pins jax's platform list at import time,
-# which silently overrides a JAX_PLATFORMS env var set by a parent process
-# (e.g. the test suite spawning the CLI with JAX_PLATFORMS=cpu). Re-apply
-# the env var so subprocess platform selection behaves as documented.
-if _os.environ.get("JAX_PLATFORMS"):
+# The container's site config pins jax's platform list to "axon,cpu" at
+# interpreter start, silently overriding a JAX_PLATFORMS env var set by a
+# parent process (e.g. the test suite spawning the CLI with
+# JAX_PLATFORMS=cpu). Re-apply the env var ONLY while the config still
+# leads with that pinned "axon" AND the env var asks for something else:
+# a config that was changed programmatically (jax.config.update before
+# importing this package) is a deliberate choice and must win over the
+# ambient container env.
+_env_plat = _os.environ.get("JAX_PLATFORMS")
+if _env_plat:
     import jax as _jax
 
-    _jax.config.update("jax_platforms", _os.environ["JAX_PLATFORMS"])
+    _cur = _jax.config.jax_platforms or ""
+    # Heuristic, not provenance (the site config lives outside this repo
+    # so it cannot export a marker): an axon-led list is assumed to be
+    # the container pin. The one false positive — a user programmatically
+    # pinning the same axon-led list while the env var differs — resolves
+    # in favor of the env var, which is the contract this block restores.
+    if _cur.split(",")[0] == "axon" and _env_plat != _cur:
+        _jax.config.update("jax_platforms", _env_plat)
 
 from .basic import Booster, Dataset
 from .config import Config
