@@ -217,3 +217,31 @@ def test_jit_cache_reuses_compiled_growers():
     p2 = dict(p, num_leaves=15)
     b3 = lgb.train(p2, lgb.Dataset(X, label=y, params=p2), 2)
     assert b3._gbdt._grow_raw is not b1._gbdt._grow_raw
+
+
+def test_dart_and_goss_compose_with_bundling_and_categoricals():
+    """Boosting-mode x EFB x categorical interactions train sanely end to
+    end (cross-feature integration; no reference analog asserts this)."""
+    rng = np.random.default_rng(31)
+    n = 1500
+    cat = rng.integers(0, 12, n).astype(float)
+    onehot = np.zeros((n, 20))
+    sel = rng.integers(0, 20, n)
+    onehot[np.arange(n), sel] = 1.0
+    Xd = rng.normal(size=(n, 3))
+    X = np.hstack([cat[:, None], Xd, onehot])
+    y = ((cat < 6) ^ (Xd[:, 0] > 0)).astype(np.float64)
+    from sklearn.metrics import roc_auc_score
+    for boosting in ("dart", "goss"):
+        p = {"objective": "binary", "num_leaves": 15, "verbose": -1,
+             "min_data_in_leaf": 10, "boosting": boosting,
+             "categorical_feature": [0], "enable_bundle": True}
+        ds = lgb.Dataset(X, label=y, params=p)
+        bst = lgb.train(p, ds, 12)
+        assert ds._handle.bundle is not None
+        auc = roc_auc_score(y, bst.predict(X))
+        assert auc > 0.9, (boosting, auc)
+        # categorical splits actually happened and round-trip
+        assert any(t["num_cat"] > 0 for t in bst.dump_model()["tree_info"])
+        re = lgb.Booster(model_str=bst.model_to_string())
+        np.testing.assert_allclose(re.predict(X), bst.predict(X), rtol=1e-6)
