@@ -11,7 +11,10 @@ present at $HIGGS_PATH) and reports steady-state row-iterations/second;
 vs_baseline > 1 means faster than the reference CPU result.
 
 Env knobs: BENCH_ROWS (default 1_000_000), BENCH_ITERS (default 10),
-BENCH_LEAVES (default 255).
+BENCH_LEAVES (default 255). BENCH_TASK=rank switches to an
+MSLR-WEB30K-shaped lambdarank run (ragged queries of 1..1251 docs, 136
+features, NDCG@10) against the reference's published MSLR CPU time
+(BASELINE.md: 1578 s for 500 iters over 2.27M rows).
 """
 from __future__ import annotations
 
@@ -23,6 +26,73 @@ import time
 import numpy as np
 
 REF_ROW_ITERS_PER_SEC = 10_500_000 * 500 / 238.5  # 2.2013e7
+# MSLR-WEB30K train fold: 2,270,296 rows, 31,531 queries; reference CPU
+# 500-iter time 1578 s (BASELINE.md) => 7.19e5 row-iterations/second
+REF_RANK_ROW_ITERS_PER_SEC = 2_270_296 * 500 / 1578.0
+
+
+def _rank_data(rows: int):
+    """MSLR-shaped synthetic: ragged queries (1..1251 docs, mean ~72),
+    136 features, graded 0-4 relevance correlated with a feature blend."""
+    rng = np.random.default_rng(0)
+    qsizes = []
+    total = 0
+    while total < rows:
+        s = int(min(max(1, rng.lognormal(3.8, 1.0)), 1251))
+        s = min(s, rows - total)
+        qsizes.append(s)
+        total += s
+    n = sum(qsizes)
+    X = rng.normal(size=(n, 136)).astype(np.float64)
+    w = rng.normal(size=12)
+    score = X[:, :12] @ w + rng.logistic(size=n) * 2.0
+    # per-query grading to 0..4 by within-query rank quantiles
+    y = np.zeros(n)
+    lo = 0
+    for s in qsizes:
+        q = score[lo:lo + s]
+        y[lo:lo + s] = np.searchsorted(
+            np.quantile(q, [0.5, 0.75, 0.9, 0.97]), q)
+        lo += s
+    return X, y, np.asarray(qsizes, np.int64)
+
+
+def _run_rank(iters: int, leaves: int, rows: int) -> dict:
+    import lightgbm_tpu as lgb
+
+    X, y, q = _rank_data(rows)
+    t_bin0 = time.time()
+    params = {"objective": "lambdarank", "metric": "ndcg",
+              "eval_at": [10], "num_leaves": leaves, "learning_rate": 0.1,
+              "max_bin": 255, "min_data_in_leaf": 50,
+              "min_sum_hessian_in_leaf": 5.0, "verbose": -1}
+    ds = lgb.Dataset(X, label=y, group=q, params=params)
+    ds.construct()
+    bin_time = time.time() - t_bin0
+    booster = lgb.Booster(params=params, train_set=ds)
+    t0 = time.time()
+    booster.update()
+    compile_time = time.time() - t0
+    t1 = time.time()
+    for _ in range(iters - 1):
+        booster.update()
+    per_iter = (time.time() - t1) / max(iters - 1, 1)
+    ndcg = next((v for (_, m, v, _) in booster.eval_train()
+                 if m.startswith("ndcg")), None)
+    rps = len(y) / per_iter
+    return {
+        "metric": "rank_train_throughput",
+        "value": round(rps, 1),
+        "unit": "row_iters/s",
+        "vs_baseline": round(rps / REF_RANK_ROW_ITERS_PER_SEC, 4),
+        "rows": len(y), "queries": len(q), "iters": iters,
+        "num_leaves": leaves,
+        "per_iter_s": round(per_iter, 3),
+        "compile_s": round(compile_time, 1),
+        "binning_s": round(bin_time, 1),
+        "train_ndcg10": None if ndcg is None else round(float(ndcg), 5),
+        "implied_mslr_500iter_s": round(2_270_296 * 500 / rps, 1),
+    }
 
 
 def _load_data(rows: int):
@@ -48,6 +118,15 @@ def main() -> None:
                          "compile warmup and is excluded from throughput")
 
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    if os.environ.get("BENCH_TASK", "").lower() == "rank":
+        # rank mode bounds: 255 leaves (uint8 bin kernels) and 500k rows
+        # (synthetic generation time); clamping is reported, not silent
+        if leaves > 255 or rows > 500_000:
+            print(f"# clamping rank bench to rows<=500000, leaves<=255 "
+                  f"(asked rows={rows}, leaves={leaves})", file=sys.stderr)
+        print(json.dumps(_run_rank(iters, min(leaves, 255),
+                                   min(rows, 500_000))))
+        return
     import lightgbm_tpu as lgb
 
     X, y = _load_data(rows)
