@@ -14,7 +14,7 @@ Env knobs: BENCH_ROWS (default 1_000_000), BENCH_ITERS (default 10),
 BENCH_LEAVES (default 255). BENCH_TASK=rank switches to an
 MSLR-WEB30K-shaped lambdarank run (ragged queries of 1..1251 docs, 136
 features, NDCG@10) against the reference's published MSLR CPU time
-(BASELINE.md: 1578 s for 500 iters over 2.27M rows).
+(BASELINE.md: 215.32 s for 500 iters over 2.27M rows).
 """
 from __future__ import annotations
 
@@ -26,9 +26,9 @@ import time
 import numpy as np
 
 REF_ROW_ITERS_PER_SEC = 10_500_000 * 500 / 238.5  # 2.2013e7
-# MSLR-WEB30K train fold: 2,270,296 rows, 31,531 queries; reference CPU
-# 500-iter time 1578 s (BASELINE.md) => 7.19e5 row-iterations/second
-REF_RANK_ROW_ITERS_PER_SEC = 2_270_296 * 500 / 1578.0
+# MSLR-WEB30K train fold: 2,270,296 rows; reference CPU 500-iter time
+# 215.32 s (BASELINE.md) => 5.272e6 row-iterations/second
+REF_RANK_ROW_ITERS_PER_SEC = 2_270_296 * 500 / 215.32
 
 
 def _rank_data(rows: int):
@@ -57,16 +57,14 @@ def _rank_data(rows: int):
     return X, y, np.asarray(qsizes, np.int64)
 
 
-def _run_rank(iters: int, leaves: int, rows: int) -> dict:
+def _measure(params: dict, X, y, group, iters: int, metric_prefix: str):
+    """Shared protocol for both benches: bin, one compile-warmup update,
+    (iters-1) steady-state updates, then read the train metric.
+    Returns (per_iter_s, compile_s, bin_s, metric_value, num_rows)."""
     import lightgbm_tpu as lgb
 
-    X, y, q = _rank_data(rows)
     t_bin0 = time.time()
-    params = {"objective": "lambdarank", "metric": "ndcg",
-              "eval_at": [10], "num_leaves": leaves, "learning_rate": 0.1,
-              "max_bin": 255, "min_data_in_leaf": 50,
-              "min_sum_hessian_in_leaf": 5.0, "verbose": -1}
-    ds = lgb.Dataset(X, label=y, group=q, params=params)
+    ds = lgb.Dataset(X, label=y, group=group, params=params)
     ds.construct()
     bin_time = time.time() - t_bin0
     booster = lgb.Booster(params=params, train_set=ds)
@@ -77,15 +75,26 @@ def _run_rank(iters: int, leaves: int, rows: int) -> dict:
     for _ in range(iters - 1):
         booster.update()
     per_iter = (time.time() - t1) / max(iters - 1, 1)
-    ndcg = next((v for (_, m, v, _) in booster.eval_train()
-                 if m.startswith("ndcg")), None)
-    rps = len(y) / per_iter
+    mval = next((v for (_, m, v, _) in booster.eval_train()
+                 if m.startswith(metric_prefix)), None)
+    return per_iter, compile_time, bin_time, mval, len(y)
+
+
+def _run_rank(iters: int, leaves: int, rows: int) -> dict:
+    X, y, q = _rank_data(rows)
+    params = {"objective": "lambdarank", "metric": "ndcg",
+              "eval_at": [10], "num_leaves": leaves, "learning_rate": 0.1,
+              "max_bin": 255, "min_data_in_leaf": 50,
+              "min_sum_hessian_in_leaf": 5.0, "verbose": -1}
+    per_iter, compile_time, bin_time, ndcg, n = _measure(
+        params, X, y, q, iters, "ndcg")
+    rps = n / per_iter
     return {
         "metric": "rank_train_throughput",
         "value": round(rps, 1),
         "unit": "row_iters/s",
         "vs_baseline": round(rps / REF_RANK_ROW_ITERS_PER_SEC, 4),
-        "rows": len(y), "queries": len(q), "iters": iters,
+        "rows": n, "queries": len(q), "iters": iters,
         "num_leaves": leaves,
         "per_iter_s": round(per_iter, 3),
         "compile_s": round(compile_time, 1),
@@ -127,32 +136,12 @@ def main() -> None:
         print(json.dumps(_run_rank(iters, min(leaves, 255),
                                    min(rows, 500_000))))
         return
-    import lightgbm_tpu as lgb
-
     X, y = _load_data(rows)
-    t_bin0 = time.time()
-    ds = lgb.Dataset(X, label=y, params={"max_bin": 255, "verbose": -1})
-    ds.construct()
-    bin_time = time.time() - t_bin0
-
     params = {"objective": "binary", "metric": "auc", "num_leaves": leaves,
               "learning_rate": 0.1, "max_bin": 255, "min_data_in_leaf": 100,
               "verbose": -1}
-    booster = lgb.Booster(params=params, train_set=ds)
-
-    # warmup iteration (jit compile)
-    t0 = time.time()
-    booster.update()
-    compile_time = time.time() - t0
-
-    t1 = time.time()
-    for _ in range(iters - 1):
-        booster.update()
-    steady = time.time() - t1
-    per_iter = steady / max(iters - 1, 1)
-
-    auc = booster.eval_train()
-    auc_val = next((v for (_, m, v, _) in auc if m == "auc"), None)
+    per_iter, compile_time, bin_time, auc_val, _ = _measure(
+        params, X, y, None, iters, "auc")
 
     row_iters_per_sec = rows / per_iter
     result = {
