@@ -8,6 +8,7 @@ taking precedence (reference: config.cpp Config::Set ordering).
 """
 from __future__ import annotations
 
+import os
 import sys
 from typing import Dict, List
 
@@ -40,20 +41,42 @@ def _parse_args(argv: List[str]) -> Dict[str, str]:
 
 
 def _dataset_from_file(path: str, cfg: Config, params: Dict,
-                       reference=None) -> Dataset:
+                       reference=None, initscore_path: str = "") -> Dataset:
     X, label, weight, group, names = load_text(path, cfg)
+    # init scores: explicit initscore_filename for the train set, else the
+    # <data>.init sidecar (reference: Metadata::LoadInitialScore,
+    # metadata.cpp — ".init" suffix convention)
+    init_score = None
+    if initscore_path and not os.path.exists(initscore_path):
+        log.fatal(f"Initial score file {initscore_path} does not exist")
+    for cand in ([initscore_path] if initscore_path else []) + [path + ".init"]:
+        if cand and os.path.exists(cand):
+            arr = np.loadtxt(cand, dtype=np.float64)
+            # multiclass files are N rows x K cols; the trainer consumes
+            # class-major flat layout (reference Metadata layout;
+            # gbdt init reshapes (K, N))
+            init_score = (arr.T.ravel() if arr.ndim == 2 else arr.ravel())
+            log.info("Loaded %d init scores from %s", len(init_score), cand)
+            break
     ds = Dataset(X, label=label, weight=weight, group=group,
+                 init_score=init_score,
                  feature_name=names, params=dict(params),
                  reference=reference)
     return ds
 
 
 def run_train(cfg: Config, params: Dict) -> None:
-    train_set = _dataset_from_file(cfg.data, cfg, params)
+    train_set = _dataset_from_file(
+        cfg.data, cfg, params,
+        initscore_path=getattr(cfg, "initscore_filename", ""))
     valid_sets, valid_names = [], []
     for i, vpath in enumerate(cfg.valid):
+        vinit = (cfg.valid_data_initscores[i]
+                 if i < len(getattr(cfg, "valid_data_initscores", []))
+                 else "")
         valid_sets.append(_dataset_from_file(vpath, cfg, params,
-                                             reference=train_set))
+                                             reference=train_set,
+                                             initscore_path=vinit))
         valid_names.append(f"valid_{i + 1}" if len(cfg.valid) > 1 else "valid")
 
     from . import callback

@@ -300,7 +300,6 @@ class Config:
                                         # leaves with gain >= gate * best
                                         # ready gain (1 = strict best-first
                                         # order, 0 = max wave throughput)
-    tpu_donate_buffers: bool = True
     tpu_mesh_shape: str = ""            # e.g. "data:8" or "data:4,feature:2"
 
     # ---- derived (not user-settable) ----

@@ -222,14 +222,24 @@ class MultiLoglossMetric(Metric):
 
 
 class MultiErrorMetric(Metric):
-    """(reference: multiclass_metric.hpp:163-180)."""
+    """Top-k classification error: a row scores 0 when at most
+    ``multi_error_top_k`` classes have a score >= the true class's
+    (reference: multiclass_metric.hpp:140-160)."""
     name = "multi_error"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.top_k = max(1, int(getattr(config, "multi_error_top_k", 1)))
+        if self.top_k > 1:
+            self.name = f"multi_error@{self.top_k}"
 
     def eval(self, score, objective) -> List[EvalResult]:
         score = np.asarray(score)
         lab = self.label.astype(np.int64)
-        pred = score.argmax(axis=1)
-        return [(self.name, self._avg((pred != lab).astype(np.float64)), False)]
+        true_score = score[np.arange(len(lab)), lab][:, None]
+        num_ge = (score >= true_score).sum(axis=1)  # includes the label
+        err = (num_ge > self.top_k).astype(np.float64)
+        return [(self.name, self._avg(err), False)]
 
 
 class AucMuMetric(Metric):

@@ -225,7 +225,10 @@ class LGBMModel:
                 **kwargs):
         self._check_fitted()
         X = np.asarray(X, dtype=np.float64)
-        if X.ndim != 2 or X.shape[1] != self._n_features:
+        disable_shape_check = kwargs.pop("predict_disable_shape_check",
+                                         False)
+        if (X.ndim != 2 or X.shape[1] != self._n_features) \
+                and not disable_shape_check:
             raise ValueError(
                 f"Number of features of the model must match the input. "
                 f"Model n_features_ is {self._n_features} and input "

@@ -139,3 +139,61 @@ def test_reference_example_confs_run_unchanged(example, metric_key, tmp_path):
     # the configured metric was actually evaluated on the valid set
     # (the log stream goes to stderr)
     assert metric_key.split("@")[0] in (r.stdout + r.stderr).lower()
+
+
+def test_init_score_sidecar_and_param(tmp_path):
+    """<data>.init sidecar and initscore_filename seed training scores
+    (reference: Metadata::LoadInitialScore)."""
+    rng = np.random.default_rng(41)
+    N = 500
+    X = rng.normal(size=(N, 4))
+    y = (X[:, 0] > 0).astype(int)
+    np.savetxt(tmp_path / "d.train", np.column_stack([y, X]), delimiter="\t",
+               fmt="%.8f")
+    np.savetxt(tmp_path / "d.train.init", np.full(N, 2.5), fmt="%.6f")
+    (tmp_path / "t.conf").write_text(
+        "task = train\nobjective = binary\ndata = d.train\n"
+        "num_trees = 2\nnum_leaves = 7\nmin_data_in_leaf = 5\n"
+        "output_model = m.txt\nverbosity = 1\n")
+    r = _run_cli(["config=t.conf"], cwd=str(tmp_path))
+    assert "Loaded 500 init scores" in r.stdout + r.stderr
+    # explicit initscore_filename branch, and the scores must actually
+    # shift training: a +2.5 offset changes the gradients, so the trees
+    # (raw predictions) differ from a run without init scores
+    np.savetxt(tmp_path / "other.init", np.full(N, 2.5), fmt="%.6f")
+    (tmp_path / "t2.conf").write_text(
+        "task = train\nobjective = binary\ndata = d.train\n"
+        "initscore_filename = other.init\n"
+        "num_trees = 2\nnum_leaves = 7\nmin_data_in_leaf = 5\n"
+        "output_model = m2.txt\nverbosity = 1\n")
+    (tmp_path / "d.train.init").unlink()  # only the explicit file remains
+    r2 = _run_cli(["config=t2.conf"], cwd=str(tmp_path))
+    assert "other.init" in r2.stdout + r2.stderr
+    (tmp_path / "t3.conf").write_text(
+        "task = train\nobjective = binary\ndata = d.train\n"
+        "num_trees = 2\nnum_leaves = 7\nmin_data_in_leaf = 5\n"
+        "output_model = m3.txt\nverbosity = -1\n")
+    _run_cli(["config=t3.conf"], cwd=str(tmp_path))
+    b_init = lgb.Booster(model_file=str(tmp_path / "m2.txt"))
+    b_none = lgb.Booster(model_file=str(tmp_path / "m3.txt"))
+    X2 = np.loadtxt(tmp_path / "d.train")[:, 1:]
+    assert not np.allclose(b_init.predict(X2, raw_score=True),
+                           b_none.predict(X2, raw_score=True))
+
+
+def test_multi_error_top_k():
+    rng = np.random.default_rng(42)
+    X = rng.normal(size=(400, 5))
+    y = (X[:, 0] > 0).astype(int) + (X[:, 1] > 0).astype(int)
+    p = {"objective": "multiclass", "num_class": 3, "verbose": -1,
+         "num_leaves": 7, "min_data_in_leaf": 5,
+         "metric": "multi_error", "multi_error_top_k": 2}
+    ds = lgb.Dataset(X, label=y.astype(float), params=p)
+    res = {}
+    bst = lgb.train(p, ds, 5, valid_sets=[ds], valid_names=["t"],
+                    callbacks=[lgb.record_evaluation(res)])
+    assert "multi_error@2" in res["t"]
+    # top-2 error must be <= top-1 error by construction
+    prob = bst.predict(X)
+    top1 = float((prob.argmax(1) != y).mean())
+    assert res["t"]["multi_error@2"][-1] <= top1 + 1e-12
