@@ -197,3 +197,29 @@ def test_multi_error_top_k():
     prob = bst.predict(X)
     top1 = float((prob.argmax(1) != y).mean())
     assert res["t"]["multi_error@2"][-1] <= top1 + 1e-12
+
+
+def test_cli_task_refit(workdir, tmp_path):
+    """task=refit re-estimates leaf values on new data, keeping structure
+    (reference: Application task kRefitTree -> GBDT::RefitTree)."""
+    # reuse the trained model.txt from the workdir fixture's train run
+    _run_cli(["config=train.conf"], cwd=str(workdir))
+    rng = np.random.default_rng(9)
+    data = np.loadtxt(os.path.join(str(workdir), "data.train"))
+    y2 = 1 - data[:, 0]  # flipped labels -> leaf values must move
+    np.savetxt(tmp_path / "new.train",
+               np.column_stack([y2, data[:, 1:]]), delimiter="\t", fmt="%.8f")
+    (tmp_path / "refit.conf").write_text(
+        "task = refit\nobjective = binary\n"
+        f"data = new.train\ninput_model = {workdir}/model.txt\n"
+        "output_model = refitted.txt\nverbosity = -1\n")
+    _run_cli(["config=refit.conf"], cwd=str(tmp_path))
+    orig = lgb.Booster(model_file=os.path.join(str(workdir), "model.txt"))
+    refit = lgb.Booster(model_file=str(tmp_path / "refitted.txt"))
+    d_orig = orig.dump_model()
+    d_refit = refit.dump_model()
+    for a, b in zip(d_orig["tree_info"], d_refit["tree_info"]):
+        assert a["tree_structure"].get("split_feature") == \
+            b["tree_structure"].get("split_feature")  # structure kept
+    X = data[:, 1:]
+    assert not np.allclose(orig.predict(X), refit.predict(X))

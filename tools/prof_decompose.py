@@ -1,0 +1,150 @@
+"""Decompose wave-grower tree time on the real TPU (throwaway scratch).
+
+Differences out the three cost hypotheses:
+  full        — build_wave_grow_fn as shipped
+  nokernel    — hist_pallas_wave stubbed to zeros (everything-but-kernel)
+  nocompact   — compact=False (no tier gathers, full-N kernel every wave)
+  kernel-only — bare hist_pallas_wave loop, 10 full passes
+Run: PYTHONPATH=/root/repo:/root/.axon_site python prof_decompose.py
+"""
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax
+import jax.numpy as jnp
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.core.meta import SplitConfig, build_device_meta
+from lightgbm_tpu.ops import pallas_hist
+from lightgbm_tpu.core import wave_grower
+
+ROWS = int(os.environ.get("PROF_ROWS", 1_000_000))
+
+
+def timeit(fn, *args, n=3, warmup=1):
+    for _ in range(warmup):
+        jax.block_until_ready(fn(*args))
+    t0 = time.time()
+    for _ in range(n):
+        out = jax.block_until_ready(fn(*args))
+    return (time.time() - t0) / n, out
+
+
+def main():
+    print("backend:", jax.default_backend(), flush=True)
+    rng = np.random.default_rng(0)
+    F = 28
+    X = rng.normal(size=(ROWS, F))
+    w = rng.normal(size=8)
+    y = (X[:, :8] @ w + 0.5 * X[:, 0] * X[:, 1]
+         + rng.logistic(size=ROWS) > 0).astype(np.float64)
+    params = {"objective": "binary", "num_leaves": 255,
+              "min_data_in_leaf": 100, "verbose": -1, "max_bin": 255}
+    ds = lgb.Dataset(X, label=y, params=params)
+    ds.construct()
+    cfg = lgb.Config.from_params(params)
+    meta, B = build_device_meta(ds._handle, cfg)
+    scfg = SplitConfig.from_config(cfg)
+    binsT = jnp.asarray(np.ascontiguousarray(ds._handle.X_bin.T))
+    g = jnp.asarray(rng.normal(size=ROWS).astype(np.float32))
+    h = jnp.asarray((rng.random(ROWS) * 0.25).astype(np.float32))
+    mask = jnp.ones(ROWS, jnp.float32)
+    fmask = jnp.ones(F, bool)
+
+    # kernel-only: one full pass
+    sl = np.full(pallas_hist.C_MAX, -1, np.int32)
+    sl[:126] = np.repeat(np.arange(42), 3)
+    slot_leaf = jnp.asarray(sl)
+    leaf_id = jnp.asarray(rng.integers(0, 42, ROWS, dtype=np.int32))
+    kf = jax.jit(lambda: pallas_hist.hist_pallas_wave(
+        binsT, g, h, mask, leaf_id, slot_leaf, B=B, block_rows=1024,
+        highest="2xbf16"))
+    dt, _ = timeit(kf, n=10)
+    print(f"kernel full pass:    {dt*1e3:8.1f} ms", flush=True)
+
+    variants = {}
+    grow_full = jax.jit(wave_grower.build_wave_grow_fn(
+        meta, scfg, B, wave_capacity=42, highest="2xbf16", gain_gate=0.5))
+    variants["full"] = grow_full
+    grow_nc = jax.jit(wave_grower.build_wave_grow_fn(
+        meta, scfg, B, wave_capacity=42, highest="2xbf16", gain_gate=0.5,
+        compact=False))
+    variants["nocompact"] = grow_nc
+
+    # stub the kernel: same signature/shape, no MXU work
+    real = pallas_hist.hist_pallas_wave
+
+    def stub(bins_fm, gv, hv, cv, leaf_id, slot_leaf, B, **kw):
+        """Shape-compatible fake histograms with enough structure that the
+        grower keeps splitting (positive counts/hessians, wiggly g sums) —
+        measures everything-but-kernel; check the reported leaf count."""
+        Fdim = bins_fm.shape[0]
+        i = jnp.arange(B, dtype=jnp.float32)[None, :, None]
+        c = jnp.arange(pallas_hist.C_MAX, dtype=jnp.float32)[None, None, :]
+        f = jnp.arange(Fdim, dtype=jnp.float32)[:, None, None]
+        base = jnp.sin(i * 0.37 + c * 1.3 + f * 2.1)
+        kind = (jnp.arange(pallas_hist.C_MAX) % 3)[None, None, :]
+        out = jnp.where(kind == 0, base * 3.0,
+                        jnp.where(kind == 1, 40.0 + 0.0 * base,
+                                  160.0 + 0.0 * base))
+        # trivial data dependence so nothing is DCE'd
+        s = (gv[0] + hv[0] + cv[0] + leaf_id[0].astype(jnp.float32)) * 0
+        return out + s
+
+    wave_grower.hist_pallas_wave = stub
+    grow_nk = jax.jit(wave_grower.build_wave_grow_fn(
+        meta, scfg, B, wave_capacity=42, highest="2xbf16", gain_gate=0.5))
+    # trace/compile NOW, while the stub is installed — the closure looks
+    # hist_pallas_wave up late-bound at trace time
+    jax.block_until_ready(grow_nk(binsT, g, h, mask, fmask)[1])
+    variants["nokernel"] = grow_nk
+    wave_grower.hist_pallas_wave = real
+
+    for name, fn in variants.items():
+        t0 = time.time()
+        tr, lid = fn(binsT, g, h, mask, fmask)
+        jax.block_until_ready(lid)
+        ct = time.time() - t0
+        dt, (tr, lid) = timeit(fn, binsT, g, h, mask, fmask, n=3)
+        print(f"grow {name:10s}: {dt*1e3:8.1f} ms  (compile {ct:.0f}s, "
+              f"leaves={int(tr.num_leaves)})", flush=True)
+
+    # ---- compaction-primitive microbenches -----------------------------
+    # hypothesis: the tier gathers + index scatter dominate non-kernel time
+    active = jnp.asarray(rng.random(ROWS) < 0.3)
+    T = ROWS // 2
+    bins_rm = jnp.asarray(np.asarray(binsT).T.copy())  # row-major [N, F]
+
+    def idx_build():
+        pos = jnp.cumsum(active.astype(jnp.int32))
+        return jnp.zeros((ROWS,), jnp.int32).at[
+            jnp.where(active, pos - 1, ROWS)
+        ].set(jnp.arange(ROWS, dtype=jnp.int32), mode="drop")
+
+    jidx = jax.jit(idx_build)
+    dt, idx = timeit(jidx, n=10)
+    print(f"index build (cumsum+scatter): {dt*1e3:8.2f} ms", flush=True)
+    idx_t = idx[:T]
+
+    g_fm = jax.jit(lambda i: jnp.take(binsT, i, axis=1))
+    dt, _ = timeit(g_fm, idx_t, n=10)
+    print(f"gather feature-major [F,N] axis=1 T={T}: {dt*1e3:8.2f} ms",
+          flush=True)
+    g_rm = jax.jit(
+        lambda i: jnp.transpose(jnp.take(bins_rm, i, axis=0)))
+    dt, _ = timeit(g_rm, idx_t, n=10)
+    print(f"gather row-major [N,F] axis=0 + T   : {dt*1e3:8.2f} ms",
+          flush=True)
+    g3 = jax.jit(lambda i: jnp.stack([g, h, mask], 1)[i])
+    dt, _ = timeit(g3, idx_t, n=10)
+    print(f"gather vec3 [N,3]                   : {dt*1e3:8.2f} ms",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()
