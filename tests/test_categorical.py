@@ -267,3 +267,30 @@ def test_wave_categorical_matches_serial():
     np.testing.assert_array_equal(np.asarray(lid1), np.asarray(lid2))
     # at least one categorical node must exist for this to be a real test
     assert np.any(np.asarray(t1.cat_bitset[:nn]) != 0)
+
+
+def test_high_cardinality_categorical_uint16_path():
+    """A categorical with > 256 distinct values widens X_bin to uint16 and
+    disables the uint8 wave kernel; train + split + round-trip must still
+    work end to end (reference: bin storage sizing, dataset.cpp)."""
+    rng = np.random.default_rng(44)
+    n = 4000
+    cat = rng.integers(0, 400, n).astype(float)  # 400 categories
+    x1 = rng.normal(size=n)
+    # direct categorical signal (marginally learnable) + numeric term
+    y = (((cat % 7) < 3).astype(float) + 0.5 * (x1 > 0)
+         + rng.logistic(size=n) * 0.2 > 0.75).astype(np.float64)
+    X = np.column_stack([cat, x1])
+    p = {"objective": "binary", "num_leaves": 31, "verbose": -1,
+         "min_data_in_leaf": 10, "max_cat_threshold": 64,
+         "categorical_feature": [0]}
+    ds = lgb.Dataset(X, label=y, params=p)
+    ds.construct()
+    assert ds._handle.X_bin.dtype == np.uint16
+    bst = lgb.train(p, ds, 10)
+    from sklearn.metrics import roc_auc_score
+    auc = roc_auc_score(y, bst.predict(X))
+    assert auc > 0.9, auc
+    assert any(t["num_cat"] > 0 for t in bst.dump_model()["tree_info"])
+    re = lgb.Booster(model_str=bst.model_to_string())
+    np.testing.assert_allclose(re.predict(X), bst.predict(X), rtol=1e-6)
