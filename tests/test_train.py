@@ -245,3 +245,26 @@ def test_dart_and_goss_compose_with_bundling_and_categoricals():
         assert any(t["num_cat"] > 0 for t in bst.dump_model()["tree_info"])
         re = lgb.Booster(model_str=bst.model_to_string())
         np.testing.assert_allclose(re.predict(X), bst.predict(X), rtol=1e-6)
+
+
+def test_zero_as_missing_end_to_end():
+    """zero_as_missing=true routes zeros by the learned default direction
+    at train AND predict time (binning-level behavior is covered in
+    test_binning; this exercises the full train->predict chain)."""
+    rng = np.random.default_rng(51)
+    n = 1200
+    X = rng.normal(size=(n, 4))
+    zero_mask = rng.random(n) < 0.3
+    X[zero_mask, 0] = 0.0  # 30% "missing" zeros in the signal feature
+    y = np.where(zero_mask, (X[:, 1] > 0), (X[:, 0] > 0.3)).astype(float)
+    p = {"objective": "binary", "num_leaves": 31, "verbose": -1,
+         "min_data_in_leaf": 10, "zero_as_missing": True,
+         "use_missing": True}
+    ds = lgb.Dataset(X, label=y, params=p)
+    bst = lgb.train(p, ds, 15)
+    from sklearn.metrics import roc_auc_score
+    auc = roc_auc_score(y, bst.predict(X))
+    assert auc > 0.9, auc
+    # model-text round-trip preserves the missing-type decision routing
+    re = lgb.Booster(model_str=bst.model_to_string())
+    np.testing.assert_allclose(re.predict(X), bst.predict(X), rtol=1e-6)
