@@ -12,9 +12,13 @@ vs_baseline > 1 means faster than the reference CPU result.
 
 Env knobs: BENCH_ROWS (default 1_000_000), BENCH_ITERS (default 10),
 BENCH_LEAVES (default 255). BENCH_TASK=rank switches to an
-MSLR-WEB30K-shaped lambdarank run (ragged queries of 1..1251 docs, 136
-features, NDCG@10) against the reference's published MSLR CPU time
+MSLR-WEB30K-shaped lambdarank run only (ragged queries of 1..1251 docs,
+136 features, NDCG@10) against the reference's published MSLR CPU time
 (BASELINE.md: 215.32 s for 500 iters over 2.27M rows).
+
+The DEFAULT run also appends the rank numbers (prefixed rank_*) to the
+single JSON line, sized by BENCH_RANK_ROWS (default 200_000) /
+BENCH_RANK_ITERS (default 5); BENCH_RANK_ROWS=0 skips the rank leg.
 """
 from __future__ import annotations
 
@@ -158,6 +162,32 @@ def main() -> None:
         "train_auc": None if auc_val is None else round(float(auc_val), 5),
         "implied_higgs_500iter_s": round(10_500_000 * 500 / row_iters_per_sec, 1),
     }
+    # Rank leg: fold the MSLR north-star numbers into the same JSON line so
+    # the driver's plain `python bench.py` run always captures them.
+    rank_rows = int(os.environ.get("BENCH_RANK_ROWS", 200_000))
+    rank_iters = max(int(os.environ.get("BENCH_RANK_ITERS", 5)), 2)
+    if rank_rows > 0:
+        if rank_rows > 500_000 or leaves > 255:
+            print(f"# clamping rank leg to rows<=500000, leaves<=255 "
+                  f"(asked rows={rank_rows}, leaves={leaves})",
+                  file=sys.stderr)
+        try:
+            rr = _run_rank(rank_iters, min(leaves, 255),
+                           min(rank_rows, 500_000))
+            result.update({
+                "rank_row_iters_per_s": rr["value"],
+                "rank_vs_baseline": rr["vs_baseline"],
+                "rank_rows": rr["rows"],
+                "rank_queries": rr["queries"],
+                "rank_iters": rr["iters"],
+                "rank_per_iter_s": rr["per_iter_s"],
+                "rank_compile_s": rr["compile_s"],
+                "rank_binning_s": rr["binning_s"],
+                "rank_train_ndcg10": rr["train_ndcg10"],
+                "implied_mslr_500iter_s": rr["implied_mslr_500iter_s"],
+            })
+        except Exception as exc:  # rank failure must not lose the main number
+            result["rank_error"] = f"{type(exc).__name__}: {exc}"[:200]
     print(json.dumps(result))
 
 
