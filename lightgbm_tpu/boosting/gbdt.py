@@ -312,11 +312,27 @@ class GBDT(PredictorBase):
             getattr(config, "forcedsplits_filename", ""), train_ds,
             self.split_cfg.num_leaves)
 
-        wave_ok = (config.device_type in ("tpu", "gpu")
-                   and jax.default_backend() == "tpu"
-                   and train_ds.X_bin.dtype == np.uint8
-                   and self.B_phys <= 256
-                   and train_ds.num_features > 0)
+        backend_ok = (config.device_type in ("tpu", "gpu")
+                      and jax.default_backend() == "tpu"
+                      and train_ds.num_features > 0)
+        narrow_all = (train_ds.X_bin.dtype == np.uint8
+                      and self.B_phys <= 256)
+        mixed_info = None
+        if backend_ok and not narrow_all:
+            # mixed-width: keep the <=256-bin columns on the Pallas kernel
+            # and side-pass the wide ones (core/wave_grower.py MixedWidth)
+            # instead of dropping the whole dataset to the XLA grower
+            from ..core.meta import _padded_bin_width
+            from ..core.wave_grower import MixedWidth
+            phys_bins = np.asarray(train_ds.phys_max_bins())
+            wide = phys_bins > 256
+            if wide.any() and (~wide).any():
+                mixed_info = MixedWidth(
+                    narrow_idx=np.flatnonzero(~wide).astype(np.int32),
+                    wide_idx=np.flatnonzero(wide).astype(np.int32),
+                    B_narrow=_padded_bin_width(int(phys_bins[~wide].max())))
+        self._wave_mixed = mixed_info
+        wave_ok = backend_ok and (narrow_all or mixed_info is not None)
         if forced is not None and wave_ok:
             log.info("forcedsplits_filename set: using the XLA serial "
                      "grower (the wave grower splits many leaves per pass "
@@ -369,7 +385,9 @@ class GBDT(PredictorBase):
                                  time_out=NETWORK.get("time_out"))
             mesh = build_mesh(config.tpu_mesh_shape)
             wave_kw = None
-            if self.uses_wave:
+            # engine growers shard one bins array; mixed-width stays
+            # serial-only and parallel uint16 keeps the XLA path
+            if self.uses_wave and mixed_info is None:
                 wave_kw = dict(
                     wave_capacity=int(config.tpu_wave_capacity),
                     highest=self._hist_mode(config),
@@ -404,21 +422,34 @@ class GBDT(PredictorBase):
                     gain_gate=float(config.tpu_wave_gain_gate),
                     block_rows=int(config.tpu_block_rows),
                     B_phys=self.B_phys, bundled=self._bundled,
-                    cegb=cegb_cfg)
+                    cegb=cegb_cfg, mixed=mixed_info)
             if cegb_cfg is None:
+                mixed_key = (None if mixed_info is None else
+                             (mixed_info.narrow_idx.tobytes(),
+                              mixed_info.wide_idx.tobytes(),
+                              mixed_info.B_narrow))
                 key = ("wave", id(self.meta), self.split_cfg, self.B,
                        self.B_phys, self._bundled,
                        int(config.tpu_wave_capacity),
                        self._hist_mode(config),
                        float(config.tpu_wave_gain_gate),
-                       int(config.tpu_block_rows))
+                       int(config.tpu_block_rows), mixed_key)
                 self._grow_raw = _cached_jit(key, build_wave)
                 self._raw_cached = True
             else:
                 self._grow_raw = build_wave()
             # feature-major resident copy for the Pallas kernel layout
-            self._grow_bins = jnp.asarray(
-                np.ascontiguousarray(train_ds.X_bin.T))
+            # (narrow-u8/wide pair when mixed-width)
+            if mixed_info is None:
+                self._grow_bins = jnp.asarray(
+                    np.ascontiguousarray(train_ds.X_bin.T))
+            else:
+                xbt = train_ds.X_bin.T
+                self._grow_bins = (
+                    jnp.asarray(np.ascontiguousarray(
+                        xbt[mixed_info.narrow_idx]).astype(np.uint8)),
+                    jnp.asarray(np.ascontiguousarray(
+                        xbt[mixed_info.wide_idx])))
         else:
             from ..core.grower import build_grow_fn
 

@@ -81,6 +81,58 @@ def _hist_block(bins, ghc, B: int):
     return out.reshape(F, B, 3)
 
 
+@functools.partial(jax.jit, static_argnames=("B",))
+def hist_wave_xla(bins_rm, gv, hv, cv, leaf_id, slot_leaf, B: int):
+    """XLA analog of ``ops.pallas_hist.hist_pallas_wave`` for WIDE
+    (>256-bin) features — the side-pass of the mixed-width wave path.
+
+    The Pallas kernel's one-hot tile is built per uint8 feature block in
+    VMEM; features with more than 256 bins don't fit that layout, so the
+    few wide columns (high-cardinality categoricals, mostly) take this
+    chunked one-hot contraction instead and are merged with the kernel's
+    output before the split scan (core/wave_grower.py).
+
+    bins_rm: ROW-major [N, Fw] bin indices; gv/hv/cv: f32 [N] (bag-masked
+    g, h, ones); leaf_id: i32 [N]; slot_leaf: i32 [C] channel->leaf map
+    (kinds cycle g,h,count; -1 = unused).  Returns [Fw, B, C] f32 matching
+    the kernel's channel semantics.
+    """
+    N, Fw = bins_rm.shape
+    C = slot_leaf.shape[0]
+    kind = jnp.arange(C, dtype=jnp.int32) % 3
+    vals = jnp.stack([gv, hv, cv], axis=1)               # [N, 3]
+    chunk = _chunk_rows(Fw, B)
+    if N > chunk:
+        pad = (-N) % chunk
+        if pad:
+            bins_rm = jnp.pad(bins_rm, ((0, pad), (0, 0)))
+            vals = jnp.pad(vals, ((0, pad), (0, 0)))
+            leaf_id = jnp.pad(leaf_id, (0, pad), constant_values=-2)
+        n_chunks = bins_rm.shape[0] // chunk
+        bins_c = bins_rm.reshape(n_chunks, chunk, Fw)
+        vals_c = vals.reshape(n_chunks, chunk, 3)
+        leaf_c = leaf_id.reshape(n_chunks, chunk)
+    else:
+        bins_c = bins_rm[None]
+        vals_c = vals[None]
+        leaf_c = leaf_id[None]
+
+    def body(acc, xs):
+        b, v, l = xs
+        m = (l[:, None] == slot_leaf[None, :]) & (slot_leaf >= 0)[None, :]
+        gh = jnp.where(m, v[:, kind], 0.0)               # [c, C]
+        oh = jax.nn.one_hot(b.astype(jnp.int32), B, dtype=jnp.float32)
+        out = jax.lax.dot_general(
+            oh.reshape(b.shape[0], Fw * B), gh, (((0,), (0,)), ((), ())),
+            precision=jax.lax.Precision.HIGHEST,
+            preferred_element_type=jnp.float32)          # [Fw*B, C]
+        return acc + out, None
+
+    init = jnp.zeros((Fw * B, C), jnp.float32)
+    out, _ = jax.lax.scan(body, init, (bins_c, vals_c, leaf_c))
+    return out.reshape(Fw, B, C)
+
+
 def hist_subtract(parent, child):
     """Sibling histogram by subtraction (reference:
     src/treelearner/feature_histogram.hpp:75-81, serial_tree_learner.cpp:567)."""

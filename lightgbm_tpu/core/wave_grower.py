@@ -33,11 +33,27 @@ import jax.numpy as jnp
 
 from ..ops.pallas_hist import C_MAX, hist_pallas_wave
 from .grower import TreeArrays, _empty_tree, decode_feature_col, go_left_node
-from .histogram import expand_bundled, fix_default_bins
+from .histogram import expand_bundled, fix_default_bins, hist_wave_xla
 from .meta import DeviceMeta, SplitConfig
 from .splitter import best_split, bitset_words, leaf_output
 
 NEG_INF = -jnp.inf
+
+
+class MixedWidth(NamedTuple):
+    """Static physical-column partition for the mixed-width wave path.
+
+    The Pallas kernel's VMEM one-hot layout tops out at 256 bins per
+    feature; a dataset with even one wider column (a high-cardinality
+    categorical, say) used to fall off the wave path entirely.  Instead
+    the narrow columns stay on the kernel and the wide ones take the XLA
+    side-pass (histogram.hist_wave_xla), merged before the split scan.
+
+    narrow_idx / wide_idx: np.int32 physical-column indices;
+    B_narrow: padded bin width of the narrow group (<= 256)."""
+    narrow_idx: np.ndarray
+    wide_idx: np.ndarray
+    B_narrow: int
 
 
 class _WaveState(NamedTuple):
@@ -75,9 +91,17 @@ def build_wave_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
                        interpret: bool = False, gain_gate: float = 0.0,
                        block_rows: int = 1024, compact: bool = True,
                        reduce_fn=None, B_phys: int = None,
-                       bundled: bool = False, cegb=None):
+                       bundled: bool = False, cegb=None,
+                       mixed: MixedWidth = None):
     """Unjitted ``grow(bins_fm, g, h, sample_mask, feature_mask)`` using the
     Pallas wave kernel. Returns (TreeArrays, leaf_id).
+
+    With ``mixed`` set, ``bins_fm`` is a PAIR ``(narrow_u8 [Fn, N],
+    wide [Fw, N])``: narrow physical columns ride the kernel at
+    ``mixed.B_narrow`` bins while the wide ones take the XLA one-hot
+    side-pass, merged into one ``[F_phys, B_phys, C]`` histogram before
+    the split scan — one >256-bin feature no longer evicts the whole
+    dataset from the fast path.
 
     ``reduce_fn`` (e.g. ``lambda x: jax.lax.psum(x, "data")``) makes the
     grower row-shard-aware for use under ``shard_map``: root statistics and
@@ -117,6 +141,48 @@ def build_wave_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
     # gain_gate > 1 would make _split_once never commit while loop_cond
     # stays true — an infinite while_loop on device
     gain_gate = min(max(float(gain_gate), 0.0), 1.0)
+
+    if mixed is not None:
+        Fn, Fw = len(mixed.narrow_idx), len(mixed.wide_idx)
+        assert Fn > 0 and Fw > 0, "mixed needs both narrow and wide columns"
+        _isw = np.zeros(Fn + Fw, bool)
+        _isw[mixed.wide_idx] = True
+        _pos = np.zeros(Fn + Fw, np.int32)
+        _pos[mixed.narrow_idx] = np.arange(Fn, dtype=np.int32)
+        _pos[mixed.wide_idx] = np.arange(Fw, dtype=np.int32)
+        is_wide_c = jnp.asarray(_isw)
+        pos_c = jnp.asarray(_pos)
+        inv_perm = jnp.asarray(np.argsort(np.concatenate(
+            [mixed.narrow_idx, mixed.wide_idx])).astype(np.int32))
+        B_kern = int(mixed.B_narrow)
+    else:
+        B_kern = B_phys
+
+    def _phys_col(bins_fm, p):
+        """Physical column ``p`` as i32 [N] across the narrow/wide pair."""
+        if mixed is None:
+            return bins_fm[p].astype(jnp.int32)
+        bins_n, bins_w = bins_fm
+        pos = pos_c[p]
+        coln = bins_n[jnp.minimum(pos, bins_n.shape[0] - 1)]
+        colw = bins_w[jnp.minimum(pos, bins_w.shape[0] - 1)]
+        return jnp.where(is_wide_c[p], colw.astype(jnp.int32),
+                         coln.astype(jnp.int32))
+
+    def _wave_hist(nb_fm, wide_rm, gvx, hvx, cvx, leafx, slot_leaf):
+        """One wave's physical histogram [F_phys, B_phys, C]: Pallas kernel
+        over the narrow columns (+ XLA side-pass over the wide ones when
+        mixed, merged back into physical order)."""
+        hw = hist_pallas_wave(nb_fm, gvx, hvx, cvx, leafx, slot_leaf,
+                              B=B_kern, block_rows=block_rows,
+                              highest=highest, interpret=interpret)
+        if mixed is None:
+            return hw
+        hw_w = hist_wave_xla(wide_rm, gvx, hvx, cvx, leafx, slot_leaf,
+                             B=B_phys)
+        if B_phys > B_kern:
+            hw = jnp.pad(hw, ((0, 0), (0, B_phys - B_kern), (0, 0)))
+        return jnp.concatenate([hw, hw_w], axis=0)[inv_perm]
 
     def _scan_leaf(hist_leaf, sg, sh, sc, min_c, max_c, depth, feature_mask,
                    cegb_coupled):
@@ -179,8 +245,7 @@ def build_wave_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
                 cat_bitset=tr.cat_bitset.at[k].set(cb),
             )
 
-            col = bins_fm[meta.feat2phys[f] if bundled else f].astype(
-                jnp.int32)
+            col = _phys_col(bins_fm, meta.feat2phys[f] if bundled else f)
             if bundled:
                 col = decode_feature_col(col, f, meta)
             go_left = go_left_node(col, t, dl, meta.is_categorical[f], cb,
@@ -224,6 +289,11 @@ def build_wave_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
             c_idx = jnp.arange(C_MAX) // 3
             slot_leaf = jnp.where(c_idx < P, st.pend_small[jnp.minimum(c_idx, P - 1)],
                                   -1).astype(jnp.int32)
+            if mixed is not None:
+                bins_n_fm, _ = bins_fm
+                bins_rm_n, bins_rm_w = bins_rm
+            else:
+                bins_n_fm, bins_rm_n, bins_rm_w = bins_fm, bins_rm, None
 
             # ---- active-row compaction --------------------------------
             # Only rows sitting in a pending-small leaf (and carrying
@@ -236,7 +306,7 @@ def build_wave_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
             # Static tiers keep the Pallas grid fully pipelined — a
             # dynamically bounded grid defeats Mosaic's DMA scheduling.
             if compact:
-                N = bins_fm.shape[1]
+                N = bins_n_fm.shape[1]
                 # empty pending slots (-1) write to dead slot L+1, never to
                 # a real leaf's entry
                 pend_tbl = jnp.zeros((L + 2,), bool).at[
@@ -271,10 +341,8 @@ def build_wave_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
                 def tier_call(T):
                     def f(_):
                         if T >= N:
-                            return hist_pallas_wave(
-                                bins_fm, gv, hv, cv, st.leaf_id, slot_leaf,
-                                B=B_phys, block_rows=block_rows, highest=highest,
-                                interpret=interpret)
+                            return _wave_hist(bins_n_fm, bins_rm_w, gv, hv,
+                                              cv, st.leaf_id, slot_leaf)
                         # index build lives inside the branch: full-tier
                         # waves never pay for it
                         pos = jnp.cumsum(active.astype(jnp.int32))
@@ -286,16 +354,16 @@ def build_wave_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
                         # F-byte read per index instead of F strided
                         # single-byte touches on the [F, N] layout, then
                         # one fast tiled transpose back to feature-major
-                        bins_c = jnp.take(bins_rm, idx_t, axis=0).T
+                        bins_c = jnp.take(bins_rm_n, idx_t, axis=0).T
+                        wide_c = (jnp.take(bins_rm_w, idx_t, axis=0)
+                                  if mixed is not None else None)
                         vc = vecs3[idx_t]                # ONE packed gather
                         # tail slots repeat row 0: leaf -2 misses every
                         # channel slot, so their values never contribute
                         leaf_c = jnp.where(arange_n[:T] < n_active,
                                            st.leaf_id[idx_t], -2)
-                        return hist_pallas_wave(
-                            bins_c, vc[:, 0], vc[:, 1], vc[:, 2], leaf_c,
-                            slot_leaf, B=B_phys, block_rows=block_rows,
-                            highest=highest, interpret=interpret)
+                        return _wave_hist(bins_c, wide_c, vc[:, 0], vc[:, 1],
+                                          vc[:, 2], leaf_c, slot_leaf)
                     return f
 
                 if K == 1:
@@ -310,10 +378,8 @@ def build_wave_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
                         jnp.clip(k, 0, K - 1),
                         [tier_call(T) for T in tiers], 0)  # [F, B, C]
             else:
-                hw = hist_pallas_wave(bins_fm, gv, hv, cv, st.leaf_id,
-                                      slot_leaf, B=B_phys,
-                                      block_rows=block_rows, highest=highest,
-                                      interpret=interpret)  # [Fp, Bp, C]
+                hw = _wave_hist(bins_n_fm, bins_rm_w, gv, hv, cv,
+                                st.leaf_id, slot_leaf)   # [Fp, Bp, C]
             if reduce_fn is not None:
                 # global histograms: every device now sees the same wave
                 # result and takes identical split decisions
@@ -375,7 +441,7 @@ def build_wave_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
 
     # ---------------- driver -------------------------------------------
     def grow(bins_fm, g, h, sample_mask, feature_mask, cegb_coupled=None):
-        N = bins_fm.shape[1]
+        N = (bins_fm[0] if mixed is not None else bins_fm).shape[1]
         F = int(meta.num_bins.shape[0])
         W = bitset_words(B)
         if cegb is not None and cegb_coupled is None:
@@ -435,8 +501,12 @@ def build_wave_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
         # row-major twin of the resident feature-major bins: materialized
         # once per tree (a ~50us transpose at 1M rows), it turns every
         # compaction gather from F strided byte-touches per row into one
-        # contiguous F-byte read (see _wave)
-        bins_rm = jnp.transpose(bins_fm) if compact else bins_fm
+        # contiguous F-byte read (see _wave).  The wide twin also feeds the
+        # XLA side-pass, so mixed mode builds it even when not compacting.
+        if mixed is not None:
+            bins_rm = (jnp.transpose(bins_fm[0]), jnp.transpose(bins_fm[1]))
+        else:
+            bins_rm = jnp.transpose(bins_fm) if compact else bins_fm
 
         def loop_body(st):
             ready = jnp.where(st.hist_ready[:L], st.best_gain[:L], NEG_INF)

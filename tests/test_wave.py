@@ -210,3 +210,109 @@ def test_wave_gated_boosting_matches_serial_loss():
                                       gain_gate=0.5))
     l_wave = boosted_loss(wave, bins_fm)
     assert l_wave <= 1.03 * l_serial, (l_serial, l_wave)
+
+
+def _mixed_problem(n=2000, seed=11):
+    """One 1000-category categorical (>256 bins -> uint16) + three narrow
+    numeric columns; label depends on both groups so splits land on each."""
+    rng = np.random.default_rng(seed)
+    cat = rng.integers(0, 1000, size=n)
+    X = np.stack([
+        cat.astype(np.float64),
+        rng.integers(0, 40, size=n).astype(np.float64),
+        rng.integers(0, 25, size=n).astype(np.float64),
+        rng.normal(size=n).round(1),
+    ], axis=1)
+    y = (((cat % 7) < 3).astype(float) + 0.05 * X[:, 1]
+         + 0.3 * rng.normal(size=n) > 0.6).astype(np.float64)
+    params = {"objective": "binary", "num_leaves": 15, "max_bin": 1024,
+              "min_data_in_leaf": 5, "min_data_per_group": 5,
+              "cat_smooth": 1.0, "cat_l2": 1.0, "verbose": -1}
+    ds = lgb.Dataset(X, label=y, categorical_feature=[0], params=params)
+    ds.construct()
+    return ds, params, y
+
+
+def test_mixed_width_wave_matches_serial():
+    """A >256-bin feature no longer evicts the dataset from the wave path:
+    narrow columns stay on the Pallas kernel (interpret mode) while the
+    wide one takes the XLA side-pass (hist_wave_xla), and capacity-1
+    growth reproduces the serial grower node-for-node."""
+    from lightgbm_tpu.core.meta import padded_phys_width, _padded_bin_width
+    from lightgbm_tpu.core.wave_grower import MixedWidth
+
+    ds, params, _ = _mixed_problem()
+    handle = ds._handle
+    assert handle.X_bin.dtype == np.uint16  # the wide column forced uint16
+    cfg = Config.from_params(params)
+    meta, B = build_device_meta(handle, cfg)
+    scfg = SplitConfig.from_config(cfg)
+    B_phys = padded_phys_width(handle)
+    phys_bins = np.asarray(handle.phys_max_bins())
+    wide = phys_bins > 256
+    assert wide.any() and (~wide).any()
+    mixed = MixedWidth(
+        narrow_idx=np.flatnonzero(~wide).astype(np.int32),
+        wide_idx=np.flatnonzero(wide).astype(np.int32),
+        B_narrow=_padded_bin_width(int(phys_bins[~wide].max())))
+    assert mixed.B_narrow <= 256
+
+    n = handle.num_data
+    rng = np.random.default_rng(3)
+    g = jnp.asarray(rng.normal(size=n).astype(np.float32))
+    h = jnp.asarray((0.1 + rng.random(size=n)).astype(np.float32))
+    mask = jnp.ones((n,), jnp.float32)
+    fmask = jnp.ones((handle.num_features,), bool)
+
+    serial = make_grower(meta, scfg, B)
+    t1, lid1 = serial(jnp.asarray(handle.X_bin), g, h, mask, fmask)
+
+    xbt = handle.X_bin.T
+    bins_pair = (
+        jnp.asarray(np.ascontiguousarray(xbt[mixed.narrow_idx]).astype(np.uint8)),
+        jnp.asarray(np.ascontiguousarray(xbt[mixed.wide_idx])))
+    wave = jax.jit(build_wave_grow_fn(meta, scfg, B, wave_capacity=1,
+                                      highest=True, interpret=True,
+                                      B_phys=B_phys, mixed=mixed))
+    t2, lid2 = wave(bins_pair, g, h, mask, fmask)
+
+    assert int(t1.num_leaves) == int(t2.num_leaves)
+    nn = int(t1.num_leaves) - 1
+    np.testing.assert_array_equal(np.asarray(t1.split_feature[:nn]),
+                                  np.asarray(t2.split_feature[:nn]))
+    np.testing.assert_array_equal(np.asarray(t1.threshold_bin[:nn]),
+                                  np.asarray(t2.threshold_bin[:nn]))
+    np.testing.assert_array_equal(np.asarray(t1.cat_bitset[:nn]),
+                                  np.asarray(t2.cat_bitset[:nn]))
+    np.testing.assert_allclose(np.asarray(t1.leaf_value),
+                               np.asarray(t2.leaf_value), rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_array_equal(np.asarray(lid1), np.asarray(lid2))
+    # the wide categorical must actually be split on for this to test the
+    # side-pass, and a narrow feature too for the kernel half
+    feats = set(np.asarray(t1.split_feature[:nn]).tolist())
+    assert 0 in feats and (feats - {0})
+
+
+def test_mixed_width_gate_activates_wave(monkeypatch):
+    """gbdt gating: with a TPU backend a uint16 dataset with narrow+wide
+    columns takes the wave path via MixedWidth instead of falling back
+    (VERDICT r4 weak #3)."""
+    ds, params, _ = _mixed_problem(seed=12)
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    bst = lgb.Booster(params={**params, "device_type": "tpu"},
+                      train_set=ds)
+    gb = bst._gbdt
+    assert gb.uses_wave
+    assert gb._wave_mixed is not None
+    assert isinstance(gb._grow_bins, tuple)
+    assert gb._grow_bins[0].dtype == jnp.uint8
+    # pure-narrow datasets are untouched by the mixed gate
+    rngb = np.random.default_rng(0)
+    Xs = rngb.normal(size=(200, 3)).round(1)
+    ys = (Xs[:, 0] > 0).astype(np.float64)
+    ds2 = lgb.Dataset(Xs, label=ys, params={"objective": "binary",
+                                            "verbose": -1})
+    bst2 = lgb.Booster(params={"objective": "binary", "verbose": -1,
+                               "device_type": "tpu"}, train_set=ds2)
+    assert bst2._gbdt.uses_wave and bst2._gbdt._wave_mixed is None
