@@ -18,7 +18,8 @@ MSLR-WEB30K-shaped lambdarank run only (ragged queries of 1..1251 docs,
 
 The DEFAULT run also appends the rank numbers (prefixed rank_*) to the
 single JSON line, sized by BENCH_RANK_ROWS (default 200_000) /
-BENCH_RANK_ITERS (default 5); BENCH_RANK_ROWS=0 skips the rank leg.
+BENCH_RANK_ITERS (default 5, minimum 2 — iteration 1 is compile warmup);
+BENCH_RANK_ROWS=0 skips the rank leg.
 """
 from __future__ import annotations
 

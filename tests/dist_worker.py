@@ -1,0 +1,135 @@
+"""Two-process jax.distributed worker — spawned by test_distributed.py.
+
+Each rank bootstraps the real multi-host runtime over a local coordinator
+(CPU backend, 1 device per process), then drives the three layers the
+single-process suite cannot reach:
+
+  1. ``init_distributed`` bring-up (parallel/distributed.py:87-152) —
+     machine-list parsing, coordinator handshake, rank resolution;
+  2. ``global_bin_sample`` cross-host sample pooling (the reference syncs
+     per-feature bin bounds over Network::Allgather,
+     dataset_loader.cpp:807-1042; we pool the samples instead);
+  3. data-parallel boosting through the engine grower: rows sharded over
+     the 2-process mesh, histograms psum'd ACROSS PROCESSES, trees
+     replicated — the reference's socket ReduceScatter
+     (data_parallel_tree_learner.cpp:119-164) as a cross-process XLA
+     collective.
+
+Writes a JSON summary (per-iteration tree fingerprints + the serial
+oracle's) for the parent test to cross-check between ranks.
+
+Usage: dist_worker.py <rank> <base_port> <out_json>
+"""
+import json
+import sys
+
+rank = int(sys.argv[1])
+base_port = int(sys.argv[2])
+out_path = sys.argv[3]
+
+import jax  # noqa: E402
+
+# the container's sitecustomize pins jax_platforms="axon,cpu"; an explicit
+# programmatic update is the only reliable CPU pin (see verify skill)
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+result = {"rank": rank}
+
+from lightgbm_tpu.parallel.distributed import (  # noqa: E402
+    global_bin_sample, init_distributed)
+
+machines = f"127.0.0.1:{base_port},127.0.0.1:{base_port + 1}"
+assert init_distributed(machines=machines, num_machines=2, rank=rank)
+assert jax.process_count() == 2, jax.process_count()
+assert jax.process_index() == rank
+result["global_devices"] = len(jax.devices())
+
+# ---- 2. cross-host bin-sample pooling --------------------------------
+rng = np.random.default_rng(0)
+n, f = 512, 5
+X = rng.normal(size=(n, f))
+y = (X[:, 0] + X[:, 1] * X[:, 2] > 0).astype(np.float64)
+
+sample = X[rank::2]  # each rank contributes a different half
+pooled, total = global_bin_sample(sample, num_local_rows=len(sample))
+assert total == n, total
+np.testing.assert_allclose(pooled, np.concatenate([X[0::2], X[1::2]]))
+result["pooled_rows"] = int(pooled.shape[0])
+
+# ---- 3. data-parallel boosting over the 2-process mesh ---------------
+import jax.numpy as jnp  # noqa: E402
+from jax.experimental import multihost_utils  # noqa: E402
+from jax.sharding import PartitionSpec as P  # noqa: E402
+
+import lightgbm_tpu as lgb  # noqa: E402
+from lightgbm_tpu.config import Config  # noqa: E402
+from lightgbm_tpu.core.grower import make_grower  # noqa: E402
+from lightgbm_tpu.core.meta import SplitConfig, build_device_meta  # noqa: E402
+from lightgbm_tpu.parallel.mesh import (  # noqa: E402
+    build_mesh, engine_pad_bins, make_engine_grower)
+
+params = {"objective": "binary", "num_leaves": 7, "min_data_in_leaf": 5,
+          "verbose": -1}
+ds = lgb.Dataset(X, label=y, params=params)
+ds.construct()
+handle = ds._handle
+cfg = Config.from_params(params)
+meta, B = build_device_meta(handle, cfg)
+scfg = SplitConfig.from_config(cfg)
+mesh = build_mesh()
+assert mesh.devices.size == 2, mesh.devices.size
+
+grow_dp = make_engine_grower("data", meta, scfg, B, mesh)
+serial = make_grower(meta, scfg, B)
+bins = engine_pad_bins(handle.X_bin, mesh.devices.size, feature_major=False)
+fmask = np.ones(f, bool)
+ones = np.ones(n, np.float32)
+
+
+def fingerprint(tree):
+    nn = int(tree.num_leaves) - 1
+    return {
+        "num_leaves": int(tree.num_leaves),
+        "split_feature": np.asarray(tree.split_feature[:nn]).tolist(),
+        "threshold_bin": np.asarray(tree.threshold_bin[:nn]).tolist(),
+        "leaf_value": np.round(
+            np.asarray(tree.leaf_value, np.float64), 10).tolist(),
+    }
+
+
+score = np.zeros(n, np.float32)
+score_s = np.zeros(n, np.float32)
+dp_trees, serial_trees = [], []
+for it in range(5):
+    p = 1.0 / (1.0 + np.exp(-score))
+    g = (p - y).astype(np.float32)
+    h = (p * (1.0 - p)).astype(np.float32)
+    tree, leaf_id = grow_dp(bins, g, h, ones, fmask)
+    # leaf_id is row-sharded across processes: fetch the local block and
+    # allgather blocks (mesh device order == process order)
+    lid_local = multihost_utils.global_array_to_host_local_array(
+        leaf_id, mesh, P("data"))
+    lid = np.asarray(multihost_utils.process_allgather(
+        jnp.asarray(lid_local))).reshape(-1)[:n]
+    lv = np.asarray(tree.leaf_value)
+    score = score + 0.1 * lv[lid]
+    dp_trees.append(fingerprint(tree))
+
+    # serial oracle: plain local jit, identical on both ranks
+    ps = 1.0 / (1.0 + np.exp(-score_s))
+    gs = (ps - y).astype(np.float32)
+    hs = (ps * (1.0 - ps)).astype(np.float32)
+    t_s, lid_s = serial(jnp.asarray(handle.X_bin), jnp.asarray(gs),
+                        jnp.asarray(hs), jnp.asarray(ones),
+                        jnp.asarray(fmask))
+    score_s = score_s + 0.1 * np.asarray(t_s.leaf_value)[np.asarray(lid_s)]
+    serial_trees.append(fingerprint(t_s))
+
+result["dp_trees"] = dp_trees
+result["serial_trees"] = serial_trees
+result["ok"] = True
+with open(out_path, "w") as fh:
+    json.dump(result, fh)
+print("WORKER_DONE", rank)
