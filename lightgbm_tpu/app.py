@@ -42,26 +42,70 @@ def _parse_args(argv: List[str]) -> Dict[str, str]:
 
 def _dataset_from_file(path: str, cfg: Config, params: Dict,
                        reference=None, initscore_path: str = "") -> Dataset:
+    if getattr(cfg, "two_round", False):
+        from .io.text_loader import _ParseError
+        try:
+            return _dataset_two_round(path, cfg, params, reference,
+                                      initscore_path)
+        except _ParseError as exc:
+            log.warning("two_round streaming needs the strict native "
+                        "parser (%s); falling back to in-memory loading",
+                        exc)
     X, label, weight, group, names = load_text(path, cfg)
-    # init scores: explicit initscore_filename for the train set, else the
-    # <data>.init sidecar (reference: Metadata::LoadInitialScore,
-    # metadata.cpp — ".init" suffix convention)
-    init_score = None
+    init_score = _load_init_scores(path, initscore_path)
+    ds = Dataset(X, label=label, weight=weight, group=group,
+                 init_score=init_score,
+                 feature_name=names, params=dict(params),
+                 reference=reference)
+    return ds
+
+
+def _load_init_scores(path: str, initscore_path: str = ""):
+    """Init scores: explicit initscore_filename, else the <data>.init
+    sidecar (reference: Metadata::LoadInitialScore, metadata.cpp — ".init"
+    suffix convention).  Multiclass files are N rows x K cols; the trainer
+    consumes class-major flat layout (gbdt init reshapes (K, N))."""
     if initscore_path and not os.path.exists(initscore_path):
         log.fatal(f"Initial score file {initscore_path} does not exist")
     for cand in ([initscore_path] if initscore_path else []) + [path + ".init"]:
         if cand and os.path.exists(cand):
             arr = np.loadtxt(cand, dtype=np.float64)
-            # multiclass files are N rows x K cols; the trainer consumes
-            # class-major flat layout (reference Metadata layout;
-            # gbdt init reshapes (K, N))
             init_score = (arr.T.ravel() if arr.ndim == 2 else arr.ravel())
             log.info("Loaded %d init scores from %s", len(init_score), cand)
-            break
-    ds = Dataset(X, label=label, weight=weight, group=group,
-                 init_score=init_score,
-                 feature_name=names, params=dict(params),
+            return init_score
+    return None
+
+
+def _dataset_two_round(path: str, cfg: Config, params: Dict,
+                       reference=None, initscore_path: str = "") -> Dataset:
+    """two_round=true file loading: stream the file twice instead of
+    materializing the raw matrix (reference: config.h two_round,
+    dataset_loader.cpp:807-827)."""
+    from .io.text_loader import load_text_two_round
+
+    ref_handle = (reference.construct()._handle
+                  if reference is not None else None)
+    cats = []
+    spec = str(getattr(cfg, "categorical_feature", "") or "")
+    for tok in spec.replace("name:", "").split(","):
+        tok = tok.strip()
+        if not tok:
+            continue
+        cats.append(int(tok) if tok.isdigit() else tok)
+    handle, label, weight, group, names = load_text_two_round(
+        path, cfg, categorical_features=cats, reference=ref_handle)
+    ds = Dataset(None, params=dict(params), feature_name=names,
                  reference=reference)
+    ds._handle = handle
+    if label is not None:
+        ds.set_label(label)
+    if weight is not None:
+        ds.set_weight(weight)
+    if group is not None:
+        ds.set_group(group)
+    init_score = _load_init_scores(path, initscore_path)
+    if init_score is not None:
+        ds.set_init_score(init_score)
     return ds
 
 

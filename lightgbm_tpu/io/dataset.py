@@ -173,15 +173,12 @@ class BinnedDataset:
         n, p = data.shape
         if n == 0:
             log.fatal("Cannot construct a Dataset from an empty matrix (0 rows)")
-        ds = cls()
-        ds.num_data = n
-        ds.num_total_features = p
-        ds.metadata = Metadata(n)
-        ds.max_bin = config.max_bin
-        ds.feature_names = (list(feature_names) if feature_names
-                            else [f"Column_{i}" for i in range(p)])
 
         if reference is not None:
+            ds = cls()
+            ds.num_data = n
+            ds.num_total_features = p
+            ds.metadata = Metadata(n)
             log.check(p == reference.num_total_features,
                       "validation data has a different number of features")
             ds.bin_mappers = reference.bin_mappers
@@ -201,11 +198,38 @@ class BinnedDataset:
             sample_indices = (np.arange(n, dtype=np.int64) if sample_cnt >= n
                               else rng.sample(n, sample_cnt).astype(np.int64))
         sample = data[sample_indices]
+        ds = cls.from_sample(sample, n, config,
+                             categorical_features=categorical_features,
+                             feature_names=feature_names)
+        from ..utils.timetag import timetag
+        ds._alloc_X()
+        with timetag("binarize"):
+            ds._binarize_chunk(data, 0)
+        return ds
+
+    @classmethod
+    def from_sample(cls, sample: np.ndarray, num_data: int, config: Config,
+                    categorical_features: Sequence[int] = (),
+                    feature_names: Optional[List[str]] = None) -> "BinnedDataset":
+        """Build mappers/feature-map/bundles from a row SAMPLE, leaving
+        ``X_bin`` unallocated — the constructor half of the reference's
+        two-pass loading (DatasetLoader::ConstructFromSampleData +
+        two_round, dataset_loader.cpp:574,807-827).  Callers then
+        ``_alloc_X()`` and stream rows through ``_binarize_chunk``.
+        """
+        ds = cls()
+        p = sample.shape[1]
+        ds.num_data = int(num_data)
+        ds.num_total_features = p
+        ds.metadata = Metadata(ds.num_data)
+        ds.max_bin = config.max_bin
+        ds.feature_names = (list(feature_names) if feature_names
+                            else [f"Column_{i}" for i in range(p)])
         # multi-host: pool every host's sample so all processes derive
         # identical mappers; sample-vs-data ratios below must then use the
         # GLOBAL row count (no-op single-host; parallel/distributed.py)
         from ..parallel.distributed import global_bin_sample
-        sample, n_global = global_bin_sample(sample, n)
+        sample, n_global = global_bin_sample(sample, ds.num_data)
 
         from ..utils.timetag import timetag
         cat_set = set(int(c) for c in categorical_features)
@@ -247,8 +271,6 @@ class BinnedDataset:
                                    config.max_conflict_rate)
             if not bundle.is_trivial:
                 ds.bundle = bundle
-        with timetag("binarize"):
-            ds._binarize(data)
         return ds
 
     def _finalize_features(self) -> None:
@@ -262,14 +284,19 @@ class BinnedDataset:
         if not used:
             log.warning("There are no meaningful features, as all feature values are constant.")
 
-    def _binarize(self, data: np.ndarray) -> None:
+    def _alloc_X(self) -> None:
+        """Allocate the binned matrix for ``num_data`` rows (filled by
+        ``_binarize_chunk`` — whole-matrix or streaming two_round)."""
         if self.bundle is not None:
-            self._binarize_bundled(data)
-            return
-        used = self.real_feature_idx
-        # size storage by the ACTUAL bin counts: categorical bin finding can
-        # exceed max_bin (reference sizes by num_bin, bin.cpp CreateBin)
-        widest = int(self.feature_max_bins().max(initial=0))
+            widest = int(max(self.bundle.phys_num_bin.max(initial=0),
+                             self.feature_max_bins().max(initial=0)))
+            cols = self.bundle.num_phys
+        else:
+            # size storage by the ACTUAL bin counts: categorical bin
+            # finding can exceed max_bin (reference sizes by num_bin,
+            # bin.cpp CreateBin)
+            widest = int(self.feature_max_bins().max(initial=0))
+            cols = len(self.real_feature_idx)
         dtype = (np.uint8 if widest <= 256
                  else np.uint16 if widest <= 65536 else np.uint32)
         if dtype != np.uint8 and self.max_bin <= 256:
@@ -277,7 +304,21 @@ class BinnedDataset:
                 "A feature has %d bins (> 256, from a high-cardinality "
                 "categorical); the whole binned matrix is widened to %s",
                 widest, np.dtype(dtype).name)
-        X = np.empty((self.num_data, len(used)), dtype=dtype)
+        self.X_bin = np.empty((self.num_data, cols), dtype=dtype)
+
+    def _binarize(self, data: np.ndarray) -> None:
+        self._alloc_X()
+        self._binarize_chunk(data, 0)
+
+    def _binarize_chunk(self, data: np.ndarray, row0: int) -> None:
+        """Bin ``data``'s rows into ``X_bin[row0:row0+len(data)]``."""
+        if self.bundle is not None:
+            self._binarize_bundled_chunk(data, row0)
+            return
+        used = self.real_feature_idx
+        n = len(data)
+        X = self.X_bin[row0:row0 + n]
+        dtype = X.dtype
         from .. import native as _native
         from .binning import BIN_NUMERICAL, MISSING_NAN
         fast = _native.lib() is not None and dtype == np.uint8
@@ -292,7 +333,7 @@ class BinnedDataset:
             if num_cols:
                 # fill a preallocated transpose column-by-column: one extra
                 # copy of the numerical submatrix, never two at once
-                dt = np.empty((len(num_cols), self.num_data), np.float64)
+                dt = np.empty((len(num_cols), n), np.float64)
                 for r, j in enumerate(num_cols):
                     dt[r] = data[:, j]
                 dt_row = {j: r for r, j in enumerate(num_cols)}
@@ -305,20 +346,21 @@ class BinnedDataset:
                     m.missing_type, m.num_bin, X[:, inner])
             else:
                 X[:, inner] = m.value_to_bin(data[:, int(j)]).astype(dtype)
-        self.X_bin = X
 
     def _binarize_bundled(self, data: np.ndarray) -> None:
+        self._alloc_X()
+        self._binarize_bundled_chunk(data, 0)
+
+    def _binarize_bundled_chunk(self, data: np.ndarray, row0: int) -> None:
         """Binarize into EFB physical columns (see io/bundling.py layout;
         reference: Dataset::PushOneRow -> FeatureGroup::PushData,
         dataset.h:333-359)."""
         from .bundling import encode_column
         bundle = self.bundle
         used = self.real_feature_idx
-        widest = int(max(bundle.phys_num_bin.max(initial=0),
-                         self.feature_max_bins().max(initial=0)))
-        dtype = (np.uint8 if widest <= 256
-                 else np.uint16 if widest <= 65536 else np.uint32)
-        X = np.zeros((self.num_data, bundle.num_phys), dtype=dtype)
+        n = len(data)
+        X = self.X_bin[row0:row0 + n]
+        dtype = X.dtype
         for gp, members in enumerate(bundle.groups):
             if len(members) == 1:
                 inner = members[0]
@@ -331,8 +373,7 @@ class BinnedDataset:
                          for m, i in zip(mappers, members)]
             X[:, gp] = encode_column(
                 bundle, members, feat_bins,
-                [m.default_bin for m in mappers], self.num_data, dtype)
-        self.X_bin = X
+                [m.default_bin for m in mappers], n, dtype)
 
     # ------------------------------------------------------------------
     def create_valid(self, data: np.ndarray) -> "BinnedDataset":

@@ -23,6 +23,91 @@ def _sniff_delimiter(line: str) -> str:
     return "\t"
 
 
+_CHUNK_BYTES = 64 * 1024 * 1024
+
+
+class _ParseError(Exception):
+    """Native parser rejected the file; fall back to np.loadtxt."""
+
+
+def _file_ncol(mm, pos: int, size: int, delim: str) -> int:
+    nl = mm.find(b"\n", pos)
+    first = mm[pos:(nl if nl >= 0 else size)].decode(
+        "utf-8", "replace").rstrip("\r")
+    return len(first.split() if delim == " " else first.split(delim))
+
+
+def _mmap_windows(path: str, skiprows: int, chunk_bytes: int = _CHUNK_BYTES):
+    """Yield ``(mm, lo, hi)`` newline-aligned windows over an mmap of the
+    file — the parser reads straight out of the page cache, no bytes
+    copies, no carry-over concatenation."""
+    import mmap
+
+    with open(path, "rb") as fh:
+        size = os.fstat(fh.fileno()).st_size
+        if size == 0:
+            return
+        mm = mmap.mmap(fh.fileno(), 0, access=mmap.ACCESS_READ)
+        try:
+            pos = 0
+            for _ in range(skiprows):
+                nl = mm.find(b"\n", pos)
+                pos = (nl + 1) if nl >= 0 else size
+            while pos < size:
+                hi = min(pos + chunk_bytes, size)
+                if hi < size:
+                    nl = mm.rfind(b"\n", pos, hi)
+                    if nl < pos:  # a single line longer than the window
+                        nl = mm.find(b"\n", hi)
+                        hi = size if nl < 0 else nl + 1
+                    else:
+                        hi = nl + 1
+                yield mm, pos, hi
+                pos = hi
+        finally:
+            mm.close()
+
+
+def _iter_dense_chunks(path: str, delim: str, skiprows: int,
+                       chunk_bytes: int = _CHUNK_BYTES):
+    """Stream-parse a dense numeric text file with the native chunk parser
+    (native/binning_native.cpp csv_parse — the reference's
+    TextReader/PipelineReader analog, utils/text_reader.h:1-341), yielding
+    row-major f64 arrays.  Raises ``_ParseError`` when the native library
+    is unavailable or the file needs np.loadtxt's leniency.
+    """
+    from .. import native as _native
+    if _native.lib() is None:
+        raise _ParseError("native library unavailable")
+    ncol = None
+    for mm, lo, hi in _mmap_windows(path, skiprows, chunk_bytes):
+        if ncol is None:
+            ncol = _file_ncol(mm, lo, len(mm), delim)
+        arr = _native.csv_parse(mm, delim, ncol, offset=lo, length=hi - lo)
+        if arr is None:
+            raise _ParseError("malformed row (inconsistent columns?)")
+        if len(arr):
+            yield arr
+
+
+def _read_dense(path: str, delim: str, skiprows: int) -> np.ndarray:
+    """Whole-file dense parse: native mmap parse with the lenient
+    np.loadtxt fallback."""
+    try:
+        # one window over the whole file: a single exactly-sized output
+        # array, no per-chunk vstack copy
+        size = max(os.path.getsize(path), 1)
+        parts = list(_iter_dense_chunks(path, delim, skiprows,
+                                        chunk_bytes=size))
+        if parts:
+            return parts[0] if len(parts) == 1 else np.vstack(parts)
+    except _ParseError as exc:
+        log.info("Native text parse unavailable (%s); using np.loadtxt",
+                 exc)
+    return np.loadtxt(path, delimiter=None if delim == " " else delim,
+                      skiprows=skiprows, ndmin=2, dtype=np.float64)
+
+
 def _resolve_column(spec: str, names: List[str], what: str) -> Optional[int]:
     """Column spec: "" -> None, "3" -> 3, "name:foo" -> index of foo
     (reference: dataset_loader.cpp column-by-name needs a header)."""
@@ -62,9 +147,25 @@ def load_text(path: str, config) -> Tuple[np.ndarray, Optional[np.ndarray],
     if getattr(config, "header", False):
         names = [t.strip() for t in first.rstrip("\n").split(delim)]
         skip = 1
-    data = np.loadtxt(path, delimiter=None if delim == " " else delim,
-                      skiprows=skip, ndmin=2, dtype=np.float64)
+    data = _read_dense(path, delim, skip)
     ncol = data.shape[1]
+    names, label_col, weight_col, group_col, keep = _column_plan(
+        names, ncol, config)
+
+    label = data[:, label_col]
+    weight = data[:, weight_col] if weight_col is not None else None
+    group_raw = data[:, group_col] if group_col is not None else None
+    X = data[:, keep]
+    feat_names = [names[i] for i in keep]
+
+    weight, group = _load_sidecars(path, weight, None)
+    return X, label, weight, group if group is not None else _group_from_col(
+        group_raw), feat_names
+
+
+def _column_plan(names: List[str], ncol: int, config):
+    """Resolve the label/weight/group/ignore column layout of a data file
+    -> (names, label_col, weight_col, group_col, keep_columns)."""
     if not names:
         names = [f"Column_{i}" for i in range(ncol)]
 
@@ -99,17 +200,8 @@ def load_text(path: str, config) -> Tuple[np.ndarray, Optional[np.ndarray],
             c = skip_label(_resolve_column(tok, names, "ignore"), tok)
             if c is not None:
                 drop.add(c)
-
-    label = data[:, label_col]
-    weight = data[:, weight_col] if weight_col is not None else None
-    group_raw = data[:, group_col] if group_col is not None else None
     keep = [i for i in range(ncol) if i not in drop]
-    X = data[:, keep]
-    feat_names = [names[i] for i in keep]
-
-    weight, group = _load_sidecars(path, weight, None)
-    return X, label, weight, group if group is not None else _group_from_col(
-        group_raw), feat_names
+    return names, label_col, weight_col, group_col, keep
 
 
 def _group_from_col(group_raw):
@@ -168,3 +260,173 @@ def _load_sidecars(path: str, weight, group):
                 log.info("Loading query boundaries from %s", qpath)
                 break
     return weight, group
+
+
+def load_text_two_round(path: str, config, categorical_features=(),
+                        reference=None):
+    """Two-pass streaming load: construct a ``BinnedDataset`` from a text
+    file WITHOUT materializing the full float64 matrix (the reference's
+    ``two_round`` path: sample on the first read, push binned rows on the
+    second — dataset_loader.cpp:807-827, config.h two_round).
+
+    Pass 1 streams the file counting rows, reservoir-sampling
+    ``bin_construct_sample_cnt`` rows for bin finding, and collecting the
+    label/weight/group columns.  Pass 2 streams again, binning each chunk
+    straight into the preallocated ``X_bin``.  Peak memory is one parsed
+    chunk + the binned matrix (1-2 bytes/cell) instead of 8 bytes/cell.
+
+    Returns ``(handle, label, weight, group, feature_names)`` where
+    ``handle`` is a constructed BinnedDataset.  With ``reference`` given
+    (a constructed BinnedDataset), its bin mappers are reused and the
+    sampling pass only counts rows (validation alignment).
+    """
+    from .dataset import BinnedDataset, Metadata
+
+    if not os.path.exists(path):
+        log.fatal(f"Data file {path} does not exist")
+    with open(path) as fh:
+        first = fh.readline()
+    if ":" in first and not getattr(config, "header", False):
+        log.warning("two_round is not supported for LibSVM input; "
+                    "loading in one round")
+        X, label, weight, group, names = _load_libsvm(path, config)
+        handle = BinnedDataset.from_matrix(
+            X, config, categorical_features=categorical_features,
+            feature_names=names, reference=reference)
+        return handle, label, weight, group, names
+    delim = _sniff_delimiter(first.rstrip("\n"))
+    names: List[str] = []
+    skip = 0
+    if getattr(config, "header", False):
+        names = [t.strip() for t in first.rstrip("\n").split(delim)]
+        skip = 1
+
+    # ---- pass 1: count rows, parse ONLY the side columns, and
+    # reservoir-sample line BYTE RANGES (the sampled lines are fully
+    # parsed once at the end — ~200k lines instead of the whole file)
+    from .. import native as _native
+    sample_cnt = int(getattr(config, "bin_construct_sample_cnt", 200000))
+    rng = np.random.default_rng(getattr(config, "data_random_seed", 1))
+    plan = None
+    n_rows = 0
+    res_off = res_len = None  # sampled line byte ranges
+    labels, weights, groups = [], [], []
+    side_vals = {}
+    for mm, lo, hi in _mmap_windows(path, skip):
+        if plan is None:
+            if _native.lib() is None:
+                log.fatal("two_round loading needs the native parser "
+                          "(g++ unavailable?); set two_round=false")
+            ncol = _file_ncol(mm, lo, len(mm), delim)
+            plan = _column_plan(names, ncol, config)
+            names, label_col, weight_col, group_col, keep = plan
+            side_cols = sorted({label_col}
+                               | ({weight_col} if weight_col is not None
+                                  else set())
+                               | ({group_col} if group_col is not None
+                                  else set()))
+            side_pos = {c: i for i, c in enumerate(side_cols)}
+        sv = _native.csv_parse_cols(mm, delim, side_cols, offset=lo,
+                                    length=hi - lo)
+        if sv is None:
+            raise _ParseError("malformed row (inconsistent columns?)")
+        labels.append(sv[:, side_pos[label_col]].copy())
+        if weight_col is not None:
+            weights.append(sv[:, side_pos[weight_col]].copy())
+        if group_col is not None:
+            groups.append(sv[:, side_pos[group_col]].copy())
+        if reference is None:
+            offs = _native.csv_line_offsets(mm, offset=lo, length=hi - lo)
+            offs = offs[:len(sv)]  # a dropped trailing blank line
+            lens = np.diff(np.append(offs, hi - lo)).astype(np.int64)
+            offs = offs + lo
+            if res_off is None:
+                res_off = np.empty(sample_cnt, np.int64)
+                res_len = np.empty(sample_cnt, np.int64)
+            filled = min(n_rows, sample_cnt)
+            take = min(max(sample_cnt - filled, 0), len(offs))
+            if take:
+                res_off[filled:filled + take] = offs[:take]
+                res_len[filled:filled + take] = lens[:take]
+            if take < len(offs):
+                # Algorithm R, vectorized per chunk: row with global index
+                # g replaces a random slot with probability sample_cnt/(g+1)
+                gi = np.arange(n_rows + take, n_rows + len(offs))
+                slots = rng.integers(0, gi + 1)
+                hit = slots < sample_cnt
+                res_off[slots[hit]] = offs[take:][hit]
+                res_len[slots[hit]] = lens[take:][hit]
+        n_rows += len(sv)
+    if n_rows == 0:
+        log.fatal(f"Data file {path} is empty")
+    label = np.concatenate(labels)
+    weight = np.concatenate(weights) if weights else None
+    group_raw = np.concatenate(groups) if groups else None
+    feat_names = [names[i] for i in keep]
+    # name-based categorical specs resolve against the KEPT feature names
+    # (same convention as basic.Dataset._resolve_categorical)
+    cats = []
+    for c in categorical_features or ():
+        if isinstance(c, str):
+            if c in feat_names:
+                cats.append(feat_names.index(c))
+            else:
+                log.warning("categorical_feature %r not found in feature "
+                            "names; ignored", c)
+        else:
+            cats.append(int(c))
+    categorical_features = sorted(set(cats))
+
+    # ---- mappers from the sample --------------------------------------
+    if reference is None:
+        m = min(n_rows, sample_cnt)
+        with open(path, "rb") as fh:
+            import mmap as _mmap
+            mm = _mmap.mmap(fh.fileno(), 0, access=_mmap.ACCESS_READ)
+            try:
+                # per-line newline: the file's FINAL line may lack one, and
+                # it can land in any reservoir slot
+                pieces = []
+                for o, l in zip(res_off[:m], res_len[:m]):
+                    b = bytes(mm[int(o):int(o + l)])
+                    pieces.append(b if b.endswith(b"\n") else b + b"\n")
+                joined = b"".join(pieces)
+            finally:
+                mm.close()
+        sample_full = _native.csv_parse(joined, delim, len(names))
+        if sample_full is None:
+            raise _ParseError("malformed sampled row")
+        sample = sample_full[:, keep]
+        handle = BinnedDataset.from_sample(
+            sample, n_rows, config,
+            categorical_features=categorical_features,
+            feature_names=feat_names)
+    else:
+        log.check(len(keep) == reference.num_total_features,
+                  "validation data has a different number of features")
+        handle = BinnedDataset()
+        handle.num_data = n_rows
+        handle.num_total_features = len(keep)
+        handle.metadata = Metadata(n_rows)
+        handle.bin_mappers = reference.bin_mappers
+        handle.used_feature_map = reference.used_feature_map
+        handle.real_feature_idx = reference.real_feature_idx
+        handle.bin_offsets = reference.bin_offsets
+        handle.feature_names = reference.feature_names
+        handle.max_bin = reference.max_bin
+        handle.bundle = reference.bundle
+
+    # ---- pass 2: stream rows into the binned matrix -------------------
+    from ..utils.timetag import timetag
+    handle._alloc_X()
+    with timetag("binarize"):
+        row0 = 0
+        for chunk in _iter_dense_chunks(path, delim, skip):
+            handle._binarize_chunk(chunk[:, keep], row0)
+            row0 += len(chunk)
+    log.check(row0 == n_rows, "data file changed between two_round passes")
+
+    weight, group = _load_sidecars(path, weight, None)
+    if group is None:
+        group = _group_from_col(group_raw)
+    return handle, label, weight, group, feat_names

@@ -79,6 +79,16 @@ def lib() -> Optional[ctypes.CDLL]:
     L.binarize_numerical_u8.restype = None
     L.binarize_numerical_u8.argtypes = [ctypes.c_void_p, i64, i64, pd, i64,
                                         i32, i32, ctypes.c_void_p, i64]
+    L.csv_parse.restype = i64
+    L.csv_parse.argtypes = [ctypes.c_void_p, i64, ctypes.c_char, i64, pd,
+                            i64]
+    L.csv_count_lines.restype = i64
+    L.csv_count_lines.argtypes = [ctypes.c_void_p, i64]
+    L.csv_line_offsets.restype = i64
+    L.csv_line_offsets.argtypes = [ctypes.c_void_p, i64, pi64, i64]
+    L.csv_parse_cols.restype = i64
+    L.csv_parse_cols.argtypes = [ctypes.c_void_p, i64, ctypes.c_char, pi64,
+                                 i64, pd, i64]
     _lib = L
     return _lib
 
@@ -137,3 +147,58 @@ def binarize_numerical_u8(col: np.ndarray, bounds: np.ndarray, n_bounds: int,
                             np.ascontiguousarray(bounds, np.float64),
                             int(n_bounds), int(missing_type), int(num_bin),
                             out.ctypes.data, out.strides[0])
+
+
+def csv_parse(buf, delim: str, ncol: int, offset: int = 0,
+              length: int = None):
+    """Parse ``buf[offset:offset+length]`` (bytes or any buffer, e.g. a
+    read-only mmap — zero-copy) of delimiter-separated numbers into a
+    row-major f64 [rows, ncol] array.  Returns None on malformed input
+    (caller falls back to np.loadtxt for the slow-but-lenient path)."""
+    L = lib()
+    assert L is not None
+    if length is None:
+        length = len(buf) - offset
+    view = np.frombuffer(buf, np.uint8, count=length, offset=offset)
+    addr = view.ctypes.data
+    max_rows = L.csv_count_lines(addr, length)
+    out = np.empty((max_rows, ncol), np.float64)
+    n = L.csv_parse(addr, length, delim.encode()[:1], int(ncol), out,
+                    max_rows)
+    if n < 0:
+        return None
+    return out[:n]
+
+
+def csv_line_offsets(buf, offset: int = 0, length: int = None):
+    """Line start offsets (relative to ``offset``) as int64 [rows]."""
+    L = lib()
+    assert L is not None
+    if length is None:
+        length = len(buf) - offset
+    view = np.frombuffer(buf, np.uint8, count=length, offset=offset)
+    addr = view.ctypes.data
+    n = L.csv_count_lines(addr, length)
+    out = np.empty(max(n, 1), np.int64)
+    m = L.csv_line_offsets(addr, length, out, max(n, 1))
+    return out[:m]
+
+
+def csv_parse_cols(buf, delim: str, cols, offset: int = 0,
+                   length: int = None):
+    """Parse only the (ascending) ``cols`` of each line -> f64 [rows, k];
+    None on malformed input."""
+    L = lib()
+    assert L is not None
+    if length is None:
+        length = len(buf) - offset
+    view = np.frombuffer(buf, np.uint8, count=length, offset=offset)
+    addr = view.ctypes.data
+    cols = np.ascontiguousarray(sorted(int(c) for c in cols), np.int64)
+    max_rows = L.csv_count_lines(addr, length)
+    out = np.empty((max_rows, len(cols)), np.float64)
+    n = L.csv_parse_cols(addr, length, delim.encode()[:1], cols, len(cols),
+                         out, max_rows)
+    if n < 0:
+        return None
+    return out[:n]
