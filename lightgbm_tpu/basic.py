@@ -17,11 +17,15 @@ from .utils import log
 from .utils.log import LightGBMError
 
 
+def _is_scipy_sparse(data) -> bool:
+    return hasattr(data, "toarray") and hasattr(data, "tocsr")
+
+
 def _to_matrix(data) -> np.ndarray:
     """Accept numpy arrays, lists, pandas DataFrames, scipy sparse."""
     if hasattr(data, "values") and hasattr(data, "columns"):  # pandas
         return np.ascontiguousarray(data.values, dtype=np.float64)
-    if hasattr(data, "toarray"):  # scipy sparse
+    if _is_scipy_sparse(data):
         return np.ascontiguousarray(data.toarray(), dtype=np.float64)
     arr = np.asarray(data)
     if arr.ndim != 2:
@@ -129,6 +133,9 @@ class Dataset:
                 # keep "auto" when no category-dtype columns exist so the
                 # params['categorical_feature'] fallback still applies
                 self.categorical_feature = auto_cat
+        elif _is_scipy_sparse(self.data):
+            mat = self.data  # stays sparse; from_csr never densifies
+            names = None
         else:
             mat = _to_matrix(self.data)
             names = _feature_names_of(self.data)
@@ -140,7 +147,9 @@ class Dataset:
         ref_handle = None
         if self.reference is not None:
             ref_handle = self.reference.construct()._handle
-        self._handle = BinnedDataset.from_matrix(
+        builder = (BinnedDataset.from_csr if _is_scipy_sparse(mat)
+                   else BinnedDataset.from_matrix)
+        self._handle = builder(
             mat, config,
             categorical_features=self._resolve_categorical(names),
             feature_names=names, reference=ref_handle)
@@ -207,11 +216,15 @@ class Dataset:
     def num_data(self) -> int:
         if self._handle is not None:
             return self._handle.num_data
+        if _is_scipy_sparse(self.data):
+            return self.data.shape[0]
         return _to_matrix(self.data).shape[0]
 
     def num_feature(self) -> int:
         if self._handle is not None:
             return self._handle.num_total_features
+        if _is_scipy_sparse(self.data):
+            return self.data.shape[1]
         return _to_matrix(self.data).shape[1]
 
     def get_feature_name(self) -> List[str]:
@@ -456,6 +469,23 @@ class Booster:
             mat, _, _, _ = _data_from_pandas(
                 data, categorical_feature=None,
                 pandas_categorical=self.pandas_categorical)
+        elif _is_scipy_sparse(data):
+            # block-wise densify, ~128MB of dense cells per block: bounded
+            # memory on wide sparse inputs (the reference predicts sparse
+            # rows natively, predictor.hpp:140-180; row blocks are the
+            # dense-core analog)
+            block = max(256, (1 << 24) // max(data.shape[1], 1))
+            if data.shape[0] > block:
+                csr = data.tocsr()
+                blocks = [
+                    self.predict(csr[i:i + block],
+                                 num_iteration=num_iteration,
+                                 raw_score=raw_score, pred_leaf=pred_leaf,
+                                 pred_contrib=pred_contrib,
+                                 start_iteration=start_iteration, **kwargs)
+                    for i in range(0, csr.shape[0], block)]
+                return np.concatenate(blocks, axis=0)
+            mat = _to_matrix(data)
         else:
             mat = _to_matrix(data)
         if num_iteration is None:

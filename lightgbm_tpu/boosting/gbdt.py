@@ -398,6 +398,7 @@ class GBDT(PredictorBase):
             self._grow = make_engine_grower(
                 tl, self.meta, self.split_cfg, self.B, mesh,
                 wave_kw=wave_kw if use_wave else None,
+                top_k=int(getattr(config, "top_k", 20)),
                 B_phys=self.B_phys, bundled=self._bundled)
             # pre-jitted, but callable from inside grow_apply's jit too
             self._grow_raw = self._grow
@@ -452,16 +453,25 @@ class GBDT(PredictorBase):
                         xbt[mixed_info.wide_idx])))
         else:
             from ..core.grower import build_grow_fn
+            from ..core.histogram import hist_onehot, hist_scatter
+
+            # very wide physical layouts (wide-sparse EFB): the one-hot
+            # contraction is O(N*F*B) and intractable past ~32k total
+            # physical bins; scatter-add is O(N*F)
+            wide = (self.B_phys * max(train_ds.num_phys_features, 1)
+                    > 32768)
+            hist_fn = hist_scatter if wide else hist_onehot
 
             def build_xla():
                 return build_grow_fn(self.meta, self.split_cfg, self.B,
+                                     hist_fn=hist_fn,
                                      B_phys=self.B_phys,
                                      bundled=self._bundled,
                                      cegb=cegb_cfg, forced=forced,
                                      bynode=bynode)
             if cegb_cfg is None and forced is None and bynode is None:
                 key = ("xla", id(self.meta), self.split_cfg, self.B,
-                       self.B_phys, self._bundled)
+                       self.B_phys, self._bundled, wide)
                 self._grow_raw = _cached_jit(key, build_xla)
                 self._raw_cached = True
             else:

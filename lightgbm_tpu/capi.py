@@ -286,7 +286,14 @@ def LGBM_DatasetCreateFromCSR(indptr, indptr_type: int, indices, data,
                               data_type: int, nindptr: int, nelem: int,
                               num_col: int, parameters: str, reference,
                               out_handle) -> int:
-    mat = _csr_to_dense(indptr, indices, data, int(num_col))
+    # stays sparse end to end: BinnedDataset.from_csr bins column-by-column
+    # without materializing the dense raw matrix (the reference's SparseBin
+    # analog, src/io/sparse_bin.hpp:72)
+    import scipy.sparse as sp
+    mat = sp.csr_matrix(
+        (np.asarray(data, np.float64), np.asarray(indices, np.int32),
+         np.asarray(indptr, np.int64)),
+        shape=(len(np.asarray(indptr)) - 1, int(num_col)))
     ref = _get(reference, _CDataset).require() if reference else None
     ds = Dataset(mat, params=_params_dict(parameters), reference=ref,
                  free_raw_data=False)
@@ -315,7 +322,11 @@ def LGBM_DatasetCreateFromCSC(col_ptr, col_ptr_type: int, indices, data,
                               data_type: int, ncol_ptr: int, nelem: int,
                               num_row: int, parameters: str, reference,
                               out_handle) -> int:
-    mat = _csc_to_dense(col_ptr, indices, data, int(num_row))
+    import scipy.sparse as sp
+    mat = sp.csc_matrix(
+        (np.asarray(data, np.float64), np.asarray(indices, np.int32),
+         np.asarray(col_ptr, np.int64)),
+        shape=(int(num_row), len(np.asarray(col_ptr)) - 1))
     ref = _get(reference, _CDataset).require() if reference else None
     ds = Dataset(mat, params=_params_dict(parameters), reference=ref,
                  free_raw_data=False)

@@ -207,11 +207,17 @@ def build_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
     def hist_leaf(bins, g, h, mask, tg, th, tc):
         """Histogram the PHYSICAL columns, globally reduce, then (when
         bundled) expand to per-feature space and reconstruct each member's
-        elided default-bin mass from the leaf totals."""
+        elided default-bin mass from the leaf totals.  A lossy reduce
+        (voting-parallel) may return ``(hist, alive)`` — gated-off
+        columns' members then skip the default-bin fix and scan all-zero
+        histograms (no gain) instead of fabricated mass."""
         hp = reduce_fn(hist_fn(bins, g, h, mask, B=B_phys))
+        alive = None
+        if isinstance(hp, tuple):
+            hp, alive = hp
         if bundled:
             hp = expand_bundled(hp, meta, B)
-            hp = fix_default_bins(hp, tg, th, tc, meta)
+            hp = fix_default_bins(hp, tg, th, tc, meta, alive=alive)
         return hp
     if best_split_fn is None:
         def best_split_fn(hist_leaf, sg, sh, sc, min_c, max_c, feature_mask):

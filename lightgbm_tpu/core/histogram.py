@@ -82,6 +82,47 @@ def _hist_block(bins, ghc, B: int):
 
 
 @functools.partial(jax.jit, static_argnames=("B",))
+def hist_scatter(bins, g, h, mask, B: int):
+    """Scatter-add histogram for VERY wide physical layouts (wide-sparse
+    EFB datasets): cost O(N*F) instead of the one-hot path's O(N*F*B).
+
+    The reference's answer to wide sparse data is SparseBin's
+    nonzero-stream accumulate (src/io/sparse_bin.hpp:72); a dense one-hot
+    contraction over 50k+ features x thousands of bundle bins would
+    materialize terabytes.  Scatter-add is not MXU-friendly, but at these
+    shapes it is the only formulation with a feasible op count — and
+    wide-sparse is a CPU/host-dominant regime in the reference too.
+
+    Same contract as ``hist_onehot``: bins [N, F] -> f32 [F, B, 3].
+    """
+    N, F = bins.shape
+    ghc = jnp.stack([g, h, jnp.ones_like(g)], axis=-1) * mask[:, None]
+    offsets = (jnp.arange(F, dtype=jnp.int32) * B)[None, :]
+    # chunk rows so the broadcasted [c, F, 3] update tensor stays ~100MB
+    chunk = max(256, min(N, (8 * 1024 * 1024) // max(F, 1)))
+    out = jnp.zeros((F * B, 3), jnp.float32)
+    if N <= chunk:
+        flat = bins.astype(jnp.int32) + offsets
+        out = out.at[flat].add(ghc[:, None, :])
+        return out.reshape(F, B, 3)
+    n_chunks = -(-N // chunk)
+    pad = n_chunks * chunk - N
+    if pad:
+        bins = jnp.pad(bins, ((0, pad), (0, 0)))
+        ghc = jnp.pad(ghc, ((0, pad), (0, 0)))
+    bins_c = bins.reshape(n_chunks, chunk, F)
+    ghc_c = ghc.reshape(n_chunks, chunk, 3)
+
+    def body(acc, xs):
+        b, z = xs
+        flat = b.astype(jnp.int32) + offsets
+        return acc.at[flat].add(z[:, None, :]), None
+
+    out, _ = jax.lax.scan(body, out, (bins_c, ghc_c))
+    return out.reshape(F, B, 3)
+
+
+@functools.partial(jax.jit, static_argnames=("B",))
 def hist_wave_xla(bins_rm, gv, hv, cv, leaf_id, slot_leaf, B: int):
     """XLA analog of ``ops.pallas_hist.hist_pallas_wave`` for WIDE
     (>256-bin) features — the side-pass of the mixed-width wave path.
@@ -159,14 +200,21 @@ def expand_bundled(hist_phys, meta, B_out: int):
     return out * valid[..., None]
 
 
-def fix_default_bins(hist, tg, th, tc, meta):
+def fix_default_bins(hist, tg, th, tc, meta, alive=None):
     """Reconstruct each bundled member's elided default-bin mass from the
     leaf totals (reference: Dataset::FixHistogram, src/io/dataset.cpp:
     1044-1063): hist[f, default_bin_f] += total - sum_b hist[f, b].
 
-    hist: f32 [F, B, 3]; tg/th/tc: scalar leaf totals."""
+    hist: f32 [F, B, 3]; tg/th/tc: scalar leaf totals.  ``alive`` (bool
+    [F_phys], optional) marks physical columns that survived a lossy
+    reduce (voting-parallel's top-k gate): members of a gated-OFF column
+    must stay all-zero — fixing them would fabricate the whole leaf mass
+    at their default bin and produce phantom splits."""
     sums = hist.sum(axis=1)                               # [F, 3]
     totals = jnp.stack([tg, th, tc]).astype(hist.dtype)   # [3]
-    resid = jnp.where(meta.needs_fix[:, None], totals[None, :] - sums, 0.0)
+    fix = meta.needs_fix
+    if alive is not None:
+        fix = fix & alive[meta.feat2phys]
+    resid = jnp.where(fix[:, None], totals[None, :] - sums, 0.0)
     F = hist.shape[0]
     return hist.at[jnp.arange(F), meta.default_bins].add(resid)

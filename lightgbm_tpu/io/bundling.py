@@ -58,65 +58,83 @@ class BundleInfo:
         return self.num_phys == len(self.feat2phys) and not self.needs_fix.any()
 
 
-def find_groups(nonzero_masks: List[np.ndarray], nbins: List[int],
+def find_groups(nonzero_idx: List[np.ndarray], nbins: List[int],
                 sparse_rates: List[float], total_sample: int,
                 max_conflict_rate: float, sparse_threshold: float = 0.8,
-                max_bins_per_group: int = 256) -> List[List[int]]:
+                max_bins_per_group: int = 256,
+                max_search_group: int = 128) -> List[List[int]]:
     """Greedy conflict-budgeted grouping over a row sample.
 
-    ``nonzero_masks[i]``: bool [S] — sample rows where feature i is
-    non-default.  Features with sparse_rate < ``sparse_threshold`` are kept
-    as singletons (bundling dense features buys nothing and eats the
+    ``nonzero_idx[i]``: int [nnz_i] — sample-row indices where feature i
+    is non-default (index arrays, NOT bool masks: a 50k-feature sparse
+    dataset would need 10GB of [S] masks; indices are nnz-bound).
+    Features with sparse_rate < ``sparse_threshold`` are kept as
+    singletons (bundling dense features buys nothing and eats the
     conflict budget; the reference reaches the same outcome through its
     budget arithmetic, dataset.cpp:110-140).
 
-    Mirrors FindGroups (reference: dataset.cpp:91-167): features visited in
-    descending non-default count, first group with enough remaining budget
-    and bin capacity wins.
+    Mirrors FindGroups (reference: dataset.cpp:91-167): features visited
+    in descending non-default count, first group with enough remaining
+    budget and bin capacity wins; like the reference's random-subset
+    probe cap, at most ``max_search_group`` groups are tried per feature.
     """
-    F = len(nonzero_masks)
+    F = len(nonzero_idx)
     budget_total = int(max_conflict_rate * total_sample)
     candidates = [i for i in range(F) if sparse_rates[i] >= sparse_threshold]
     cand_set = set(candidates)
     dense = [i for i in range(F) if i not in cand_set]
 
-    order = sorted(candidates,
-                   key=lambda i: -int(nonzero_masks[i].sum()))
-    group_masks: List[np.ndarray] = []
+    order = sorted(candidates, key=lambda i: -len(nonzero_idx[i]))
+    group_masks: List[np.ndarray] = []  # bool [S] per GROUP (not feature)
     group_bins: List[int] = []
     group_conflicts: List[int] = []
     groups: List[List[int]] = []
+    # cap total mask memory at ~512MB: past it, unplaceable features fall
+    # back to mask-less singleton groups (they could never accept members
+    # anyway once nothing bundles) instead of re-creating the old
+    # bool-per-feature blowup in the all-conflicting worst case
+    mask_cap = max(8, (512 << 20) // max(total_sample, 1))
+    singles: List[List[int]] = []
     for i in order:
-        mi = nonzero_masks[i]
+        ii = nonzero_idx[i]
         placed = False
-        for gi in range(len(groups)):
+        lo = max(0, len(groups) - max_search_group)
+        for gi in range(lo, len(groups)):
             # bin 0 is the shared all-default bin
             if group_bins[gi] + nbins[i] > max_bins_per_group:
                 continue
-            conflicts = int((group_masks[gi] & mi).sum())
+            conflicts = int(group_masks[gi][ii].sum())
             if group_conflicts[gi] + conflicts <= budget_total:
                 groups[gi].append(i)
-                group_masks[gi] |= mi
+                group_masks[gi][ii] = True
                 group_bins[gi] += nbins[i]
                 group_conflicts[gi] += conflicts
                 placed = True
                 break
         if not placed:
+            if len(group_masks) >= mask_cap:
+                singles.append([i])
+                continue
+            m = np.zeros(total_sample, bool)
+            m[ii] = True
             groups.append([i])
-            group_masks.append(mi.copy())
+            group_masks.append(m)
             group_bins.append(1 + nbins[i])
             group_conflicts.append(0)
-    return groups + [[i] for i in dense]
+    return groups + singles + [[i] for i in dense]
 
 
-def build_bundles(mappers, used_features: np.ndarray, sample: np.ndarray,
+def build_bundles(mappers, used_features: np.ndarray, sample,
                   total_rows: int, max_conflict_rate: float,
                   max_bins_per_group: int = 256) -> BundleInfo:
     """Construct the bundle mapping from the bin-finding row sample.
 
     ``mappers``: all BinMappers (original feature indexing);
     ``used_features``: original indices of non-trivial features (inner
-    order); ``sample``: [S, P] raw values used for bin finding.
+    order); ``sample``: [S, P] raw values used for bin finding — dense
+    ndarray or a scipy.sparse matrix (the CSR ingestion path; only stored
+    entries can be non-default, so masks come straight from the CSC
+    columns without densifying).
     """
     F = len(used_features)
     nbins = [mappers[int(j)].num_bin for j in used_features]
@@ -124,15 +142,22 @@ def build_bundles(mappers, used_features: np.ndarray, sample: np.ndarray,
         return BundleInfo.identity(np.asarray(nbins))
 
     S = sample.shape[0]
-    masks, rates = [], []
+    sample_csc = sample.tocsc() if hasattr(sample, "tocsc") else None
+    idxs, rates = [], []
     for inner, j in enumerate(used_features):
         m = mappers[int(j)]
-        fb = m.value_to_bin(sample[:, int(j)])
-        nz = np.asarray(fb) != m.default_bin
-        masks.append(nz)
-        rates.append(1.0 - float(nz.sum()) / max(S, 1))
+        if sample_csc is not None:
+            lo, hi = sample_csc.indptr[int(j)], sample_csc.indptr[int(j) + 1]
+            rows = sample_csc.indices[lo:hi]
+            fb = np.asarray(m.value_to_bin(sample_csc.data[lo:hi]))
+            nz_idx = np.asarray(rows[fb != m.default_bin])
+        else:
+            fb = m.value_to_bin(sample[:, int(j)])
+            nz_idx = np.flatnonzero(np.asarray(fb) != m.default_bin)
+        idxs.append(nz_idx)
+        rates.append(1.0 - float(len(nz_idx)) / max(S, 1))
 
-    groups = find_groups(masks, nbins, rates, S, max_conflict_rate,
+    groups = find_groups(idxs, nbins, rates, S, max_conflict_rate,
                          max_bins_per_group=max_bins_per_group)
     if all(len(g) <= 1 for g in groups):
         return BundleInfo.identity(np.asarray(nbins))

@@ -225,11 +225,25 @@ class BinnedDataset:
         ds.max_bin = config.max_bin
         ds.feature_names = (list(feature_names) if feature_names
                             else [f"Column_{i}" for i in range(p)])
-        # multi-host: pool every host's sample so all processes derive
-        # identical mappers; sample-vs-data ratios below must then use the
-        # GLOBAL row count (no-op single-host; parallel/distributed.py)
-        from ..parallel.distributed import global_bin_sample
-        sample, n_global = global_bin_sample(sample, ds.num_data)
+        sample_csc = sample.tocsc() if hasattr(sample, "tocsc") else None
+        if sample_csc is None:
+            # multi-host: pool every host's sample so all processes derive
+            # identical mappers; sample-vs-data ratios below must then use
+            # the GLOBAL row count (no-op single-host;
+            # parallel/distributed.py)
+            from ..parallel.distributed import global_bin_sample
+            sample, n_global = global_bin_sample(sample, ds.num_data)
+        else:
+            # sparse samples are not pooled cross-host yet — divergent
+            # per-process mappers would silently corrupt distributed
+            # training, so refuse loudly instead
+            import jax
+            if jax.process_count() > 1:
+                log.fatal("multi-host bin finding from sparse input is "
+                          "not supported; load from files or dense "
+                          "matrices, or construct on one host and share "
+                          "the dataset binary")
+            n_global = ds.num_data
 
         from ..utils.timetag import timetag
         cat_set = set(int(c) for c in categorical_features)
@@ -237,7 +251,7 @@ class BinnedDataset:
         forced = _load_forced_bins(config.forcedbins_filename, p, config.max_bin)
         # min-data filter threshold scaled to the bin-finding sample
         # (reference: dataset_loader.cpp:599 filter_cnt)
-        filter_cnt = int(config.min_data_in_leaf * len(sample) / n_global)
+        filter_cnt = int(config.min_data_in_leaf * sample.shape[0] / n_global)
         mbf = [int(v) for v in (config.max_bin_by_feature or [])]
         if mbf:
             # reference: dataset_loader.cpp:438-441
@@ -248,13 +262,19 @@ class BinnedDataset:
         bin_finding = timetag("bin finding")
         bin_finding.__enter__()
         for j in range(p):
-            col = sample[:, j]
+            if sample_csc is not None:
+                # only stored entries can be non-zero; implicit zeros are
+                # exactly the dropped |v| <= kZeroThreshold values below
+                lo, hi = sample_csc.indptr[j], sample_csc.indptr[j + 1]
+                col = np.asarray(sample_csc.data[lo:hi], np.float64)
+            else:
+                col = sample[:, j]
             # drop "zero" values (|v| <= kZeroThreshold); NaN compares False so
             # NaNs are kept for the missing-type decision
             non_zero = col[~((col > -1e-35) & (col <= 1e-35))]
             mapper = BinMapper()
             bt = BIN_CATEGORICAL if j in cat_set else BIN_NUMERICAL
-            mapper.find_bin(non_zero, len(sample),
+            mapper.find_bin(non_zero, sample.shape[0],
                             mbf[j] if mbf else config.max_bin,
                             config.min_data_in_bin, filter_cnt,
                             bt, config.use_missing, config.zero_as_missing,
@@ -266,9 +286,14 @@ class BinnedDataset:
                 and config.max_bin <= 255
                 and getattr(config, "tree_learner", "serial") == "serial"):
             from .bundling import build_bundles
+            # wide-sparse datasets get uint16-wide bundle columns so EFB
+            # can pack hundreds of features per column (the histogram
+            # switches to the scatter path past 32k physical bins)
+            wide = len(ds.real_feature_idx) > 2048
             bundle = build_bundles(ds.bin_mappers, ds.real_feature_idx,
                                    sample, n_global,
-                                   config.max_conflict_rate)
+                                   config.max_conflict_rate,
+                                   max_bins_per_group=4096 if wide else 256)
             if not bundle.is_trivial:
                 ds.bundle = bundle
         return ds
@@ -283,6 +308,100 @@ class BinnedDataset:
         self.bin_offsets = np.concatenate([[0], np.cumsum(nbins)]).astype(np.int32)
         if not used:
             log.warning("There are no meaningful features, as all feature values are constant.")
+
+    @classmethod
+    def from_csr(cls, X, config: Config,
+                 categorical_features: Sequence[int] = (),
+                 feature_names: Optional[List[str]] = None,
+                 reference: Optional["BinnedDataset"] = None) -> "BinnedDataset":
+        """Construct from a scipy.sparse matrix WITHOUT densifying the raw
+        values — the memory-bounded replacement for the reference's
+        ``SparseBin`` streams (src/io/sparse_bin.hpp:72,
+        ordered_sparse_bin.hpp:1; trade-off at bin.h:224-277).
+
+        Bin finding reads stored entries per CSC column; EFB packs the
+        mutually-exclusive (within ``max_conflict_rate``) sparse features
+        into shared physical columns; binarization scatters only stored
+        non-default bins.  Peak memory is the CSC copy + the binned
+        matrix — never rows x features x 8 bytes.  Genuinely conflicting
+        wide data that EFB cannot pack still materializes one physical
+        column per feature; raise ``max_conflict_rate`` (the reference's
+        own EFB knob) to trade exactness for packing.
+        """
+        import scipy.sparse as sp
+
+        X = X.tocsr() if not sp.issparse(X) or X.format != "csr" else X
+        n, p = X.shape
+        if n == 0:
+            log.fatal("Cannot construct a Dataset from an empty matrix (0 rows)")
+
+        if reference is not None:
+            ds = cls()
+            ds.num_data = n
+            ds.num_total_features = p
+            ds.metadata = Metadata(n)
+            log.check(p == reference.num_total_features,
+                      "validation data has a different number of features")
+            ds.bin_mappers = reference.bin_mappers
+            ds.used_feature_map = reference.used_feature_map
+            ds.real_feature_idx = reference.real_feature_idx
+            ds.bin_offsets = reference.bin_offsets
+            ds.feature_names = reference.feature_names
+            ds.max_bin = reference.max_bin
+            ds.bundle = reference.bundle
+            ds._binarize_csc(X.tocsc())
+            return ds
+
+        sample_cnt = min(config.bin_construct_sample_cnt, n)
+        rng = Random(config.data_random_seed)
+        sample_indices = (np.arange(n, dtype=np.int64) if sample_cnt >= n
+                          else rng.sample(n, sample_cnt).astype(np.int64))
+        ds = cls.from_sample(X[sample_indices], n, config,
+                             categorical_features=categorical_features,
+                             feature_names=feature_names)
+        from ..utils.timetag import timetag
+        with timetag("binarize"):
+            ds._binarize_csc(X.tocsc())
+        return ds
+
+    def _binarize_csc(self, X_csc) -> None:
+        """Scatter stored non-default bins into the physical matrix.
+
+        Unbundled columns init to the feature's default bin (the bin of
+        value 0.0 — implicit entries); bundle columns init to physical
+        bin 0 (= every member at default, io/bundling.py layout)."""
+        from .binning import BIN_CATEGORICAL
+
+        used = self.real_feature_idx
+        groups = (self.bundle.groups if self.bundle is not None
+                  else [[i] for i in range(len(used))])
+        self._alloc_X()  # single source of the widest/dtype ladder
+        X = self.X_bin
+        X.fill(0)  # implicit entries: bin 0 until default-bin init below
+        dtype = X.dtype
+        indptr, indices, data = X_csc.indptr, X_csc.indices, X_csc.data
+        for gp, members in enumerate(groups):
+            if len(members) == 1:
+                inner = members[0]
+                j = int(used[inner])
+                m = self.bin_mappers[j]
+                lo, hi = indptr[j], indptr[j + 1]
+                fb = np.asarray(m.value_to_bin(
+                    np.asarray(data[lo:hi], np.float64)))
+                if m.default_bin:
+                    X[:, gp] = m.default_bin
+                X[indices[lo:hi], gp] = fb.astype(dtype)
+                continue
+            for inner in members:
+                j = int(used[inner])
+                m = self.bin_mappers[j]
+                lo, hi = indptr[j], indptr[j + 1]
+                fb = np.asarray(m.value_to_bin(
+                    np.asarray(data[lo:hi], np.float64)))
+                nz = fb != m.default_bin
+                off = self.bundle.feat_offset[inner]
+                X[indices[lo:hi][nz], gp] = (off + fb[nz]).astype(dtype)
+        self.X_bin = X
 
     def _alloc_X(self) -> None:
         """Allocate the binned matrix for ``num_data`` rows (filled by

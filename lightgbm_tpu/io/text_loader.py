@@ -300,6 +300,31 @@ def load_text_two_round(path: str, config, categorical_features=(),
     if getattr(config, "header", False):
         names = [t.strip() for t in first.rstrip("\n").split(delim)]
         skip = 1
+    try:
+        return _two_round_streamed(path, config, categorical_features,
+                                   reference, names, skip, delim)
+    except _ParseError as exc:
+        # the strict native parser rejected the file (or is unavailable):
+        # degrade to the lenient in-memory path rather than erroring
+        log.warning("two_round streaming unavailable (%s); falling back "
+                    "to in-memory loading", exc)
+        X, label, weight, group, fnames = load_text(path, config)
+        cats = []
+        for c in categorical_features or ():
+            if isinstance(c, str):
+                if c in fnames:
+                    cats.append(fnames.index(c))
+            else:
+                cats.append(int(c))
+        handle = BinnedDataset.from_matrix(
+            X, config, categorical_features=cats, feature_names=fnames,
+            reference=reference)
+        return handle, label, weight, group, fnames
+
+
+def _two_round_streamed(path, config, categorical_features, reference,
+                        names, skip, delim):
+    from .dataset import BinnedDataset, Metadata
 
     # ---- pass 1: count rows, parse ONLY the side columns, and
     # reservoir-sample line BYTE RANGES (the sampled lines are fully

@@ -10,6 +10,7 @@
 //
 // Build: g++ -O3 -fopenmp -shared -fPIC (see native/__init__.py);
 // loaded via ctypes, with the Python implementation as fallback.
+#include <atomic>
 #include <cmath>
 #include <cstdint>
 #include <cstdlib>
@@ -380,8 +381,10 @@ int64_t csv_parse(const char* buf, int64_t len, char delim, int64_t ncol,
     if (rows > max_rows) return -1;
     starts.push_back(len);
 
-    volatile int64_t bad = 0;   // a malformed line (1-based), 0 = none
-    volatile int drop_last = 0;  // trailing blank line tolerated, dropped
+    // atomics, not volatile: concurrent writes from the parallel loop
+    // would otherwise be a formal data race
+    std::atomic<int64_t> bad{0};   // a malformed line (1-based), 0 = none
+    std::atomic<int> drop_last{0};  // trailing blank line tolerated, dropped
 #pragma omp parallel for schedule(static)
     for (int64_t r = 0; r < rows; ++r) {
         if (bad) continue;
@@ -488,8 +491,8 @@ int64_t csv_parse_cols(const char* buf, int64_t len, char delim,
     if (rows > max_rows) return -1;
     starts.push_back(len);
 
-    volatile int64_t bad = 0;
-    volatile int drop_last = 0;
+    std::atomic<int64_t> bad{0};
+    std::atomic<int> drop_last{0};
 #pragma omp parallel for schedule(static)
     for (int64_t r = 0; r < rows; ++r) {
         if (bad) continue;

@@ -91,7 +91,8 @@ def make_data_parallel_grower(meta: DeviceMeta, cfg: SplitConfig, B: int,
 
 def make_voting_parallel_grower(meta: DeviceMeta, cfg: SplitConfig, B: int,
                                 mesh: Mesh, top_k: int = 20,
-                                hist_fn=hist_onehot):
+                                hist_fn=hist_onehot, B_phys=None,
+                                bundled: bool = False):
     """Rows sharded with a per-device top-k feature vote gating the
     histogram exchange (PV-Tree; reference:
     voting_parallel_tree_learner.cpp:170-200,262-377).
@@ -103,20 +104,31 @@ def make_voting_parallel_grower(meta: DeviceMeta, cfg: SplitConfig, B: int,
     ReduceScatter.  Approximate by design.  Because each pass may keep a
     different feature set, sibling histograms are computed explicitly
     rather than by parent-minus-child subtraction.
+
+    EFB datasets vote on whole PHYSICAL columns (the reference packs
+    per-group histograms the same way,
+    voting_parallel_tree_learner.cpp:203-259); the surviving-column mask
+    rides along so gated-off members skip the default-bin reconstruction
+    (core/grower.py hist_leaf) instead of fabricating leaf mass.
     """
     def gated_reduce(x):
-        if getattr(x, "ndim", 0) == 3:  # [F, B, 3] histograms
+        if getattr(x, "ndim", 0) == 3:  # [F_phys, B_phys, 3] histograms
             F = x.shape[0]
             k = min(top_k, F)
             local_score = jnp.abs(x[..., 0]).sum(axis=1)
             thresh = jax.lax.top_k(local_score, k)[0][-1]
             votes = (local_score >= thresh).astype(jnp.float32)
-            gate = (jax.lax.psum(votes, AXIS) > 0.0)[:, None, None]
-            return jax.lax.psum(jnp.where(gate, x, 0.0), AXIS)
+            alive = jax.lax.psum(votes, AXIS) > 0.0      # [F_phys]
+            summed = jax.lax.psum(
+                jnp.where(alive[:, None, None], x, 0.0), AXIS)
+            if bundled:
+                return summed, alive
+            return summed
         return jax.lax.psum(x, AXIS)
 
     grow = build_grow_fn(meta, cfg, B, hist_fn=hist_fn,
-                         reduce_fn=gated_reduce, subtract_sibling=False)
+                         reduce_fn=gated_reduce, subtract_sibling=False,
+                         B_phys=B_phys, bundled=bundled)
     return _shard_map(grow, mesh, *_ROW_SHARDED)
 
 
@@ -268,15 +280,8 @@ def make_engine_grower(mode: str, meta: DeviceMeta, cfg: SplitConfig, B: int,
                                           B_phys=B_phys, bundled=bundled)
         feature_major = False
     elif mode == "voting":
-        if bundled:
-            # the top-k gate can zero a bundled physical column entirely,
-            # after which fix_default_bins would fabricate the whole leaf
-            # mass at each member's default bin — silently wrong splits
-            raise ValueError(
-                "EFB-bundled datasets are not supported by the voting-"
-                "parallel learner; set enable_bundle=false or use "
-                "tree_learner=data/serial")
-        inner = make_voting_parallel_grower(meta, cfg, B, mesh, top_k=top_k)
+        inner = make_voting_parallel_grower(meta, cfg, B, mesh, top_k=top_k,
+                                            B_phys=B_phys, bundled=bundled)
         feature_major = False
     elif mode == "feature":
         if bundled:

@@ -200,15 +200,53 @@ def test_bundled_dataset_feature_parallel_rejected():
         lgb.train(p, ds, num_boost_round=2)
 
 
-def test_bundled_dataset_voting_parallel_rejected():
-    X, y = _onehotish(n=1024, blocks=20, seed=7)
-    ds = lgb.Dataset(X, label=y, params={"verbose": -1})
-    ds.construct()
+def test_bundled_dataset_voting_parallel_full_vote_matches_data():
+    """EFB + voting (refused pre-r5; reference packs group histograms for
+    any bundling, voting_parallel_tree_learner.cpp:203-259): with top_k
+    >= F_phys every physical column survives the gate, so the result
+    equals data-parallel exactly."""
+    X, y = _onehotish(n=2048, blocks=20, seed=7)
+    ds_params = {"objective": "binary", "num_leaves": 15, "verbose": -1,
+                 "min_data_in_leaf": 5}
+    preds = {}
+    for tl in ("data", "voting"):
+        ds = lgb.Dataset(X, label=y, params=ds_params)
+        ds.construct()
+        assert ds._handle.bundle is not None
+        p = dict(ds_params, tree_learner=tl, top_k=64)
+        bst = lgb.train(p, ds, num_boost_round=5)
+        preds[tl] = bst.predict(X)
+    np.testing.assert_allclose(preds["voting"], preds["data"], atol=1e-6)
+
+
+def test_bundled_voting_tight_gate_no_phantom_splits():
+    """A tight top_k gates physical columns OFF some passes; their members
+    must scan all-zero histograms (skipped default-bin fix), never
+    fabricated leaf mass.  Loss must stay sane and every chosen split
+    must carry real gain."""
+    X, y = _onehotish(n=2048, blocks=20, seed=8)
+    ds_params = {"objective": "binary", "num_leaves": 15, "verbose": -1,
+                 "min_data_in_leaf": 5}
+    ds = lgb.Dataset(X, label=y, params=ds_params)
+    ds.construct()  # serial-default params -> bundling happens
     assert ds._handle.bundle is not None
-    p = {"objective": "binary", "num_leaves": 7, "verbose": -1,
-         "tree_learner": "voting", "min_data_in_leaf": 5}
-    with pytest.raises(Exception, match="bundle"):
-        lgb.train(p, ds, num_boost_round=2)
+    p = dict(ds_params, tree_learner="voting", top_k=2)
+    bst = lgb.train(p, ds, num_boost_round=8)
+    pred = bst.predict(X)
+    eps = 1e-15
+    ll = -np.mean(y * np.log(np.clip(pred, eps, 1))
+                  + (1 - y) * np.log(np.clip(1 - pred, eps, 1)))
+    assert ll < 0.60, ll  # learns despite the gate; base rate ~0.69
+    dump = bst.dump_model()
+    def gains(node, out):
+        if "split_gain" in node:
+            out.append(node["split_gain"])
+            gains(node["left_child"], out)
+            gains(node["right_child"], out)
+    allg = []
+    for t in dump["tree_info"]:
+        gains(t["tree_structure"], allg)
+    assert allg and all(g > 0 for g in allg)
 
 
 def test_reference_cli_efb_auc_parity():

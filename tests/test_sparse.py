@@ -1,0 +1,93 @@
+"""CSR-native dataset construction (no dense materialization).
+
+The reference ingests wide sparse data via SparseBin delta-encoded streams
+(src/io/sparse_bin.hpp:72, ordered_sparse_bin.hpp:1); this framework bins
+stored entries column-by-column from CSC, packs mutually-exclusive
+features with EFB (uint16-wide bundle columns past 2048 features), and
+histograms wide layouts with the scatter-add path instead of one-hot.
+"""
+import numpy as np
+import pytest
+
+scipy_sparse = pytest.importorskip("scipy.sparse")
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.dataset import BinnedDataset
+
+
+def _sparse_problem(n=400, f=30, density=0.15, seed=3):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    X[rng.random((n, f)) > density] = 0.0
+    y = (X[:, 0] - X[:, 1] + 0.2 * rng.normal(size=n) > 0).astype(float)
+    return scipy_sparse.csr_matrix(X), X, y
+
+
+def test_from_csr_matches_dense():
+    """from_csr must produce the EXACT dataset from_matrix builds on the
+    densified values (stored-entry binning == dense-column binning)."""
+    Xs, Xd, _ = _sparse_problem()
+    cfg = Config.from_params({"verbose": -1, "max_bin": 31})
+    h1 = BinnedDataset.from_matrix(Xd, cfg)
+    h2 = BinnedDataset.from_csr(Xs, cfg)
+    assert h2.num_data == h1.num_data
+    np.testing.assert_array_equal(h2.bin_offsets, h1.bin_offsets)
+    np.testing.assert_array_equal(h2.X_bin, h1.X_bin)
+    assert (h2.bundle is None) == (h1.bundle is None)
+    # valid alignment path too
+    h3 = BinnedDataset.from_csr(Xs, cfg, reference=h1)
+    np.testing.assert_array_equal(h3.X_bin, h1.X_bin)
+
+
+def test_sparse_train_matches_dense():
+    """lgb.train on a scipy CSR matrix == training on its dense copy."""
+    Xs, Xd, y = _sparse_problem(n=600)
+    params = {"objective": "binary", "num_leaves": 15, "max_bin": 31,
+              "min_data_in_leaf": 5, "verbose": -1}
+    b1 = lgb.train(params, lgb.Dataset(Xd, label=y), num_boost_round=10)
+    b2 = lgb.train(params, lgb.Dataset(Xs, label=y), num_boost_round=10)
+    p1 = b1.predict(Xd)
+    p2 = b2.predict(Xs)
+    np.testing.assert_allclose(p1, p2, rtol=1e-6, atol=1e-9)
+    # AUC sanity
+    order = np.argsort(p2)
+    ranks = np.empty_like(order, float)
+    ranks[order] = np.arange(len(y))
+    pos, neg = ranks[y == 1], ranks[y == 0]
+    auc = (pos.mean() - neg.mean()) / len(y) + 0.5
+    assert auc > 0.7
+
+
+def test_wide_sparse_constructs_and_trains():
+    """A genuinely wide sparse dataset (the scaled-down acceptance shape:
+    the full 1M x 50k drive lives in the verify skill) constructs without
+    densifying and trains through the scatter-histogram path."""
+    rng = np.random.default_rng(0)
+    n, f, nnz_per_row = 20000, 5000, 8
+    rows = np.repeat(np.arange(n), nnz_per_row)
+    cols = rng.integers(0, f, size=n * nnz_per_row)
+    # values correlated with a hidden subset of columns for learnability
+    informative = cols < 50
+    vals = np.where(informative, 1.0 + rng.random(n * nnz_per_row),
+                    rng.normal(size=n * nnz_per_row))
+    X = scipy_sparse.csr_matrix((vals, (rows, cols)), shape=(n, f))
+    row_signal = np.zeros(n)
+    np.add.at(row_signal, rows[informative], vals[informative])
+    y = (row_signal + 0.5 * rng.normal(size=n) > 1.0).astype(float)
+
+    params = {"objective": "binary", "num_leaves": 7, "max_bin": 15,
+              "min_data_in_leaf": 20, "max_conflict_rate": 0.1,
+              "verbose": -1}
+    ds = lgb.Dataset(X, label=y, params=params)
+    ds.construct()
+    h = ds._handle
+    # EFB must have packed the 5k features into far fewer physical columns
+    assert h.bundle is not None
+    assert h.num_phys_features < f // 4, h.num_phys_features
+    bst = lgb.train(params, ds, num_boost_round=3)
+    pred = bst.predict(X)
+    assert pred.shape == (n,)
+    # better than chance on the informative signal
+    auc_num = (pred[y == 1].mean() - pred[y == 0].mean())
+    assert auc_num > 0.01, auc_num
