@@ -11,7 +11,8 @@ present at $HIGGS_PATH) and reports steady-state row-iterations/second;
 vs_baseline > 1 means faster than the reference CPU result.
 
 Env knobs: BENCH_ROWS (default 1_000_000), BENCH_ITERS (default 10),
-BENCH_LEAVES (default 255). BENCH_TASK=rank switches to an
+BENCH_LEAVES (default 255), BENCH_MAXBIN (default 255 — 63 fills the
+MXU 4x denser via feature packing, see docs/ROOFLINE.md). BENCH_TASK=rank switches to an
 MSLR-WEB30K-shaped lambdarank run only (ragged queries of 1..1251 docs,
 136 features, NDCG@10) against the reference's published MSLR CPU time
 (BASELINE.md: 215.32 s for 500 iters over 2.27M rows).
@@ -68,6 +69,8 @@ def _measure(params: dict, X, y, group, iters: int, metric_prefix: str):
     Returns (per_iter_s, compile_s, bin_s, metric_value, num_rows)."""
     import lightgbm_tpu as lgb
 
+    import jax
+
     t_bin0 = time.time()
     ds = lgb.Dataset(X, label=y, group=group, params=params)
     ds.construct()
@@ -75,10 +78,14 @@ def _measure(params: dict, X, y, group, iters: int, metric_prefix: str):
     booster = lgb.Booster(params=params, train_set=ds)
     t0 = time.time()
     booster.update()
+    jax.block_until_ready(booster._gbdt._train_score)
     compile_time = time.time() - t0
     t1 = time.time()
     for _ in range(iters - 1):
         booster.update()
+    # sync: updates dispatch asynchronously — without this the loop
+    # measures enqueue time, not compute (wildly optimistic at small iters)
+    jax.block_until_ready(booster._gbdt._train_score)
     per_iter = (time.time() - t1) / max(iters - 1, 1)
     mval = next((v for (_, m, v, _) in booster.eval_train()
                  if m.startswith(metric_prefix)), None)
@@ -123,13 +130,50 @@ def _load_data(rows: int):
     return X.astype(np.float64), y
 
 
+def _tpu_alive(timeout_s: int = 120) -> bool:
+    """Probe the TPU backend in a SUBPROCESS: when the axon pool loses its
+    chip lease, jax.devices() blocks ~30 min in-process before erroring
+    (verify skill, 'TPU wedge triage') — a wedged probe must not take the
+    whole bench with it."""
+    import subprocess
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; assert jax.default_backend() != 'cpu'"],
+            timeout=timeout_s, capture_output=True)
+        return r.returncode == 0
+    except (subprocess.TimeoutExpired, OSError):
+        return False
+
+
 def main() -> None:
     rows = int(os.environ.get("BENCH_ROWS", 1_000_000))
     iters = int(os.environ.get("BENCH_ITERS", 10))
     leaves = int(os.environ.get("BENCH_LEAVES", 255))
+    max_bin = int(os.environ.get("BENCH_MAXBIN", 255))
     if iters < 2:
         raise SystemExit("BENCH_ITERS must be >= 2: the first iteration is "
                          "compile warmup and is excluded from throughput")
+
+    forced_cpu = bool(os.environ.get("BENCH_FORCE_CPU", ""))
+    backend_tag = None  # None = real accelerator run
+    if forced_cpu or not _tpu_alive():
+        # a number marked degraded beats an rc=1 with no number at all
+        # (round 4 recorded nothing for exactly this reason); CPU sizes
+        # shrink so the run finishes in minutes
+        backend_tag = "cpu-forced" if forced_cpu else "cpu-fallback"
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        # scatter-histogram CPU path: ~0.5 s/iter at 200k rows x 31
+        # leaves on this single-core container (~140s total run)
+        rows = min(rows, int(os.environ.get("BENCH_CPU_ROWS", 200_000)))
+        iters = min(iters, 3)
+        leaves = min(leaves, 31)
+        why = ("BENCH_FORCE_CPU set" if forced_cpu
+               else "TPU backend unavailable (axon lease wedge?)")
+        print(f"# {why} — CPU run at rows={rows}, iters={iters}",
+              file=sys.stderr)
+    degraded = backend_tag is not None
 
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     if os.environ.get("BENCH_TASK", "").lower() == "rank":
@@ -138,13 +182,16 @@ def main() -> None:
         if leaves > 255 or rows > 500_000:
             print(f"# clamping rank bench to rows<=500000, leaves<=255 "
                   f"(asked rows={rows}, leaves={leaves})", file=sys.stderr)
-        print(json.dumps(_run_rank(iters, min(leaves, 255),
-                                   min(rows, 500_000))))
+        rr = _run_rank(iters, min(leaves, 255), min(rows, 500_000))
+        if backend_tag is not None:
+            rr["backend"] = backend_tag
+            rr["note"] = "CPU numbers at reduced size — NOT the TPU result"
+        print(json.dumps(rr))
         return
     X, y = _load_data(rows)
     params = {"objective": "binary", "metric": "auc", "num_leaves": leaves,
-              "learning_rate": 0.1, "max_bin": 255, "min_data_in_leaf": 100,
-              "verbose": -1}
+              "learning_rate": 0.1, "max_bin": max_bin,
+              "min_data_in_leaf": 100, "verbose": -1}
     per_iter, compile_time, bin_time, auc_val, _ = _measure(
         params, X, y, None, iters, "auc")
 
@@ -157,16 +204,24 @@ def main() -> None:
         "rows": rows,
         "iters": iters,
         "num_leaves": leaves,
+        "max_bin": max_bin,
         "per_iter_s": round(per_iter, 3),
         "compile_s": round(compile_time, 1),
         "binning_s": round(bin_time, 1),
         "train_auc": None if auc_val is None else round(float(auc_val), 5),
         "implied_higgs_500iter_s": round(10_500_000 * 500 / row_iters_per_sec, 1),
     }
+    if backend_tag is not None:
+        result["backend"] = backend_tag
+        result["note"] = ("CPU numbers at reduced size — "
+                          "NOT the TPU result")
     # Rank leg: fold the MSLR north-star numbers into the same JSON line so
     # the driver's plain `python bench.py` run always captures them.
     rank_rows = int(os.environ.get("BENCH_RANK_ROWS", 200_000))
     rank_iters = max(int(os.environ.get("BENCH_RANK_ITERS", 5)), 2)
+    if degraded:
+        rank_rows = min(rank_rows, 50_000)
+        rank_iters = min(rank_iters, 3)
     if rank_rows > 0:
         if rank_rows > 500_000 or leaves > 255:
             print(f"# clamping rank leg to rows<=500000, leaves<=255 "

@@ -239,7 +239,11 @@ class Dataset:
                                 "use free_raw_data=False")
         idx = np.asarray(used_indices)
         if self._matrix_cache is None:
-            self._matrix_cache = _to_matrix(self.data)
+            # sparse raw data row-slices sparsely — densifying a wide
+            # sparse matrix here would defeat the no-densify CSR path
+            self._matrix_cache = (self.data.tocsr()
+                                  if _is_scipy_sparse(self.data)
+                                  else _to_matrix(self.data))
         sub = Dataset(self._matrix_cache[idx], reference=self,
                       params=params or self.params,
                       free_raw_data=self.free_raw_data)

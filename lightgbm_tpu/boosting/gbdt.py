@@ -457,10 +457,14 @@ class GBDT(PredictorBase):
 
             # very wide physical layouts (wide-sparse EFB): the one-hot
             # contraction is O(N*F*B) and intractable past ~32k total
-            # physical bins; scatter-add is O(N*F)
+            # physical bins; scatter-add is O(N*F).  CPU takes scatter
+            # ALWAYS — no MXU to feed, and the one-hot materialization is
+            # pure memory traffic there (~340x slower per tree measured
+            # at 20k rows x 28 features); the TPU path keeps one-hot
             wide = (self.B_phys * max(train_ds.num_phys_features, 1)
                     > 32768)
-            hist_fn = hist_scatter if wide else hist_onehot
+            use_scatter = wide or jax.default_backend() == "cpu"
+            hist_fn = hist_scatter if use_scatter else hist_onehot
 
             def build_xla():
                 return build_grow_fn(self.meta, self.split_cfg, self.B,
@@ -471,7 +475,7 @@ class GBDT(PredictorBase):
                                      bynode=bynode)
             if cegb_cfg is None and forced is None and bynode is None:
                 key = ("xla", id(self.meta), self.split_cfg, self.B,
-                       self.B_phys, self._bundled, wide)
+                       self.B_phys, self._bundled, use_scatter)
                 self._grow_raw = _cached_jit(key, build_xla)
                 self._raw_cached = True
             else:

@@ -162,18 +162,6 @@ def _csr_to_dense(indptr, indices, data, num_col: int) -> np.ndarray:
     return dense
 
 
-def _csc_to_dense(col_ptr, indices, data, num_row: int) -> np.ndarray:
-    col_ptr = np.asarray(col_ptr, np.int64)
-    indices = np.asarray(indices, np.int32)
-    data = np.asarray(data, np.float64)
-    ncol = len(col_ptr) - 1
-    dense = np.zeros((num_row, ncol), np.float64)
-    for j in range(ncol):
-        lo, hi = col_ptr[j], col_ptr[j + 1]
-        dense[indices[lo:hi], j] = data[lo:hi]
-    return dense
-
-
 class _PushState:
     """Dataset being filled row-block-wise (LGBM_DatasetPushRows*)."""
 
@@ -852,7 +840,12 @@ def LGBM_BoosterPredictForCSR(handle, indptr, indptr_type: int, indices,
                               start_iteration: int, num_iteration: int,
                               parameter: str, out_len, out_result) -> int:
     cb = _get(handle, _CBooster)
-    mat = _csr_to_dense(indptr, indices, data, int(num_col))
+    # stays sparse: Booster.predict densifies in cell-bounded row blocks
+    import scipy.sparse as sp
+    mat = sp.csr_matrix(
+        (np.asarray(data, np.float64), np.asarray(indices, np.int32),
+         np.asarray(indptr, np.int64)),
+        shape=(len(np.asarray(indptr)) - 1, int(num_col)))
     out = _predict_mat(cb, mat, predict_type, start_iteration,
                        num_iteration, parameter)
     _store(out_len, out.size)
@@ -882,7 +875,11 @@ def LGBM_BoosterPredictForCSC(handle, col_ptr, col_ptr_type: int, indices,
                               start_iteration: int, num_iteration: int,
                               parameter: str, out_len, out_result) -> int:
     cb = _get(handle, _CBooster)
-    mat = _csc_to_dense(col_ptr, indices, data, int(num_row))
+    import scipy.sparse as sp
+    mat = sp.csc_matrix(
+        (np.asarray(data, np.float64), np.asarray(indices, np.int32),
+         np.asarray(col_ptr, np.int64)),
+        shape=(int(num_row), len(np.asarray(col_ptr)) - 1)).tocsr()
     out = _predict_mat(cb, mat, predict_type, start_iteration,
                        num_iteration, parameter)
     _store(out_len, out.size)

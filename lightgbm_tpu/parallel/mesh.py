@@ -184,7 +184,7 @@ def make_feature_parallel_grower(meta: DeviceMeta, cfg: SplitConfig, B: int,
         pad_cols = F_pad - F
         if pad_cols:
             bins = jnp.pad(bins, ((0, 0), (0, pad_cols)))
-        return hist_onehot(block_slice(bins, axis=1), g, h, mask, B=B)
+        return hist_fn(block_slice(bins, axis=1), g, h, mask, B=B)
 
     def synced_best_split(hist, sg, sh, sc, min_c, max_c, feature_mask):
         lm = local_meta_fn()
@@ -269,7 +269,13 @@ def make_engine_grower(mode: str, meta: DeviceMeta, cfg: SplitConfig, B: int,
     import jax
     import jax.numpy as jnp
 
+    from ..core.histogram import hist_scatter
+
     D = mesh.devices.size
+    # CPU devices take the scatter-add histogram (no MXU; the one-hot
+    # materialization is ~300x slower there — see gbdt._init_grower)
+    hist_fn = (hist_scatter if jax.default_backend() == "cpu"
+               else hist_onehot)
     if mode == "data" and wave_kw is not None:
         inner = make_data_parallel_wave_grower(meta, cfg, B, mesh,
                                                B_phys=B_phys,
@@ -277,10 +283,12 @@ def make_engine_grower(mode: str, meta: DeviceMeta, cfg: SplitConfig, B: int,
         feature_major = True
     elif mode == "data":
         inner = make_data_parallel_grower(meta, cfg, B, mesh,
+                                          hist_fn=hist_fn,
                                           B_phys=B_phys, bundled=bundled)
         feature_major = False
     elif mode == "voting":
         inner = make_voting_parallel_grower(meta, cfg, B, mesh, top_k=top_k,
+                                            hist_fn=hist_fn,
                                             B_phys=B_phys, bundled=bundled)
         feature_major = False
     elif mode == "feature":
@@ -291,7 +299,8 @@ def make_engine_grower(mode: str, meta: DeviceMeta, cfg: SplitConfig, B: int,
                 "parallel learner; set enable_bundle=false or use "
                 "tree_learner=data/voting/serial")
         # replicated inputs — no padding or resharding needed
-        return make_feature_parallel_grower(meta, cfg, B, mesh)
+        return make_feature_parallel_grower(meta, cfg, B, mesh,
+                                            hist_fn=hist_fn)
     else:
         raise ValueError(f"unknown parallel mode: {mode}")
 
