@@ -84,6 +84,7 @@ class _WaveState(NamedTuple):
     pend_cnt: jnp.ndarray       # i32
     tree: TreeArrays
     cegb_coupled: jnp.ndarray = None  # f32 [F] CEGB pending coupled penalties
+    n_waves: jnp.ndarray = None  # i32 kernel-pass counter (report_waves)
 
 
 def build_wave_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
@@ -92,9 +93,14 @@ def build_wave_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
                        block_rows: int = 1024, compact: bool = True,
                        reduce_fn=None, B_phys: int = None,
                        bundled: bool = False, cegb=None,
-                       mixed: MixedWidth = None):
+                       mixed: MixedWidth = None,
+                       report_waves: bool = False):
     """Unjitted ``grow(bins_fm, g, h, sample_mask, feature_mask)`` using the
-    Pallas wave kernel. Returns (TreeArrays, leaf_id).
+    Pallas wave kernel. Returns (TreeArrays, leaf_id); with
+    ``report_waves`` a third output counts the kernel passes actually
+    taken — the CPU-runnable regression guard on wave-scheduling
+    efficiency (each pass is one full-data histogram kernel launch, the
+    dominant per-tree cost on TPU).
 
     With ``mixed`` set, ``bins_fm`` is a PAIR ``(narrow_u8 [Fn, N],
     wide [Fw, N])``: narrow physical columns ride the kernel at
@@ -136,6 +142,8 @@ def build_wave_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
     if cegb is not None and cegb.lazy is not None:
         raise ValueError("cegb_penalty_feature_lazy needs per-row state the "
                          "wave path does not carry; use the serial grower")
+    assert not (report_waves and cegb is not None), \
+        "report_waves and cegb both add a third output; pick one"
     split_pen = float(cegb.tradeoff * cegb.penalty_split) if cegb else 0.0
     P = max(1, min(wave_capacity, C_MAX // 3))
     # gain_gate > 1 would make _split_once never commit while loop_cond
@@ -435,6 +443,8 @@ def build_wave_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
                 pend_large=jnp.full((P,), -1, jnp.int32),
                 pend_cnt=jnp.int32(0),
             )
+            if report_waves:
+                st = st._replace(n_waves=st.n_waves + 1)
             return st
 
         return jax.lax.cond(st.pend_cnt > 0, do, lambda s: s, st)
@@ -486,6 +496,7 @@ def build_wave_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
             pend_cnt=jnp.int32(1),
             tree=_empty_tree(L, W),
             cegb_coupled=cegb_coupled,
+            n_waves=jnp.int32(0) if report_waves else None,
         )
         # Alternate split and wave phases until no ready leaf has positive
         # gain and nothing is pending.  The first body iteration has no
@@ -526,6 +537,8 @@ def build_wave_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
         )
         if cegb is not None:
             return tr, st.leaf_id, st.cegb_coupled
+        if report_waves:
+            return tr, st.leaf_id, st.n_waves
         return tr, st.leaf_id
 
     return grow

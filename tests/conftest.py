@@ -16,3 +16,92 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax
 
 jax.config.update("jax_platforms", "cpu")
+
+
+# ---------------------------------------------------------------------
+# Quick/slow tiers (round-5): the full suite is ~29 min on a 1-CPU
+# container (jit-compile bound); `pytest -m quick` runs the <6.5s tests
+# (~4-5 min), `pytest -m slow` the compile-heavy rest, plain `pytest`
+# everything.  The list is data (measured durations), not decorators —
+# re-measure with `pytest --durations=80` and update when it drifts.
+_SLOW = {
+    "test_rank.py::test_lambdarank_example_parity",
+    "test_cli.py::test_reference_example_confs_run_unchanged[multiclass_classification-multi_logloss]",
+    "test_train.py::test_reference_parity_binary",
+    "test_bundling.py::test_training_metrics_unchanged_vs_no_bundle",
+    "test_continued.py::test_continue_training_from_reference_model",
+    "test_cli.py::test_reference_example_confs_run_unchanged[lambdarank-ndcg@3]",
+    "test_model_io.py::test_reference_cli_loads_our_model",
+    "test_sparse.py::test_wide_sparse_constructs_and_trains",
+    "test_distributed.py::test_two_process_data_parallel_bitmatch",
+    "test_predict_device.py::test_device_predict_matches_host_multiclass_categorical",
+    "test_cli.py::test_reference_example_confs_run_unchanged[regression-l2]",
+    "test_bundling.py::test_bundled_dataset_voting_parallel_full_vote_matches_data",
+    "test_cegb.py::test_reference_cli_cegb_parity",
+    "test_parallel.py::test_goss_and_bagging_under_data_parallel",
+    "test_parallel.py::test_tree_learner_data_trains_end_to_end",
+    "test_cli.py::test_init_score_sidecar_and_param",
+    "test_sklearn.py::test_sklearn_clone_and_grid_search",
+    "test_rank.py::test_lambdarank_mslr_shaped_no_recompile",
+    "test_bundling.py::test_wave_grower_bundled_matches_serial",
+    "test_sklearn.py::test_classifier_multiclass",
+    "test_bundling.py::test_bundled_dataset_with_parallel_learner",
+    "test_bundling.py::test_bundled_predict_device_matches_host",
+    "test_cli.py::test_cli_snapshots_and_continue",
+    "test_continued.py::test_init_model_multiclass",
+    "test_cli.py::test_multi_error_top_k",
+    "test_bundling.py::test_bundled_voting_tight_gate_no_phantom_splits",
+    "test_wave.py::test_mixed_width_wave_matches_serial",
+    "test_forced_splits.py::test_reference_cli_forced_splits_parity",
+    "test_train.py::test_dart_and_goss_compose_with_bundling_and_categoricals",
+    "test_train.py::test_multiclass",
+    "test_parallel.py::test_tree_learner_feature_trains_end_to_end",
+    "test_cegb.py::test_coupled_penalty_narrows_feature_set",
+    "test_categorical.py::test_wave_categorical_matches_serial",
+    "test_api_extras.py::test_pandas_categorical_roundtrip",
+    "test_cegb.py::test_tradeoff_split_scaling_equality",
+    "test_dump_model.py::test_if_else_code_compiles_and_matches[3]",
+    "test_continued.py::test_init_model_with_now_trivial_feature",
+    "test_wave.py::test_wave_gated_boosting_matches_serial_loss",
+    "test_cli.py::test_cli_task_refit",
+    "test_categorical.py::test_high_cardinality_categorical_uint16_path",
+    "test_continued.py::test_refit_moves_leaf_values_toward_new_data",
+    "test_bundling.py::test_reference_cli_efb_auc_parity",
+    "test_cegb.py::test_split_penalty_prunes_splits",
+    "test_cli.py::test_cli_train_predict_matches_python_api",
+    "test_categorical.py::test_categorical_train_roundtrip_and_predict",
+    "test_continued.py::test_init_model_file_roundtrip",
+    "test_categorical.py::test_categorical_device_replay_matches_host_predict",
+    "test_sampling.py::test_feature_fraction_bynode_deterministic",
+    "test_continued.py::test_init_model_booster_equals_uninterrupted",
+    "test_predict_device.py::test_prediction_early_stop_converges_to_same_argmax",
+    "test_dump_model.py::test_dump_model_walk_matches_predict",
+    "test_parallel.py::test_data_parallel_matches_single_device",
+    "test_train.py::test_jit_cache_reuses_compiled_growers",
+    "test_parallel.py::test_feature_parallel_matches_single_device",
+    "test_parallel.py::test_wave_data_parallel_matches_single_device",
+    "test_api_extras.py::test_pandas_int_categories_json_roundtrip",
+    "test_sampling.py::test_balanced_bagging_mask_respects_class_fractions",
+    "test_wave.py::test_wave_capacity1_matches_serial",
+    "test_cli.py::test_cli_overrides_beat_config_file",
+    "test_predict_device.py::test_device_predict_matches_host_binary",
+    "test_categorical.py::test_categorical_search_matches_reference_oracle[False-0]",
+    "test_sklearn.py::test_early_stopping_eval_set",
+    "test_wave.py::test_wave_pass_count_regression_guard",
+}
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "slow: compile-heavy test (>6.5s)")
+    config.addinivalue_line("markers", "quick: fast tier (everything else)")
+
+
+def pytest_collection_modifyitems(config, items):
+    import pytest as _pytest
+    for item in items:
+        # nodeid relative to tests/ (matches the measured list)
+        nid = item.nodeid.split("tests/")[-1]
+        if nid in _SLOW:
+            item.add_marker(_pytest.mark.slow)
+        else:
+            item.add_marker(_pytest.mark.quick)
