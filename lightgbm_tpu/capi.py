@@ -901,7 +901,7 @@ def LGBM_BoosterPredictForFile(handle, data_filename: str,
     X, _, _, _, _ = load_text(str(data_filename), cfg)
     out = _predict_mat(cb, X, predict_type, start_iteration, num_iteration,
                        parameter)
-    out2 = out.reshape(len(X), -1)
+    out2 = out.reshape(X.shape[0], -1)  # X may be sparse (LibSVM input)
     with open(str(result_filename), "w") as f:
         for row in out2:
             f.write("\t".join(repr(float(v)) for v in row) + "\n")

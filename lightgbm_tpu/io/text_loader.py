@@ -215,34 +215,82 @@ def _group_from_col(group_raw):
 
 
 def _load_libsvm(path: str, config):
-    """Sparse ``label idx:val ...`` rows, densified (missing entries are
-    0.0, which the zero-bin handling treats natively; reference:
-    dataset_loader.cpp sparse parser)."""
-    labels: List[float] = []
-    rows: List[List[Tuple[int, float]]] = []
+    """Sparse ``label [qid:Q] idx:val ...`` rows (the MSLR-WEB30K format)
+    -> a scipy CSR matrix (implicit entries are 0.0, which the zero-bin /
+    SparseBin-analog handling treats natively; reference:
+    dataset_loader.cpp sparse parser).  Native chunked parser with a
+    Python fallback; ``qid:`` tokens become query boundaries unless a
+    ``.query`` sidecar overrides them."""
+    from .. import native as _native
+
+    labels_l, qids_l, trip = [], [], []
     max_idx = -1
-    with open(path) as fh:
-        for line in fh:
-            toks = line.split()
-            if not toks:
-                continue
-            labels.append(float(toks[0]))
-            pairs = []
-            for tok in toks[1:]:
-                i, _, v = tok.partition(":")
-                idx = int(i)
-                pairs.append((idx, float(v)))
-                if idx > max_idx:
-                    max_idx = idx
-            rows.append(pairs)
-    X = np.zeros((len(rows), max_idx + 1), dtype=np.float64)
-    for r, pairs in enumerate(rows):
-        for idx, v in pairs:
-            X[r, idx] = v
-    label = np.asarray(labels)
+    if _native.lib() is not None:
+        for mm, lo, hi in _mmap_windows(path, 0):
+            out = _native.libsvm_parse(mm, offset=lo, length=hi - lo)
+            if out is None:
+                labels_l = []
+                break  # malformed for the strict parser: Python fallback
+            lab, qid, indptr, idx, vals, mf = out
+            labels_l.append(lab)
+            qids_l.append(qid)
+            trip.append((indptr, idx, vals))
+            max_idx = max(max_idx, mf)
+    if labels_l:
+        label = np.concatenate(labels_l)
+        qids = np.concatenate(qids_l)
+        counts = np.concatenate([np.diff(t[0]) for t in trip])
+        indptr = np.concatenate([[0], np.cumsum(counts)]).astype(np.int64)
+        indices = np.concatenate([t[1] for t in trip])
+        values = np.concatenate([t[2] for t in trip])
+    else:
+        # lenient Python fallback (also exercised with
+        # LIGHTGBM_TPU_NO_NATIVE=1)
+        labels_py: List[float] = []
+        qids_py: List[int] = []
+        indptr_py = [0]
+        idx_py: List[int] = []
+        val_py: List[float] = []
+        with open(path) as fh:
+            for line in fh:
+                toks = line.split()
+                if not toks:
+                    continue
+                labels_py.append(float(toks[0]))
+                q = -1
+                for tok in toks[1:]:
+                    i, _, v = tok.partition(":")
+                    if i == "qid":
+                        q = int(v)
+                        continue
+                    fi = int(i)
+                    idx_py.append(fi)
+                    val_py.append(float(v))
+                    if fi > max_idx:
+                        max_idx = fi
+                qids_py.append(q)
+                indptr_py.append(len(idx_py))
+        label = np.asarray(labels_py)
+        qids = np.asarray(qids_py, np.int64)
+        indptr = np.asarray(indptr_py, np.int64)
+        indices = np.asarray(idx_py, np.int32)
+        values = np.asarray(val_py, np.float64)
+
+    import scipy.sparse as sp
+    X = sp.csr_matrix((values, indices, indptr),
+                      shape=(len(label), max_idx + 1))
     names = [f"Column_{i}" for i in range(max_idx + 1)]
+    has_q = qids >= 0
+    qid_group = None
+    if len(qids) and has_q.any():
+        if has_q.all():
+            qid_group = _group_from_col(qids)
+        else:
+            log.warning("LibSVM file has qid: on only %d of %d rows; "
+                        "ignoring qids (provide a .query sidecar or "
+                        "annotate every row)", int(has_q.sum()), len(qids))
     weight, group = _load_sidecars(path, None, None)
-    return X, label, weight, group, names
+    return X, label, weight, group if group is not None else qid_group, names
 
 
 def _load_sidecars(path: str, weight, group):
@@ -288,9 +336,9 @@ def load_text_two_round(path: str, config, categorical_features=(),
         first = fh.readline()
     if ":" in first and not getattr(config, "header", False):
         log.warning("two_round is not supported for LibSVM input; "
-                    "loading in one round")
+                    "loading in one round (stays sparse)")
         X, label, weight, group, names = _load_libsvm(path, config)
-        handle = BinnedDataset.from_matrix(
+        handle = BinnedDataset.from_csr(
             X, config, categorical_features=categorical_features,
             feature_names=names, reference=reference)
         return handle, label, weight, group, names

@@ -89,6 +89,12 @@ def lib() -> Optional[ctypes.CDLL]:
     L.csv_parse_cols.restype = i64
     L.csv_parse_cols.argtypes = [ctypes.c_void_p, i64, ctypes.c_char, pi64,
                                  i64, pd, i64]
+    L.libsvm_parse.restype = i64
+    L.libsvm_parse.argtypes = [ctypes.c_void_p, i64, pd, pi64, pi64,
+                               np.ctypeslib.ndpointer(
+                                   np.int32, flags="C_CONTIGUOUS"),
+                               pd, i64, i64,
+                               ctypes.POINTER(i64), ctypes.POINTER(i64)]
     _lib = L
     return _lib
 
@@ -202,3 +208,35 @@ def csv_parse_cols(buf, delim: str, cols, offset: int = 0,
     if n < 0:
         return None
     return out[:n]
+
+
+def libsvm_parse(buf, offset: int = 0, length: int = None):
+    """Parse LibSVM lines ("label [qid:Q] idx:val ...") ->
+    (labels f64 [n], qids i64 [n] (-1 = absent), indptr i64 [n+1],
+    indices i32 [nnz], values f64 [nnz], max_feat).  None on malformed
+    input (caller falls back to the Python parser)."""
+    L = lib()
+    assert L is not None
+    if length is None:
+        length = len(buf) - offset
+    view = np.frombuffer(buf, np.uint8, count=length, offset=offset)
+    addr = view.ctypes.data
+    max_rows = L.csv_count_lines(addr, length)
+    # every pair holds exactly one ':'; qid tokens add one per row —
+    # colon count is a tight upper bound on nnz
+    max_nnz = int(np.count_nonzero(view == ord(":".encode()[0:1])))
+    labels = np.empty(max_rows, np.float64)
+    qids = np.empty(max_rows, np.int64)
+    indptr = np.empty(max_rows + 1, np.int64)
+    idx = np.empty(max(max_nnz, 1), np.int32)
+    vals = np.empty(max(max_nnz, 1), np.float64)
+    nnz_out = ctypes.c_int64(0)
+    mf_out = ctypes.c_int64(-1)
+    n = L.libsvm_parse(addr, length, labels, qids, indptr, idx, vals,
+                       max_rows, max_nnz, ctypes.byref(nnz_out),
+                       ctypes.byref(mf_out))
+    if n < 0:
+        return None
+    nnz = nnz_out.value
+    return (labels[:n], qids[:n], indptr[:n + 1], idx[:nnz], vals[:nnz],
+            int(mf_out.value))

@@ -92,8 +92,9 @@ def test_text_loader_libsvm(tmp_path):
         "1 0:0.5 2:1.5\n0 1:2.0\n1 0:-1.0 1:3.0 2:0.25\n")
     X, y, w, g, names = load_text(str(tmp_path / "s.train"), Config())
     np.testing.assert_array_equal(y, [1, 0, 1])
+    # LibSVM input stays sparse end to end (r5; Dataset/predict accept CSR)
     np.testing.assert_allclose(
-        X, [[0.5, 0.0, 1.5], [0.0, 2.0, 0.0], [-1.0, 3.0, 0.25]])
+        X.toarray(), [[0.5, 0.0, 1.5], [0.0, 2.0, 0.0], [-1.0, 3.0, 0.25]])
 
 
 def test_auc_mu_matches_pairwise_auc_binary_case():
