@@ -474,6 +474,14 @@ class Booster:
                 data, categorical_feature=None,
                 pandas_categorical=self.pandas_categorical)
         elif _is_scipy_sparse(data):
+            if data.shape[1] < self.num_feature():
+                # LibSVM-style input sizes by the max feature index
+                # PRESENT; pad implicit-zero columns up to the model's
+                # feature count (the reference pads the same way)
+                import scipy.sparse as sp
+                pad = sp.csr_matrix((data.shape[0],
+                                     self.num_feature() - data.shape[1]))
+                data = sp.hstack([data.tocsr(), pad], format="csr")
             # block-wise densify, ~128MB of dense cells per block: bounded
             # memory on wide sparse inputs (the reference predicts sparse
             # rows natively, predictor.hpp:140-180; row blocks are the

@@ -240,3 +240,47 @@ def libsvm_parse(buf, offset: int = 0, length: int = None):
     nnz = nnz_out.value
     return (labels[:n], qids[:n], indptr[:n + 1], idx[:nnz], vals[:nnz],
             int(mf_out.value))
+
+
+def capi_abi_lib() -> Optional[str]:
+    """Build (once, hash-cached) and return the path of the loadable C ABI
+    shared library (native/capi_abi.c -> liblgbm_tpu_<hash>.so), or None
+    when the toolchain/libpython is unavailable.  The library embeds
+    CPython; programs linking it need PYTHONPATH to resolve lightgbm_tpu
+    and its dependencies."""
+    import sysconfig
+    src = os.path.join(_DIR, "capi_abi.c")
+    try:
+        with open(src, "rb") as f:
+            tag = hashlib.sha256(f.read()).hexdigest()[:16]
+    except OSError:
+        return None
+    so_path = os.path.join(_DIR, f"liblgbm_tpu_{tag}.so")
+    if os.path.exists(so_path):
+        return so_path
+    inc = sysconfig.get_paths()["include"]
+    libdir = sysconfig.get_config_var("LIBDIR") or "/usr/local/lib"
+    ldver = sysconfig.get_config_var("LDVERSION")
+    if not ldver:  # static/embedded builds without a linkable libpython
+        return None
+    tmp = None
+    try:
+        fd, tmp = tempfile.mkstemp(suffix=".so", dir=_DIR)
+        os.close(fd)
+        cmd = ["gcc", "-O2", "-shared", "-fPIC", src, f"-I{inc}",
+               f"-L{libdir}", f"-Wl,-rpath,{libdir}", f"-lpython{ldver}",
+               "-o", tmp]
+        r = subprocess.run(cmd, capture_output=True, timeout=120)
+        if r.returncode != 0:
+            return None
+        os.replace(tmp, so_path)
+        tmp = None
+        return so_path
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    finally:
+        if tmp is not None:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass

@@ -574,6 +574,11 @@ int64_t libsvm_parse(const char* buf, int64_t len, double* labels,
         const char* fe = p;
         while (fe < end && *fe != ' ' && *fe != '\t') ++fe;
         labels[row] = parse_field(p, fe);
+        // a garbage label would silently train on NaN targets; reject the
+        // chunk so the lenient Python fallback surfaces the real error
+        // (feature VALUES stay NaN-tolerant — "na" is a missing value)
+        if (std::isnan(labels[row]) && !is_na_token(p, fe))
+            return -(row + 1);
         qids[row] = -1;
         p = fe;
         while (p < end) {

@@ -1,0 +1,143 @@
+"""Loadable C ABI: a real C program links liblgbm_tpu.so and trains.
+
+SURVEY §2 row 52: the reference ships ``lib_lightgbm.so`` with ~65 C
+exports (include/LightGBM/c_api.h).  Our full surface is Python-callable
+(``capi.py``); this proves the CORE SUBSET is additionally a genuine C
+ABI — compiled C code creates a dataset from raw row-major memory, sets
+the label field, boosts, predicts, saves, and reloads the model, all
+through ``LGBM_*`` symbols resolved by the dynamic linker (the compute
+still runs on JAX via the embedded interpreter).
+"""
+import os
+import subprocess
+import sys
+import sysconfig
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu import native
+
+C_PROGRAM = r"""
+#include <stdio.h>
+#include <stdlib.h>
+#include <stdint.h>
+#include <math.h>
+
+extern const char* LGBM_GetLastError(void);
+extern int LGBM_DatasetCreateFromMat(const void*, int, int32_t, int32_t,
+                                     int, const char*, const void*, void**);
+extern int LGBM_DatasetSetField(void*, const char*, const void*, int, int);
+extern int LGBM_DatasetGetNumData(void*, int32_t*);
+extern int LGBM_DatasetGetNumFeature(void*, int32_t*);
+extern int LGBM_DatasetFree(void*);
+extern int LGBM_BoosterCreate(const void*, const char*, void**);
+extern int LGBM_BoosterCreateFromModelfile(const char*, int32_t*, void**);
+extern int LGBM_BoosterUpdateOneIter(void*, int*);
+extern int LGBM_BoosterGetCurrentIteration(void*, int32_t*);
+extern int LGBM_BoosterSaveModel(void*, int, int, const char*);
+extern int LGBM_BoosterPredictForMat(void*, const void*, int, int32_t,
+                                     int32_t, int, int, int, int,
+                                     const char*, int64_t*, double*);
+extern int LGBM_BoosterFree(void*);
+
+#define CHECK(x) do { if ((x) != 0) { \
+    fprintf(stderr, "FAIL %s: %s\n", #x, LGBM_GetLastError()); \
+    return 1; } } while (0)
+
+int main(int argc, char **argv) {
+    const int N = 400, F = 4;
+    double *X = malloc(sizeof(double) * N * F);
+    float *y = malloc(sizeof(float) * N);
+    unsigned s = 42;
+    for (int i = 0; i < N; ++i) {
+        for (int j = 0; j < F; ++j) {
+            s = s * 1664525u + 1013904223u;
+            X[i * F + j] = ((double)(s >> 8) / 16777216.0) * 4.0 - 2.0;
+        }
+        y[i] = (X[i * F] + 0.3 * X[i * F + 1] > 0.0) ? 1.0f : 0.0f;
+    }
+    void *ds = NULL, *bst = NULL;
+    const char *p = "objective=binary num_leaves=7 min_data_in_leaf=5 "
+                    "verbose=-1";
+    CHECK(LGBM_DatasetCreateFromMat(X, 1, N, F, 1, p, NULL, &ds));
+    CHECK(LGBM_DatasetSetField(ds, "label", y, N, 0));
+    int32_t nd = 0, nf = 0;
+    CHECK(LGBM_DatasetGetNumData(ds, &nd));
+    CHECK(LGBM_DatasetGetNumFeature(ds, &nf));
+    if (nd != N || nf != F) { fprintf(stderr, "dims %d %d\n", nd, nf); return 2; }
+    CHECK(LGBM_BoosterCreate(ds, p, &bst));
+    for (int it = 0; it < 10; ++it) {
+        int fin = 0;
+        CHECK(LGBM_BoosterUpdateOneIter(bst, &fin));
+    }
+    int32_t cur = 0;
+    CHECK(LGBM_BoosterGetCurrentIteration(bst, &cur));
+    if (cur != 10) { fprintf(stderr, "iters %d\n", cur); return 3; }
+    int64_t out_len = 0;
+    double *pred = malloc(sizeof(double) * N);
+    CHECK(LGBM_BoosterPredictForMat(bst, X, 1, N, F, 1, 0, 0, -1, "",
+                                    &out_len, pred));
+    if (out_len != N) { fprintf(stderr, "len %lld\n", (long long)out_len); return 4; }
+    /* separation check: mean pred of positives > negatives + margin */
+    double sp = 0, sn = 0; int np_ = 0, nn = 0;
+    for (int i = 0; i < N; ++i) {
+        if (y[i] > 0.5) { sp += pred[i]; ++np_; } else { sn += pred[i]; ++nn; }
+    }
+    if (!(sp / np_ > sn / nn + 0.2)) {
+        fprintf(stderr, "no separation %f %f\n", sp / np_, sn / nn);
+        return 5;
+    }
+    CHECK(LGBM_BoosterSaveModel(bst, 0, -1, argv[1]));
+    int32_t iters2 = 0;
+    void *bst2 = NULL;
+    CHECK(LGBM_BoosterCreateFromModelfile(argv[1], &iters2, &bst2));
+    double *pred2 = malloc(sizeof(double) * N);
+    CHECK(LGBM_BoosterPredictForMat(bst2, X, 1, N, F, 1, 0, 0, -1, "",
+                                    &out_len, pred2));
+    for (int i = 0; i < N; ++i) {
+        if (fabs(pred[i] - pred2[i]) > 1e-10) {
+            fprintf(stderr, "roundtrip mismatch @%d\n", i);
+            return 6;
+        }
+    }
+    CHECK(LGBM_BoosterFree(bst2));
+    CHECK(LGBM_BoosterFree(bst));
+    CHECK(LGBM_DatasetFree(ds));
+    printf("C_ABI_OK iters=%d\n", cur);
+    return 0;
+}
+"""
+
+
+def test_c_program_trains_through_the_abi(tmp_path):
+    lib = native.capi_abi_lib()
+    if lib is None:
+        pytest.skip("C toolchain or libpython unavailable")
+    src = tmp_path / "main.c"
+    src.write_text(C_PROGRAM)
+    exe = str(tmp_path / "abi_demo")
+    libdir = os.path.dirname(lib)
+    r = subprocess.run(
+        ["gcc", "-O1", str(src), f"-L{libdir}",
+         f"-l:{os.path.basename(lib)}", f"-Wl,-rpath,{libdir}", "-lm",
+         "-o", exe], capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+
+    site = sysconfig.get_paths()["purelib"]
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [repo, site] + env.get("PYTHONPATH", "").split(os.pathsep))
+    env["JAX_PLATFORMS"] = "cpu"
+    model = str(tmp_path / "abi_model.txt")
+    r = subprocess.run([exe, model], capture_output=True, text=True,
+                       env=env, timeout=300)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert "C_ABI_OK iters=10" in r.stdout
+
+    # the C-trained model is a normal reference-format model file: the
+    # Python API loads it straight back
+    import lightgbm_tpu as lgb
+    bst = lgb.Booster(model_file=model)
+    assert bst.current_iteration() == 10

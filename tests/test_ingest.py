@@ -191,7 +191,6 @@ def test_libsvm_qid_native_matches_python(tmp_path):
     np.testing.assert_array_equal(group, [2, 2])  # qid run lengths
     np.testing.assert_allclose(X.toarray()[0], [0, 0.5, 0, 0, 1.25])
     # python fallback parses identically
-    import os as _os
     import lightgbm_tpu.native as _native
     old_lib, old_tried = _native._lib, _native._tried
     _native._lib, _native._tried = None, True
@@ -230,3 +229,26 @@ def test_libsvm_qid_trains_lambdarank(tmp_path):
                     valid_sets=[ds], valid_names=["t"])
     res = bst.eval_train()
     assert any("ndcg" in m for (_, m, v, _) in res)
+
+
+def test_libsvm_predict_file_narrower_than_model(tmp_path):
+    """A prediction LibSVM file whose highest feature indices are absent
+    must pad implicit-zero columns to the model's feature count (the
+    reference pads the same way) instead of mis-indexing."""
+    import scipy.sparse as sp
+    import lightgbm_tpu as lgb
+    rng = np.random.default_rng(0)
+    n = 300
+    X = rng.normal(size=(n, 6))
+    y = (X[:, 5] > 0).astype(float)  # the LAST feature carries signal
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "min_data_in_leaf": 5, "verbose": -1},
+                    lgb.Dataset(X, label=y), num_boost_round=5)
+    # prediction rows only mention features 0..2 -> CSR with 3 columns
+    Xp = sp.csr_matrix(np.hstack([X[:20, :3]]))
+    out = bst.predict(Xp)
+    assert out.shape == (20,)
+    # equivalent dense rows (features 3..5 = 0) give identical output
+    dense = np.zeros((20, 6))
+    dense[:, :3] = X[:20, :3]
+    np.testing.assert_allclose(out, bst.predict(dense), atol=1e-12)
