@@ -53,7 +53,9 @@ def dataset_create_from_file(filename: str, parameters: str,
 def dataset_create_from_mat(data_addr: int, data_type: int, nrow: int,
                             ncol: int, is_row_major: int, parameters: str,
                             ref_handle: int, out_addr: int) -> int:
-    data = _typed_view(data_addr, int(nrow) * int(ncol), data_type)
+    # COPY: the dataset outlives this call and the reference contract
+    # lets the C caller free its buffer immediately after it returns
+    data = _typed_view(data_addr, int(nrow) * int(ncol), data_type).copy()
     return capi.LGBM_DatasetCreateFromMat(
         data, data_type, nrow, ncol, is_row_major, parameters,
         int(ref_handle) or None, _i64_slot(out_addr))
@@ -61,7 +63,8 @@ def dataset_create_from_mat(data_addr: int, data_type: int, nrow: int,
 
 def dataset_set_field(handle: int, name: str, data_addr: int,
                       num_element: int, dtype_code: int) -> int:
-    view = _typed_view(data_addr, num_element, dtype_code)
+    # COPY: fields are retained by the dataset (see dataset_create_from_mat)
+    view = _typed_view(data_addr, num_element, dtype_code).copy()
     return capi.LGBM_DatasetSetField(int(handle), name, view, num_element,
                                      dtype_code)
 

@@ -249,6 +249,8 @@ def capi_abi_lib() -> Optional[str]:
     CPython; programs linking it need PYTHONPATH to resolve lightgbm_tpu
     and its dependencies."""
     import sysconfig
+    if os.environ.get("LIGHTGBM_TPU_NO_NATIVE"):
+        return None
     src = os.path.join(_DIR, "capi_abi.c")
     try:
         with open(src, "rb") as f:

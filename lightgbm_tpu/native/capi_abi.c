@@ -15,13 +15,17 @@
  * The embedding interpreter resolves lightgbm_tpu + jax via PYTHONPATH.
  */
 #include <Python.h>
+#include <pthread.h>
 #include <stdarg.h>
 #include <stdint.h>
 #include <string.h>
 
 static PyObject *g_bridge = NULL;
-static char g_err[4096] = "lightgbm_tpu C ABI: not initialized";
-static volatile int g_err_native = 1;  /* g_err holds the live error */
+static pthread_mutex_t g_init_lock = PTHREAD_MUTEX_INITIALIZER;
+/* thread-local, matching the reference's thread-local last-error
+ * (c_api.cpp): concurrent marshalling failures never cross-wire */
+static __thread char g_err[4096] = "lightgbm_tpu C ABI: not initialized";
+static __thread int g_err_native = 1;  /* g_err holds the live error */
 
 static void capture_pyerr(const char *where) {
     PyObject *etype = NULL, *eval = NULL, *etb = NULL;
@@ -40,6 +44,13 @@ static void capture_pyerr(const char *where) {
 
 static int ensure(void) {
     if (g_bridge) return 0;
+    /* serialize first-call init: a second thread running
+     * PyEval_SaveThread without the GIL is a CPython fatal abort */
+    pthread_mutex_lock(&g_init_lock);
+    if (g_bridge) {
+        pthread_mutex_unlock(&g_init_lock);
+        return 0;
+    }
     if (!Py_IsInitialized()) {
         Py_InitializeEx(0);
         /* release the GIL the init acquired, or every other thread's
@@ -53,10 +64,12 @@ static int ensure(void) {
         capture_pyerr("import lightgbm_tpu.capi_embed "
                       "(is PYTHONPATH set to the package root?)");
         PyGILState_Release(st);
+        pthread_mutex_unlock(&g_init_lock);
         return -1;
     }
     g_bridge = m;
     PyGILState_Release(st);
+    pthread_mutex_unlock(&g_init_lock);
     return 0;
 }
 
