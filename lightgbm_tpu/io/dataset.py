@@ -234,16 +234,12 @@ class BinnedDataset:
             from ..parallel.distributed import global_bin_sample
             sample, n_global = global_bin_sample(sample, ds.num_data)
         else:
-            # sparse samples are not pooled cross-host yet — divergent
-            # per-process mappers would silently corrupt distributed
-            # training, so refuse loudly instead
-            import jax
-            if jax.process_count() > 1:
-                log.fatal("multi-host bin finding from sparse input is "
-                          "not supported; load from files or dense "
-                          "matrices, or construct on one host and share "
-                          "the dataset binary")
-            n_global = ds.num_data
+            # multi-host sparse: pool the samples as COO triplets so
+            # every process derives identical mappers (no densifying)
+            from ..parallel.distributed import global_bin_sample_sparse
+            sample_csc, n_global = global_bin_sample_sparse(
+                sample_csc, ds.num_data)
+            sample = sample_csc
 
         from ..utils.timetag import timetag
         cat_set = set(int(c) for c in categorical_features)

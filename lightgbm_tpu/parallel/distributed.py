@@ -163,6 +163,38 @@ def shutdown() -> None:
     _mesh.NETWORK.update(machines="", num_machines=1, rank=0)
 
 
+def _runtime_active() -> bool:
+    """True when a multi-host runtime is up — via init_distributed OR an
+    external jax.distributed.initialize (an embedding launcher).  Reads
+    jax's distributed state directly so a wedged accelerator backend is
+    never touched on the single-host fast path."""
+    if _initialized:
+        return True
+    try:
+        from jax._src.distributed import global_state
+        return global_state.client is not None
+    except Exception:  # noqa: BLE001 - private API moved; fail closed
+        return False
+
+
+def _allgather_exact(arr):
+    """process_allgather that survives jax's default 32-bit dtype
+    truncation: 64-bit payloads ride as uint32 pairs (bit-exact), so
+    pooled bin-finding samples are NOT silently rounded to float32.
+    Returns a numpy array with a leading process axis."""
+    import numpy as np
+
+    import jax.numpy as jnp
+    from jax.experimental import multihost_utils
+
+    a = np.ascontiguousarray(arr)
+    if a.dtype.itemsize == 8:
+        u = a.view(np.uint32)
+        g = np.asarray(multihost_utils.process_allgather(jnp.asarray(u)))
+        return g.view(a.dtype)
+    return np.asarray(multihost_utils.process_allgather(jnp.asarray(a)))
+
+
 def global_bin_sample(sample, num_local_rows=None):
     """Distributed bin finding: make every host derive IDENTICAL bin
     mappers by gathering all hosts' bin-finding row samples before
@@ -182,24 +214,66 @@ def global_bin_sample(sample, num_local_rows=None):
 
     if num_local_rows is None:
         num_local_rows = len(sample)
-    if not _initialized:
+    if not _runtime_active():
         return sample, int(num_local_rows)
     import jax
 
     if jax.process_count() <= 1:
         return sample, int(num_local_rows)
-    import jax.numpy as jnp
-    from jax.experimental import multihost_utils
 
     n, f = sample.shape
-    counts = np.asarray(multihost_utils.process_allgather(
-        jnp.asarray([n, int(num_local_rows)], jnp.int64))).reshape(-1, 2)
+    counts = _allgather_exact(
+        np.asarray([n, int(num_local_rows)], np.int64)).reshape(-1, 2)
     m = int(counts[:, 0].max())
-    padded = np.full((m, f), np.nan, dtype=sample.dtype)
+    padded = np.full((m, f), np.nan, dtype=np.float64)
     padded[:n] = sample
-    gathered = np.asarray(multihost_utils.process_allgather(
-        jnp.asarray(padded)))
-    gathered = gathered.reshape(len(counts), m, f)
+    gathered = _allgather_exact(padded).reshape(len(counts), m, f)
     pooled = np.concatenate([gathered[p, :counts[p, 0]]
                              for p in range(len(counts))])
     return pooled.astype(sample.dtype), int(counts[:, 1].sum())
+
+
+def global_bin_sample_sparse(sample_csc, num_local_rows: int):
+    """Sparse analog of ``global_bin_sample``: pool every host's
+    bin-finding sample as COO triplets (rows offset by cumulative host
+    row counts) so all processes derive identical mappers from sparse
+    input without densifying.  No-op outside an initialized multi-host
+    runtime.  Returns ``(pooled_csc, global_num_rows)``."""
+    import numpy as np
+
+    if not _runtime_active():
+        return sample_csc, int(num_local_rows)
+    import jax
+
+    if jax.process_count() <= 1:
+        return sample_csc, int(num_local_rows)
+    import scipy.sparse as sp
+
+    coo = sample_csc.tocoo()
+    n, f = coo.shape
+    meta = _allgather_exact(np.asarray(
+        [n, coo.nnz, int(num_local_rows), f], np.int64)).reshape(-1, 4)
+    log.check(int(meta[:, 3].max()) == int(meta[:, 3].min()),
+              "hosts disagree on the sparse sample's feature count")
+    m = int(meta[:, 1].max())
+
+    # one payload gather: (row, col, value) stacked as f64 [3, m] —
+    # indices are exact in f64 far beyond any sample size
+    buf = np.zeros((3, m), np.float64)
+    buf[0, :coo.nnz] = coo.row
+    buf[1, :coo.nnz] = coo.col
+    buf[2, :coo.nnz] = coo.data
+    g = _allgather_exact(buf).reshape(len(meta), 3, m)
+
+    row_off = np.concatenate([[0], np.cumsum(meta[:-1, 0])])
+    rows, cols, vals = [], [], []
+    for p in range(len(meta)):
+        k = int(meta[p, 1])
+        rows.append(g[p, 0, :k].astype(np.int64) + row_off[p])
+        cols.append(g[p, 1, :k].astype(np.int64))
+        vals.append(g[p, 2, :k])
+    pooled = sp.coo_matrix(
+        (np.concatenate(vals),
+         (np.concatenate(rows), np.concatenate(cols))),
+        shape=(int(meta[:, 0].sum()), f)).tocsc()
+    return pooled, int(meta[:, 2].sum())

@@ -55,8 +55,38 @@ y = (X[:, 0] + X[:, 1] * X[:, 2] > 0).astype(np.float64)
 sample = X[rank::2]  # each rank contributes a different half
 pooled, total = global_bin_sample(sample, num_local_rows=len(sample))
 assert total == n, total
-np.testing.assert_allclose(pooled, np.concatenate([X[0::2], X[1::2]]))
+# bit-exact: the gather rides as uint32 pairs, no f32 truncation
+np.testing.assert_array_equal(pooled, np.concatenate([X[0::2], X[1::2]]))
 result["pooled_rows"] = int(pooled.shape[0])
+
+# sparse pooling: same halves as CSC triplets -> identical pooled matrix
+import scipy.sparse as sp  # noqa: E402
+
+from lightgbm_tpu.parallel.distributed import (  # noqa: E402
+    global_bin_sample_sparse)
+
+Xs = X.copy()
+Xs[Xs < 0.5] = 0.0  # sparsify deterministically
+pooled_sp, total_sp = global_bin_sample_sparse(
+    sp.csc_matrix(Xs[rank::2]), num_local_rows=len(sample))
+assert total_sp == n, total_sp
+np.testing.assert_array_equal(
+    pooled_sp.toarray(), np.concatenate([Xs[0::2], Xs[1::2]]))
+result["pooled_sparse_nnz"] = int(pooled_sp.nnz)
+
+# and the full sparse construction path derives identical mappers on
+# both ranks (each builds from ITS OWN half-sample; pooling makes the
+# result global) — fingerprinted below for the parent to cross-check
+from lightgbm_tpu.config import Config as _Cfg  # noqa: E402
+from lightgbm_tpu.io.dataset import BinnedDataset  # noqa: E402
+
+h_sp = BinnedDataset.from_sample(
+    sp.csc_matrix(Xs[rank::2]), n, _Cfg.from_params(
+        {"verbose": -1, "max_bin": 31}))
+result["sparse_bin_offsets"] = np.asarray(h_sp.bin_offsets).tolist()
+result["sparse_bounds_fp"] = [
+    round(float(np.asarray(m.bin_upper_bound)[:-1].sum()), 9)
+    for m in h_sp.bin_mappers]
 
 # ---- 3. data-parallel boosting over the 2-process mesh ---------------
 import jax.numpy as jnp  # noqa: E402

@@ -127,6 +127,11 @@ def test_two_process_data_parallel_bitmatch(tmp_path):
     assert all(r["ok"] for r in res)
     assert all(r["global_devices"] == 2 for r in res)
     assert all(r["pooled_rows"] == 512 for r in res)
+    # sparse sample pooling: both ranks pooled to the same matrix AND
+    # derived IDENTICAL bin mappers from their different half-samples
+    assert res[0]["pooled_sparse_nnz"] == res[1]["pooled_sparse_nnz"] > 0
+    assert res[0]["sparse_bin_offsets"] == res[1]["sparse_bin_offsets"]
+    assert res[0]["sparse_bounds_fp"] == res[1]["sparse_bounds_fp"]
     # both ranks saw identical data-parallel trees (replicated outputs)
     assert res[0]["dp_trees"] == res[1]["dp_trees"]
     # the cross-process psum'd training matches the serial oracle:
