@@ -173,8 +173,12 @@ def _runtime_active() -> bool:
     try:
         from jax._src.distributed import global_state
         return global_state.client is not None
-    except Exception:  # noqa: BLE001 - private API moved; fail closed
-        return False
+    except Exception:  # noqa: BLE001
+        # private API moved: fall back to the public (backend-initializing)
+        # check — skipping pooling in a real multi-host run would silently
+        # diverge the mappers, which is far worse than a slow probe
+        import jax
+        return jax.process_count() > 1
 
 
 def _allgather_exact(arr):
@@ -225,7 +229,11 @@ def global_bin_sample(sample, num_local_rows=None):
     counts = _allgather_exact(
         np.asarray([n, int(num_local_rows)], np.int64)).reshape(-1, 2)
     m = int(counts[:, 0].max())
-    padded = np.full((m, f), np.nan, dtype=np.float64)
+    # keep the sample's own float width: f32 samples gather at half the
+    # traffic and are already bit-exact on the 4-byte path
+    dt = (sample.dtype if np.issubdtype(sample.dtype, np.floating)
+          else np.float64)
+    padded = np.full((m, f), np.nan, dtype=dt)
     padded[:n] = sample
     gathered = _allgather_exact(padded).reshape(len(counts), m, f)
     pooled = np.concatenate([gathered[p, :counts[p, 0]]

@@ -75,13 +75,14 @@ np.testing.assert_array_equal(
 result["pooled_sparse_nnz"] = int(pooled_sp.nnz)
 
 # and the full sparse construction path derives identical mappers on
-# both ranks (each builds from ITS OWN half-sample; pooling makes the
-# result global) — fingerprinted below for the parent to cross-check
+# both ranks (each builds from ITS OWN half-sample + LOCAL row count;
+# pooling makes the result global) — fingerprinted for the parent, which
+# also compares them against a single-host oracle built from the full Xs
 from lightgbm_tpu.config import Config as _Cfg  # noqa: E402
 from lightgbm_tpu.io.dataset import BinnedDataset  # noqa: E402
 
 h_sp = BinnedDataset.from_sample(
-    sp.csc_matrix(Xs[rank::2]), n, _Cfg.from_params(
+    sp.csc_matrix(Xs[rank::2]), len(Xs[rank::2]), _Cfg.from_params(
         {"verbose": -1, "max_bin": 31}))
 result["sparse_bin_offsets"] = np.asarray(h_sp.bin_offsets).tolist()
 result["sparse_bounds_fp"] = [

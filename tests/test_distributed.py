@@ -132,6 +132,24 @@ def test_two_process_data_parallel_bitmatch(tmp_path):
     assert res[0]["pooled_sparse_nnz"] == res[1]["pooled_sparse_nnz"] > 0
     assert res[0]["sparse_bin_offsets"] == res[1]["sparse_bin_offsets"]
     assert res[0]["sparse_bounds_fp"] == res[1]["sparse_bounds_fp"]
+    # ...and they match a SINGLE-HOST oracle built from the full matrix
+    # (catches symmetric pooling bugs both ranks would share)
+    import scipy.sparse as sp
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import BinnedDataset
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(512, 5))
+    Xs = X.copy()
+    Xs[Xs < 0.5] = 0.0
+    Xp = np.concatenate([Xs[0::2], Xs[1::2]])  # pooled host order
+    oracle = BinnedDataset.from_sample(
+        sp.csc_matrix(Xp), 512, Config.from_params(
+            {"verbose": -1, "max_bin": 31}))
+    assert res[0]["sparse_bin_offsets"] == np.asarray(
+        oracle.bin_offsets).tolist()
+    fp = [round(float(np.asarray(m.bin_upper_bound)[:-1].sum()), 9)
+          for m in oracle.bin_mappers]
+    assert res[0]["sparse_bounds_fp"] == fp
     # both ranks saw identical data-parallel trees (replicated outputs)
     assert res[0]["dp_trees"] == res[1]["dp_trees"]
     # the cross-process psum'd training matches the serial oracle:

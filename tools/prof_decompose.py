@@ -24,6 +24,9 @@ from lightgbm_tpu.ops import pallas_hist
 from lightgbm_tpu.core import wave_grower
 
 ROWS = int(os.environ.get("PROF_ROWS", 1_000_000))
+# PROF_INTERPRET=1: run the Pallas kernel in interpreter mode so the
+# script itself can be smoke-tested on CPU between TPU windows
+INTERP = os.environ.get("PROF_INTERPRET", "") not in ("", "0")
 
 
 def timeit(fn, *args, n=3, warmup=1):
@@ -63,17 +66,18 @@ def main():
     leaf_id = jnp.asarray(rng.integers(0, 42, ROWS, dtype=np.int32))
     kf = jax.jit(lambda: pallas_hist.hist_pallas_wave(
         binsT, g, h, mask, leaf_id, slot_leaf, B=B, block_rows=1024,
-        highest="2xbf16"))
+        highest="2xbf16", interpret=INTERP))
     dt, _ = timeit(kf, n=10)
     print(f"kernel full pass:    {dt*1e3:8.1f} ms", flush=True)
 
     variants = {}
     grow_full = jax.jit(wave_grower.build_wave_grow_fn(
-        meta, scfg, B, wave_capacity=42, highest="2xbf16", gain_gate=0.5))
+        meta, scfg, B, wave_capacity=42, highest="2xbf16", gain_gate=0.5,
+        interpret=INTERP))
     variants["full"] = grow_full
     grow_nc = jax.jit(wave_grower.build_wave_grow_fn(
         meta, scfg, B, wave_capacity=42, highest="2xbf16", gain_gate=0.5,
-        compact=False))
+        compact=False, interpret=INTERP))
     variants["nocompact"] = grow_nc
 
     # stub the kernel: same signature/shape, no MXU work
@@ -98,7 +102,8 @@ def main():
 
     wave_grower.hist_pallas_wave = stub
     grow_nk = jax.jit(wave_grower.build_wave_grow_fn(
-        meta, scfg, B, wave_capacity=42, highest="2xbf16", gain_gate=0.5))
+        meta, scfg, B, wave_capacity=42, highest="2xbf16", gain_gate=0.5,
+        interpret=INTERP))
     # trace/compile NOW, while the stub is installed — the closure looks
     # hist_pallas_wave up late-bound at trace time
     jax.block_until_ready(grow_nk(binsT, g, h, mask, fmask)[1])
