@@ -37,6 +37,18 @@ REF_ROW_ITERS_PER_SEC = 10_500_000 * 500 / 238.5  # 2.2013e7
 REF_RANK_ROW_ITERS_PER_SEC = 2_270_296 * 500 / 215.32
 
 
+def _telemetry_digest():
+    """Machine-readable telemetry summary for the JSON line, when the run
+    had LGBM_TPU_TELEMETRY / tpu_telemetry active; None otherwise."""
+    try:
+        from lightgbm_tpu import obs
+        if obs.enabled():
+            return obs.digest()
+    except Exception:  # telemetry must never cost the bench its number
+        pass
+    return None
+
+
 def _rank_data(rows: int):
     """MSLR-shaped synthetic: ragged queries (1..1251 docs, mean ~72),
     136 features, graded 0-4 relevance correlated with a feature blend."""
@@ -186,6 +198,9 @@ def main() -> None:
         if backend_tag is not None:
             rr["backend"] = backend_tag
             rr["note"] = "CPU numbers at reduced size — NOT the TPU result"
+        td = _telemetry_digest()
+        if td is not None:
+            rr["telemetry"] = td
         print(json.dumps(rr))
         return
     X, y = _load_data(rows)
@@ -244,6 +259,9 @@ def main() -> None:
             })
         except Exception as exc:  # rank failure must not lose the main number
             result["rank_error"] = f"{type(exc).__name__}: {exc}"[:200]
+    td = _telemetry_digest()
+    if td is not None:
+        result["telemetry"] = td
     print(json.dumps(result))
 
 

@@ -204,6 +204,12 @@ def main(argv=None) -> None:
     argv = sys.argv[1:] if argv is None else argv
     params = _parse_args(argv)
     cfg = Config.from_params(params)
+    if cfg.tpu_telemetry:
+        # enable before any data loads so dataset-construction phases
+        # (bin finding, binarize) land in the telemetry too — the param
+        # analog of setting LGBM_TPU_TELEMETRY before the process starts
+        from . import obs
+        obs.enable(cfg.tpu_telemetry)
     task = cfg.task
     if task == "train":
         run_train(cfg, params)

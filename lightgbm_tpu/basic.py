@@ -474,6 +474,16 @@ class Booster:
                 data, categorical_feature=None,
                 pandas_categorical=self.pandas_categorical)
         elif _is_scipy_sparse(data):
+            if data.shape[1] > self.num_feature():
+                # reject BEFORE the block-wise densify below — a too-wide
+                # matrix means the caller's feature space is not the
+                # model's (the reference C API fails the same way:
+                # 'The number of features in data ... is not the same as
+                # it was in training data')
+                raise LightGBMError(
+                    f"The number of features in data ({data.shape[1]}) is "
+                    f"not the same as it was in training data "
+                    f"({self.num_feature()})")
             if data.shape[1] < self.num_feature():
                 # LibSVM-style input sizes by the max feature index
                 # PRESENT; pad implicit-zero columns up to the model's
@@ -500,6 +510,16 @@ class Booster:
             mat = _to_matrix(data)
         else:
             mat = _to_matrix(data)
+        # sparse input was padded to the model width above (LibSVM-style
+        # narrower matrices); anything else must match exactly — the
+        # reference C API raises the same error both directions, and a
+        # narrower dense matrix would otherwise die in an IndexError
+        # deep inside binning
+        if mat.shape[1] != self.num_feature():
+            raise LightGBMError(
+                f"The number of features in data ({mat.shape[1]}) is not "
+                f"the same as it was in training data "
+                f"({self.num_feature()})")
         if num_iteration is None:
             num_iteration = self.best_iteration if self.best_iteration > 0 else -1
         if pred_leaf:

@@ -13,10 +13,12 @@ Correspondence to the reference:
 """
 from __future__ import annotations
 
+import time
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from .. import obs
 from ..config import Config
 from ..core.grower import TreeArrays, make_grower
 from ..core.meta import SplitConfig, build_device_meta
@@ -203,6 +205,10 @@ class GBDT(PredictorBase):
     # (DART) must keep the synchronous per-iteration stop check
     _lag_stop = True
 
+    # subclasses whose train loop unpacks self._grow as (tree, leaf_id)
+    # directly (RF) opt out of the telemetry wave-count third output
+    _telemetry_waves = True
+
     def __init__(self):
         self.models: List[Tree] = _TreeList(self)
         self._has_deferred = False
@@ -226,6 +232,12 @@ class GBDT(PredictorBase):
     # ------------------------------------------------------------------
     def init(self, config: Config, train_ds, objective, metrics) -> None:
         import jax.numpy as jnp
+
+        # telemetry sink from the parameter surface (the env var
+        # LGBM_TPU_TELEMETRY was handled at obs import); must precede
+        # _init_grower so the wave grower can build its pass counter in
+        if getattr(config, "tpu_telemetry", ""):
+            obs.enable(config.tpu_telemetry)
 
         self.config = config
         self.train_ds = train_ds
@@ -261,6 +273,15 @@ class GBDT(PredictorBase):
             objective.class_need_train(k) if objective is not None else True
             for k in range(K)]
         self._jit_helpers()
+        self._telem_iters = 0
+        self._telem_train_s = 0.0
+        if obs.enabled():
+            obs.event("train_start", num_data=N,
+                      num_features=train_ds.num_features, num_class=K,
+                      num_leaves=self.split_cfg.num_leaves,
+                      tree_learner=getattr(config, "tree_learner", "serial"),
+                      wave=self.uses_wave,
+                      objective=getattr(objective, "name", None))
 
     def _init_grower(self, config: Config, train_ds) -> None:
         """Select the tree-growth engine — the TreeLearner factory analog
@@ -274,6 +295,7 @@ class GBDT(PredictorBase):
         import jax.numpy as jnp
 
         self._raw_cached = False  # set True when _grow_raw is _JIT_CACHE'd
+        self._report_waves = False  # wave grower emits its pass count
 
         # ---- CEGB (reference: cost_effective_gradient_boosting.hpp) -----
         self._cegb_on = False
@@ -415,6 +437,12 @@ class GBDT(PredictorBase):
         if self.uses_wave:
             from ..core.wave_grower import build_wave_grow_fn
 
+            # telemetry: have the wave grower count its kernel passes so
+            # per-iteration records carry the wave count (report_waves and
+            # cegb both add a third output — cegb wins when both apply)
+            self._report_waves = (obs.enabled() and cegb_cfg is None
+                                  and self._telemetry_waves)
+
             def build_wave():
                 return build_wave_grow_fn(
                     self.meta, self.split_cfg, self.B,
@@ -423,7 +451,8 @@ class GBDT(PredictorBase):
                     gain_gate=float(config.tpu_wave_gain_gate),
                     block_rows=int(config.tpu_block_rows),
                     B_phys=self.B_phys, bundled=self._bundled,
-                    cegb=cegb_cfg, mixed=mixed_info)
+                    cegb=cegb_cfg, mixed=mixed_info,
+                    report_waves=self._report_waves)
             if cegb_cfg is None:
                 mixed_key = (None if mixed_info is None else
                              (mixed_info.narrow_idx.tobytes(),
@@ -434,7 +463,8 @@ class GBDT(PredictorBase):
                        int(config.tpu_wave_capacity),
                        self._hist_mode(config),
                        float(config.tpu_wave_gain_gate),
-                       int(config.tpu_block_rows), mixed_key)
+                       int(config.tpu_block_rows), mixed_key,
+                       self._report_waves)
                 self._grow_raw = _cached_jit(key, build_wave)
                 self._raw_cached = True
             else:
@@ -567,6 +597,7 @@ class GBDT(PredictorBase):
 
         grow_raw = self._grow_raw
         bynode_on = getattr(self, "_bynode_on", False)
+        report_waves = getattr(self, "_report_waves", False)
 
         def build_grow_apply():
             @functools.partial(jax.jit, static_argnames=("k",))
@@ -581,12 +612,17 @@ class GBDT(PredictorBase):
                 overlap the device->host fetch instead of serializing on
                 it."""
                 if bynode_on:
-                    arrs, leaf_id = grow_raw(bins, g[:, k], h[:, k],
-                                             bag_mask, feature_mask,
-                                             tree_seed=seed)
+                    res = grow_raw(bins, g[:, k], h[:, k],
+                                   bag_mask, feature_mask,
+                                   tree_seed=seed)
                 else:
-                    arrs, leaf_id = grow_raw(bins, g[:, k], h[:, k],
-                                             bag_mask, feature_mask)
+                    res = grow_raw(bins, g[:, k], h[:, k],
+                                   bag_mask, feature_mask)
+                if report_waves:
+                    arrs, leaf_id, n_waves = res
+                else:
+                    arrs, leaf_id = res
+                    n_waves = jnp.int32(-1)  # sentinel: not counted
                 grew = arrs.num_leaves > 1
                 lv = jnp.where(grew, arrs.leaf_value * lr, 0.0)
                 arrs = arrs._replace(
@@ -594,12 +630,13 @@ class GBDT(PredictorBase):
                     internal_value=jnp.where(grew,
                                              arrs.internal_value * lr, 0.0))
                 new_score = score.at[:, k].add(lv[leaf_id])
-                return arrs, leaf_id, new_score
+                return arrs, leaf_id, new_score, n_waves
             return grow_apply
 
         if getattr(self, "_raw_cached", False):
             self._grow_apply = _cached_jit(
-                ("grow_apply", id(grow_raw), bynode_on), build_grow_apply)
+                ("grow_apply", id(grow_raw), bynode_on, report_waves),
+                build_grow_apply)
         else:
             self._grow_apply = build_grow_apply()
 
@@ -860,6 +897,18 @@ class GBDT(PredictorBase):
 
         from ..utils.timetag import sync, timetag
 
+        # Telemetry snapshots for the per-iteration record.  Everything in
+        # the telem branches costs device syncs / metric evals, so it is
+        # gated hard: with no sink configured this is one bool check.
+        telem = obs.enabled()
+        if telem:
+            t_iter0 = time.perf_counter()
+            phase0 = obs.phase_snapshot()
+            compiles0 = obs.counter_value("jax/compiles")
+            compile_s0 = obs.counter_value("jax/compile_s")
+            leaves_grown: List[int] = []
+            waves_total = None
+
         init_scores = [0.0] * K
         if gradients is None or hessians is None:
             for k in range(K):
@@ -895,6 +944,7 @@ class GBDT(PredictorBase):
         cur_grown = []
         for k in range(K):
             tree = None
+            n_waves_dev = None
             if self.class_need_train[k] and self.train_ds.num_features > 0:
                 if slow_path:
                     # slow path: leaf refit needs host residuals between
@@ -910,16 +960,19 @@ class GBDT(PredictorBase):
                     if self._cegb_on:
                         arrs, leaf_id = res[0], res[1]
                         self._cegb_state = list(res[2:])
+                    elif getattr(self, "_report_waves", False):
+                        arrs, leaf_id, n_waves_dev = res
                     else:
                         arrs, leaf_id = res
                     nl = int(arrs.num_leaves)
                 else:
                     with timetag("tree growth"):
-                        arrs, leaf_id, new_score = self._grow_apply(
-                            self._grow_bins, g, h, self._bag_mask,
-                            feature_mask, self._train_score,
-                            jnp.float32(self.shrinkage_rate), k,
-                            seed=jnp.uint32(self.iter_ * K + k))
+                        arrs, leaf_id, new_score, n_waves_dev = \
+                            self._grow_apply(
+                                self._grow_bins, g, h, self._bag_mask,
+                                feature_mask, self._train_score,
+                                jnp.float32(self.shrinkage_rate), k,
+                                seed=jnp.uint32(self.iter_ * K + k))
                         sync(new_score)
                     if lag_ok:
                         nl_dev = arrs.num_leaves
@@ -970,6 +1023,15 @@ class GBDT(PredictorBase):
                         for i in range(len(self._valid_scores)):
                             self._valid_scores[i] = self._valid_scores[i].at[:, k].add(output)
                 tree = _constant_tree(output)
+            if telem:
+                # the telemetry path already synced this class's update, so
+                # the scalar leaf-count / wave-count reads are cheap D2H
+                leaves_grown.append(1 if arrs is None
+                                    else int(arrs.num_leaves))
+                if n_waves_dev is not None:
+                    w = int(n_waves_dev)
+                    if w >= 0:
+                        waves_total = (waves_total or 0) + w
             self.models.append(tree)
         self._model_version += 1
 
@@ -978,6 +1040,9 @@ class GBDT(PredictorBase):
             if prev_dead:
                 log.warning("Stopped training because there are no more "
                             "leaves that meet the split requirements")
+                if telem:
+                    obs.event("train_stop", iteration=self.iter_,
+                              reason="no_splits")
                 return True
             self._pending_nl = pend_nl
 
@@ -986,9 +1051,53 @@ class GBDT(PredictorBase):
                         "that meet the split requirements")
             if len(self.models) > K:
                 del self.models[-K:]
+            if telem:
+                obs.event("train_stop", iteration=self.iter_,
+                          reason="no_splits")
             return True
+        if telem:
+            self._emit_iteration_record(t_iter0, phase0, compiles0,
+                                        compile_s0, leaves_grown,
+                                        waves_total)
         self.iter_ += 1
         return False
+
+    def _emit_iteration_record(self, t_iter0, phase0, compiles0, compile_s0,
+                               leaves, waves) -> None:
+        """One structured telemetry record per boosting iteration: phase
+        timings, train/valid metric values, counter snapshots, cumulative
+        throughput, and a retrace warning when a steady-state iteration
+        compiled."""
+        obs.sync(self._train_score)
+        iter_s = time.perf_counter() - t_iter0
+        self._telem_iters = getattr(self, "_telem_iters", 0) + 1
+        self._telem_train_s = getattr(self, "_telem_train_s", 0.0) + iter_s
+        metrics = {}
+        for ds_name, mname, value, _ in self.eval_results():
+            metrics[f"{ds_name}.{mname}"] = float(value)
+        recompiles = int(obs.counter_value("jax/compiles") - compiles0)
+        N = self.train_ds.num_data
+        obs.event(
+            "iteration",
+            iteration=self.iter_,
+            num_class=self.num_tpi,
+            leaves=leaves,
+            waves=waves,
+            iter_s=round(iter_s, 6),
+            phase_s=obs.phase_delta(phase0),
+            metrics=metrics,
+            counters=obs.counters_snapshot(),
+            recompiles=recompiles,
+            cum_row_iters_per_s=round(
+                N * self._telem_iters / max(self._telem_train_s, 1e-9), 1))
+        if recompiles > 0 and self.iter_ >= 2:
+            # iterations 0-1 legitimately compile (growers, lag-path
+            # helpers); later retraces mean shape / static-arg churn
+            log.warning(
+                "iteration %d triggered %d XLA recompilation(s) (%.1fs) — "
+                "unexpected retrace, look for changing shapes or static "
+                "arguments", self.iter_, recompiles,
+                float(obs.counter_value("jax/compile_s") - compile_s0))
 
     def _resolve_pending_stop(self, current=None) -> bool:
         """Resolve the lag-1 stop check: if NO class split in the previous

@@ -16,6 +16,10 @@ from .gbdt import GBDT, K_EPSILON, _constant_tree
 class RF(GBDT):
     average_output = True
 
+    # RF's train loop unpacks self._grow as (tree, leaf_id) directly —
+    # keep the grower two-output even when telemetry is on
+    _telemetry_waves = False
+
     def init(self, config, train_ds, objective, metrics) -> None:
         if not (config.bagging_freq > 0 and 0.0 < config.bagging_fraction < 1.0):
             log.fatal("RF mode requires bagging "

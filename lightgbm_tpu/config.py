@@ -301,6 +301,10 @@ class Config:
                                         # ready gain (1 = strict best-first
                                         # order, 0 = max wave throughput)
     tpu_mesh_shape: str = ""            # e.g. "data:8" or "data:4,feature:2"
+    tpu_telemetry: str = ""             # structured-telemetry sink: a dir
+                                        # (telemetry.{proc}.jsonl inside) or
+                                        # a .jsonl path; same switch as the
+                                        # LGBM_TPU_TELEMETRY env var
 
     # ---- derived (not user-settable) ----
     is_parallel: bool = dataclasses.field(default=False, repr=False)

@@ -84,6 +84,7 @@ def forest_predict_fn(meta: DeviceMeta, K: int, early_stop: Optional[dict] = Non
 
     from .predict import predict_leaf_bins
 
+    @jax.named_scope("lgbm/forest_predict")
     def predict(forest: ForestArrays, bins):
         N = bins.shape[0]
         score0 = jnp.zeros((N, K), jnp.float32)

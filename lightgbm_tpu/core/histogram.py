@@ -32,6 +32,7 @@ def _chunk_rows(F: int, B: int) -> int:
 
 
 @functools.partial(jax.jit, static_argnames=("B",))
+@jax.named_scope("lgbm/hist_onehot")
 def hist_onehot(bins, g, h, mask, B: int):
     """Dense histogram via chunked one-hot contraction.
 
@@ -82,6 +83,7 @@ def _hist_block(bins, ghc, B: int):
 
 
 @functools.partial(jax.jit, static_argnames=("B",))
+@jax.named_scope("lgbm/hist_scatter")
 def hist_scatter(bins, g, h, mask, B: int):
     """Scatter-add histogram for VERY wide physical layouts (wide-sparse
     EFB datasets): cost O(N*F) instead of the one-hot path's O(N*F*B).
@@ -123,6 +125,7 @@ def hist_scatter(bins, g, h, mask, B: int):
 
 
 @functools.partial(jax.jit, static_argnames=("B",))
+@jax.named_scope("lgbm/hist_wave_xla")
 def hist_wave_xla(bins_rm, gv, hv, cv, leaf_id, slot_leaf, B: int):
     """XLA analog of ``ops.pallas_hist.hist_pallas_wave`` for WIDE
     (>256-bin) features — the side-pass of the mixed-width wave path.

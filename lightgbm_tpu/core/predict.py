@@ -15,6 +15,7 @@ from .meta import DeviceMeta
 from .splitter import bitset_contains
 
 
+@jax.named_scope("lgbm/tree_traverse")
 def predict_leaf_bins(tree: TreeArrays, bins, meta: DeviceMeta,
                       phys: bool = False):
     """Leaf index per row for binned inputs. bins: [N, F] uint8/int32.

@@ -266,6 +266,7 @@ def _cat_winner_bitset(cat: dict, f_best, B: int):
     return _pack_bitset(member, B)
 
 
+@jax.named_scope("lgbm/split_scan")
 def best_split(hist, sum_g, sum_h, cnt, meta: DeviceMeta, cfg: SplitConfig,
                min_constraint, max_constraint, feature_mask=None,
                has_cat=None, penalty_sub=None) -> BestSplit:

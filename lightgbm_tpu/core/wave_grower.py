@@ -177,6 +177,7 @@ def build_wave_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
         return jnp.where(is_wide_c[p], colw.astype(jnp.int32),
                          coln.astype(jnp.int32))
 
+    @jax.named_scope("lgbm/wave_hist")
     def _wave_hist(nb_fm, wide_rm, gvx, hvx, cvx, leafx, slot_leaf):
         """One wave's physical histogram [F_phys, B_phys, C]: Pallas kernel
         over the narrow columns (+ XLA side-pass over the wide ones when
@@ -201,6 +202,7 @@ def build_wave_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
         return bs._replace(gain=jnp.where(depth_ok, bs.gain, NEG_INF))
 
     # ---------------- split phase --------------------------------------
+    @jax.named_scope("lgbm/wave_split_phase")
     def _split_once(st: _WaveState, bins_fm, feature_mask, phase_max):
         gains = jnp.where(st.hist_ready[:L], st.best_gain[:L], NEG_INF)
         leaf = jnp.argmax(gains).astype(jnp.int32)

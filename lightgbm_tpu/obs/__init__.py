@@ -1,0 +1,28 @@
+"""Observability subsystem: structured telemetry, phase timers, JAX
+instrumentation (see ``core`` for the event/counter API, ``trace`` for
+the recompile hook, ``report`` for JSONL merging).
+
+Quick start::
+
+    LGBM_TPU_TELEMETRY=/tmp/telem python train.py
+    python tools/telemetry_report.py /tmp/telem
+
+or programmatically ``obs.enable("/tmp/telem")`` / the ``tpu_telemetry``
+parameter.  ``LGBM_TPU_TIMETAG=1`` keeps the plain phase-time report.
+"""
+from .core import (TIMETAG_ENABLED, add, count, counter_value,
+                   counters_snapshot, current_phase, digest, disable,
+                   enable, enabled, event, gauge, phase, phase_delta,
+                   phase_snapshot, record_collective,
+                   record_collective_host, report, reset, sink_path, sync,
+                   tracing_enabled)
+from .trace import compile_count, compile_seconds, install_recompile_hook
+
+__all__ = [
+    "TIMETAG_ENABLED", "add", "count", "counter_value",
+    "counters_snapshot", "current_phase", "digest", "disable", "enable",
+    "enabled", "event", "gauge", "phase", "phase_delta", "phase_snapshot",
+    "record_collective", "record_collective_host", "report", "reset",
+    "sink_path", "sync", "tracing_enabled",
+    "compile_count", "compile_seconds", "install_recompile_hook",
+]

@@ -1,0 +1,170 @@
+"""Merge telemetry JSONL files into per-phase / per-iteration summaries.
+
+Library backing for ``tools/telemetry_report.py`` (and for tests): pure
+stdlib, no jax import, so the report tool starts instantly even on a box
+without an accelerator runtime.
+"""
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+from collections import defaultdict
+from typing import List
+
+
+def telemetry_files(path: str) -> List[str]:
+    """Resolve ``path`` (a telemetry dir, a ``.jsonl`` file, or a glob)
+    to the sorted list of per-process JSONL files.  A ``base.jsonl``
+    argument also picks up the ``base.{i}.jsonl`` siblings non-zero
+    ranks write in file-sink mode (obs/core.py sink_path)."""
+    if os.path.isdir(path):
+        return sorted(glob.glob(os.path.join(path, "telemetry.*.jsonl")))
+    if path.endswith(".jsonl"):
+        sibs = glob.glob(path[:-len(".jsonl")] + ".*.jsonl")
+        out = {f for f in sibs + [path] if os.path.isfile(f)}
+        return sorted(out)
+    return sorted(glob.glob(path))
+
+
+def load_events(path: str) -> List[dict]:
+    """Parse every record from the file set; corrupt lines are counted,
+    not fatal (a crashed run may truncate its last record).  Each event
+    gains ``_proc`` (from the ``telemetry.{i}.jsonl`` name, else 0)."""
+    events = []
+    bad = 0
+    for fname in telemetry_files(path):
+        m = re.search(r"\.(\d+)\.jsonl$", os.path.basename(fname))
+        proc = int(m.group(1)) if m else 0
+        with open(fname) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    bad += 1
+                    continue
+                rec["_proc"] = proc
+                events.append(rec)
+    if bad:
+        events.append({"event": "_parse_errors", "count": bad, "_proc": -1})
+    return events
+
+
+def summarize(events: List[dict]) -> dict:
+    """Machine-readable digest of a merged event stream.
+
+    Per-iteration rows come from process 0 (iteration records are
+    emitted by every process and are near-identical — metrics/timings of
+    replicated training); counters are summed across processes' final
+    ``summary`` events (collective bytes et al. are per-process).
+    """
+    procs = sorted({e["_proc"] for e in events if e["_proc"] >= 0})
+    iters0 = [e for e in events if e.get("event") == "iteration"
+              and e["_proc"] == (procs[0] if procs else 0)]
+    iters0.sort(key=lambda e: e.get("iteration", 0))
+
+    phase_s = defaultdict(float)
+    phase_calls = defaultdict(int)
+    per_iteration = []
+    for e in iters0:
+        for k, v in (e.get("phase_s") or {}).items():
+            phase_s[k] += float(v)
+        per_iteration.append({
+            "iteration": e.get("iteration"),
+            "iter_s": e.get("iter_s"),
+            "leaves": e.get("leaves"),
+            "waves": e.get("waves"),
+            "recompiles": e.get("recompiles"),
+            "phase_s": e.get("phase_s") or {},
+            "metrics": e.get("metrics") or {},
+            "cum_row_iters_per_s": e.get("cum_row_iters_per_s"),
+        })
+
+    counters = defaultdict(float)
+    summaries = [e for e in events if e.get("event") == "summary"]
+    sum_phase = defaultdict(float)
+    for e in summaries:
+        for k, v in (e.get("counters") or {}).items():
+            if isinstance(v, (int, float)):
+                counters[k] += v
+        for k, v in (e.get("phase_s") or {}).items():
+            sum_phase[k] += float(v)
+        for k, v in (e.get("phase_calls") or {}).items():
+            phase_calls[k] += int(v)
+    # the atexit summaries carry authoritative totals including phases
+    # outside the iteration loop (binning, predict); per-iteration deltas
+    # are the fallback for live/crashed runs with no summary yet
+    if sum_phase:
+        phase_s = sum_phase
+    # live runs (no atexit summary yet): fall back to per-event counters
+    if not summaries:
+        for e in events:
+            if e.get("event") == "collective":
+                kind = e.get("kind", "?")
+                tag = "traced_" if e.get("traced") else ""
+                counters[f"collective/{kind}/{tag}calls"] += 1
+                counters[f"collective/{kind}/{tag}bytes"] += e.get("bytes", 0)
+
+    last = per_iteration[-1] if per_iteration else {}
+    return {
+        "processes": procs,
+        "iterations": len(per_iteration),
+        "per_iteration": per_iteration,
+        "phase_s": {k: round(v, 4) for k, v in sorted(phase_s.items())},
+        "phase_calls": dict(sorted(phase_calls.items())),
+        "counters": {k: (int(v) if float(v).is_integer() else round(v, 4))
+                     for k, v in sorted(counters.items())},
+        "metrics_last": last.get("metrics", {}),
+        "cum_row_iters_per_s": last.get("cum_row_iters_per_s"),
+        "parse_errors": sum(e.get("count", 0) for e in events
+                            if e.get("event") == "_parse_errors"),
+    }
+
+
+def render(digest: dict) -> str:
+    """Human-readable table for the digest."""
+    out = []
+    out.append(f"processes: {len(digest['processes'])}  "
+               f"iterations: {digest['iterations']}")
+    if digest["phase_s"]:
+        total = sum(digest["phase_s"].values()) or 1.0
+        calls = digest.get("phase_calls") or {}
+        out.append("")
+        out.append(f"{'phase':<28}{'seconds':>10}{'share':>8}{'calls':>8}")
+        for name, s in sorted(digest["phase_s"].items(),
+                              key=lambda kv: -kv[1]):
+            c = calls.get(name)
+            out.append(f"{name:<28}{s:>10.3f}{100.0 * s / total:>7.1f}%"
+                       f"{c if c is not None else '-':>8}")
+    rows = digest["per_iteration"]
+    if rows:
+        out.append("")
+        out.append(f"{'iter':>5}{'iter_s':>9}{'leaves':>10}{'waves':>7}"
+                   f"{'recomp':>7}  metrics")
+        for r in rows:
+            leaves = r.get("leaves")
+            leaves_s = ",".join(str(x) for x in leaves) if leaves else "-"
+            metr = " ".join(f"{k}={v:.6g}"
+                            for k, v in (r.get("metrics") or {}).items())
+            waves = r.get("waves")
+            out.append(f"{r.get('iteration', '?'):>5}"
+                       f"{(r.get('iter_s') or 0.0):>9.3f}"
+                       f"{leaves_s:>10}"
+                       f"{'-' if waves in (None, -1) else waves:>7}"
+                       f"{r.get('recompiles') if r.get('recompiles') is not None else '-':>7}"
+                       f"  {metr}")
+        if digest.get("cum_row_iters_per_s"):
+            out.append(f"cumulative row-iterations/s: "
+                       f"{digest['cum_row_iters_per_s']:,}")
+    if digest["counters"]:
+        out.append("")
+        out.append("counters:")
+        for k, v in digest["counters"].items():
+            out.append(f"  {k:<40} {v}")
+    if digest.get("parse_errors"):
+        out.append(f"\n(parse errors skipped: {digest['parse_errors']})")
+    return "\n".join(out)

@@ -1,0 +1,59 @@
+"""JAX-native instrumentation: the recompile counter.
+
+XLA recompilation is the classic silent TPU-performance killer — a shape
+or static-argument change retraces the whole grower (~40-60s, see the
+_JIT_CACHE note in boosting/gbdt.py) and nothing in the training loop
+says so.  ``jax.monitoring`` publishes a duration event per backend
+compile; hooking it gives an exact process-wide compile counter without
+wrapping every jitted closure.  ``boosting/gbdt.py`` snapshots the
+counter around each iteration and warns when a steady-state iteration
+triggered a retrace.
+
+The hook is installed by :func:`.core.enable` (so the telemetry-off path
+never imports jax from here) and is global + permanent once installed:
+listeners can't be unregistered without clearing everyone's, and an idle
+listener costs a few Python calls per compile — compiles are rare.
+"""
+from __future__ import annotations
+
+from . import core
+
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+_installed = False
+
+
+def install_recompile_hook() -> bool:
+    """Register the compile listener (idempotent).  False when
+    jax.monitoring is unavailable or the registration API changed."""
+    global _installed
+    if _installed:
+        return True
+    try:
+        import jax.monitoring as monitoring
+    except Exception:  # noqa: BLE001
+        return False
+
+    def _on_duration(name, secs, **kw):
+        if name == _COMPILE_EVENT:
+            # straight into the accumulators, bypassing core.count's
+            # enabled() gate: the listener outlives disable()/enable()
+            # cycles and compile counts are cheap to keep
+            core._counters["jax/compiles"] += 1
+            core._counters["jax/compile_s"] += float(secs)
+
+    try:
+        monitoring.register_event_duration_secs_listener(_on_duration)
+    except Exception:  # noqa: BLE001
+        return False
+    _installed = True
+    return True
+
+
+def compile_count() -> int:
+    """Backend compiles observed since the hook was installed."""
+    return int(core._counters.get("jax/compiles", 0))
+
+
+def compile_seconds() -> float:
+    return float(core._counters.get("jax/compile_s", 0.0))

@@ -59,6 +59,7 @@ def _hist_kernel(bins_ref, gh_ref, out_ref, *, B: int, FB: int):
 
 
 @functools.partial(jax.jit, static_argnames=("B", "block_rows", "feat_block"))
+@jax.named_scope("lgbm/pallas_hist")
 def hist_pallas_channels(bins_fm, gh, B: int, block_rows: int = _DEF_BR,
                          feat_block: int = _DEF_FB):
     """Multi-channel histogram: bins_fm [F, N] uint8, gh [N, C] f32 ->
@@ -186,6 +187,7 @@ def _resolve_mode(highest) -> str:
 @functools.partial(jax.jit,
                    static_argnames=("B", "block_rows", "feat_block", "highest",
                                     "interpret"))
+@jax.named_scope("lgbm/pallas_hist_wave")
 def hist_pallas_wave(bins_fm, gv, hv, cv, leaf_id, slot_leaf, B: int,
                      block_rows: int = 1024, feat_block: int = _DEF_FB,
                      highest="bf16", interpret: bool = False):

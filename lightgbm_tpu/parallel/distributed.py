@@ -191,12 +191,19 @@ def _allgather_exact(arr):
     import jax.numpy as jnp
     from jax.experimental import multihost_utils
 
+    from .. import obs
+
     a = np.ascontiguousarray(arr)
     if a.dtype.itemsize == 8:
         u = a.view(np.uint32)
         g = np.asarray(multihost_utils.process_allgather(jnp.asarray(u)))
-        return g.view(a.dtype)
-    return np.asarray(multihost_utils.process_allgather(jnp.asarray(a)))
+        g = g.view(a.dtype)
+    else:
+        g = np.asarray(multihost_utils.process_allgather(jnp.asarray(a)))
+    # host-driven collective: the gathered result size IS the runtime
+    # receive traffic (every process materializes all hosts' payloads)
+    obs.record_collective_host("process_allgather", g.nbytes)
+    return g
 
 
 def global_bin_sample(sample, num_local_rows=None):

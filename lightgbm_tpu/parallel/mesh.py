@@ -16,6 +16,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .. import obs
 from ..core import splitter
 from ..core.grower import build_grow_fn
 from ..core.histogram import hist_onehot
@@ -62,12 +63,28 @@ def row_sharded(mesh: Mesh):
 
 
 def _psum(x):
+    # accounted at TRACE time (once per compiled program); see
+    # obs.record_collective for the traced_* counter semantics
+    obs.record_collective("psum", x)
     return jax.lax.psum(x, AXIS)
 
 
+def _all_gather(x):
+    obs.record_collective("all_gather", x)
+    return jax.lax.all_gather(x, AXIS)
+
+
 def _shard_map(fn, mesh, in_specs, out_specs):
-    return jax.jit(jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
-                                 out_specs=out_specs, check_vma=False))
+    # jax.shard_map graduated from jax.experimental between the jax
+    # versions we run on (TPU image vs CPU CI container); the replication
+    # check kwarg was renamed check_rep -> check_vma in the move
+    try:
+        sm, kw = jax.shard_map, {"check_vma": False}
+    except AttributeError:
+        from jax.experimental.shard_map import shard_map as sm
+        kw = {"check_rep": False}
+    return jax.jit(sm(fn, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **kw))
 
 
 _ROW_SHARDED = ((P(AXIS), P(AXIS), P(AXIS), P(AXIS), P()), (P(), P(AXIS)))
@@ -118,13 +135,12 @@ def make_voting_parallel_grower(meta: DeviceMeta, cfg: SplitConfig, B: int,
             local_score = jnp.abs(x[..., 0]).sum(axis=1)
             thresh = jax.lax.top_k(local_score, k)[0][-1]
             votes = (local_score >= thresh).astype(jnp.float32)
-            alive = jax.lax.psum(votes, AXIS) > 0.0      # [F_phys]
-            summed = jax.lax.psum(
-                jnp.where(alive[:, None, None], x, 0.0), AXIS)
+            alive = _psum(votes) > 0.0                   # [F_phys]
+            summed = _psum(jnp.where(alive[:, None, None], x, 0.0))
             if bundled:
                 return summed, alive
             return summed
-        return jax.lax.psum(x, AXIS)
+        return _psum(x)
 
     grow = build_grow_fn(meta, cfg, B, hist_fn=hist_fn,
                          reduce_fn=gated_reduce, subtract_sibling=False,
@@ -200,9 +216,9 @@ def make_feature_parallel_grower(meta: DeviceMeta, cfg: SplitConfig, B: int,
         bs = bs._replace(feature=jnp.where(bs.feature >= 0,
                                            bs.feature + offset,
                                            bs.feature).astype(jnp.int32))
-        gains = jax.lax.all_gather(bs.gain, AXIS)
+        gains = _all_gather(bs.gain)
         winner = jnp.argmax(gains)
-        pick = lambda x: jax.lax.all_gather(x, AXIS)[winner]
+        pick = lambda x: _all_gather(x)[winner]
         return splitter.BestSplit(
             gain=gains[winner], feature=pick(bs.feature),
             threshold=pick(bs.threshold), default_left=pick(bs.default_left),

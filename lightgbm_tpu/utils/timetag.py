@@ -6,88 +6,23 @@ src/treelearner/serial_tree_learner.cpp:21-60, src/boosting/gbdt.cpp:30-56).
 Here the switch is the ``LGBM_TPU_TIMETAG`` environment variable (set to
 ``1``) — a Python-level gate instead of a rebuild.
 
-Because JAX dispatch is asynchronous, a phase that launches device work
-must synchronize before its timer stops or it only measures enqueue time.
-``sync(x)`` blocks on ``x`` ONLY while tracing is enabled, so the
-training loop keeps its async pipelining when tracing is off (the
-overlap matters: see the lag-1 stop note in boosting/gbdt.py).
-
-Usage::
+This module is now a thin façade over :mod:`lightgbm_tpu.obs` — the same
+accumulators feed both the atexit TIMETAG report and the structured
+telemetry stream (``LGBM_TPU_TELEMETRY``), so the two gates share one
+source of truth.  The public surface is unchanged:
 
     with timetag("tree growth"):
         tree, leaf_id = grow(...)
         sync(leaf_id)
 
+``sync(x)`` blocks on ``x`` ONLY while tracing (either gate) is enabled,
+so the training loop keeps its async pipelining when tracing is off (the
+overlap matters: see the lag-1 stop note in boosting/gbdt.py).
 Accumulated times print at process exit and via :func:`report`.
 """
 from __future__ import annotations
 
-import atexit
-import os
-import time
-from collections import defaultdict
+from ..obs.core import (TIMETAG_ENABLED as ENABLED, add, phase as timetag,
+                        report, reset, sync)
 
-from . import log
-
-ENABLED = os.environ.get("LGBM_TPU_TIMETAG", "") not in ("", "0", "false")
-
-_acc = defaultdict(float)
-_cnt = defaultdict(int)
-
-
-class timetag:
-    """Context manager accumulating wall time under ``name`` when enabled."""
-
-    __slots__ = ("name", "t0")
-
-    def __init__(self, name: str):
-        self.name = name
-
-    def __enter__(self):
-        if ENABLED:
-            self.t0 = time.perf_counter()
-        return self
-
-    def __exit__(self, *exc):
-        if ENABLED:
-            _acc[self.name] += time.perf_counter() - self.t0
-            _cnt[self.name] += 1
-        return False
-
-
-def sync(x):
-    """Block on a jax value only when tracing — keeps async dispatch
-    intact in normal runs. Returns ``x``."""
-    if ENABLED and x is not None:
-        import jax
-
-        jax.block_until_ready(x)
-    return x
-
-
-def add(name: str, seconds: float) -> None:
-    """Manual accumulation for phases timed externally."""
-    if ENABLED:
-        _acc[name] += seconds
-        _cnt[name] += 1
-
-
-def reset() -> None:
-    _acc.clear()
-    _cnt.clear()
-
-
-def report() -> None:
-    """Print accumulated phase times (reference prints at GBDT/learner
-    destructors, gbdt.cpp:46-56)."""
-    if not _acc:
-        return
-    total = sum(_acc.values())
-    log.info("TIMETAG phase times:")
-    for name, t in sorted(_acc.items(), key=lambda kv: -kv[1]):
-        log.info("  %-24s %8.3f s  (%d calls, %4.1f%%)",
-                 name, t, _cnt[name], 100.0 * t / total if total else 0.0)
-
-
-if ENABLED:
-    atexit.register(report)
+__all__ = ["ENABLED", "timetag", "sync", "add", "reset", "report"]

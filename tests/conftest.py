@@ -88,6 +88,7 @@ _SLOW = {
     "test_categorical.py::test_categorical_search_matches_reference_oracle[False-0]",
     "test_sklearn.py::test_early_stopping_eval_set",
     "test_wave.py::test_wave_pass_count_regression_guard",
+    "test_obs.py::test_off_path_overhead_guard",
 }
 
 
@@ -98,9 +99,16 @@ def pytest_configure(config):
 
 def pytest_collection_modifyitems(config, items):
     import pytest as _pytest
+    tests_root = config.rootpath / "tests"
     for item in items:
-        # nodeid relative to tests/ (matches the measured list)
-        nid = item.nodeid.split("tests/")[-1]
+        # file path relative to tests/ + test name (params included) —
+        # resolved from item.path, not nodeid string surgery, so nested
+        # dirs or odd invocation roots can't silently mis-tier into quick
+        try:
+            rel = item.path.relative_to(tests_root).as_posix()
+        except ValueError:  # collected from outside tests/ (plugins)
+            rel = item.path.name
+        nid = f"{rel}::{item.name}"
         if nid in _SLOW:
             item.add_marker(_pytest.mark.slow)
         else:

@@ -36,6 +36,26 @@ def test_pickle_and_copy_roundtrip():
     np.testing.assert_allclose(shallow.predict(X), want, rtol=1e-6)
 
 
+def test_predict_rejects_wider_matrix():
+    """A prediction matrix with MORE columns than the model trained on is
+    an error (the reference C API's column-count check), dense and
+    sparse alike; narrower sparse inputs keep the LibSVM padding path."""
+    import scipy.sparse as sp
+    bst, X, y = _train()
+    wide = np.hstack([X, np.zeros((X.shape[0], 2))])
+    with pytest.raises(lgb.LightGBMError, match="number of features"):
+        bst.predict(wide)
+    with pytest.raises(lgb.LightGBMError, match="number of features"):
+        bst.predict(sp.csr_matrix(wide))
+    # narrower DENSE input has no padding story: same LightGBMError
+    # instead of an IndexError deep inside binning
+    with pytest.raises(lgb.LightGBMError, match="number of features"):
+        bst.predict(X[:, :5])
+    # narrower sparse input still pads up to the model width
+    narrow = sp.csr_matrix(X[:, :5])
+    assert bst.predict(narrow).shape == (X.shape[0],)
+
+
 def test_get_split_value_histogram():
     bst, X, y = _train(rounds=8)
     hist, edges = bst.get_split_value_histogram(0)

@@ -46,6 +46,39 @@ def parse_sections():
     return rows
 
 
+# Process-level switches living outside the Config surface (they must work
+# before any Config exists — at import time).  Rendered as their own
+# section so the generated doc stays the one place parameters live.
+ENV_VARS = [
+    ("LGBM_TPU_TIMETAG",
+     "set to `1` to accumulate per-phase wall times (binning, boosting, "
+     "tree growth, score update, predict) and print them at process exit "
+     "— the reference's compiled-in `TIMETAG` analog.  Synchronizes the "
+     "device after each phase, so throughput drops while it is on."),
+    ("LGBM_TPU_TELEMETRY",
+     "path of the structured telemetry sink: a directory (per-process "
+     "`telemetry.{process_index}.jsonl` files inside it) or a `*.jsonl` "
+     "file.  Streams JSONL events — one `iteration` record per boosting "
+     "iteration (phase timings, train/valid metrics, leaves, wave count, "
+     "counter snapshots, recompile deltas), `collective` records for "
+     "psum/all_gather traffic, and an atexit `summary`.  Merge with "
+     "`python tools/telemetry_report.py <path>`.  Equivalent to the "
+     "`tpu_telemetry` parameter.  Implies the same per-phase device "
+     "synchronization as `LGBM_TPU_TIMETAG`."),
+    ("JAX_PLATFORMS",
+     "standard JAX backend selector (`cpu` forces the XLA host path)."),
+]
+
+PROFILER_NOTE = (
+    "Profiler scope naming: every device phase is annotated for "
+    "`jax.profiler` traces under the `lgbm/` prefix — host-side phases "
+    "as `lgbm/<phase name>` (TraceAnnotation, e.g. `lgbm/tree growth`), "
+    "compiled regions as XLA metadata scopes (`lgbm/hist_onehot`, "
+    "`lgbm/hist_scatter`, `lgbm/hist_wave_xla`, `lgbm/pallas_hist`, "
+    "`lgbm/pallas_hist_wave`, `lgbm/wave_hist`, `lgbm/wave_split_phase`, "
+    "`lgbm/split_scan`, `lgbm/tree_traverse`, `lgbm/forest_predict`).")
+
+
 def main() -> None:
     rows = parse_sections()
     aliases = defaultdict(list)
@@ -73,6 +106,10 @@ def main() -> None:
         al = sorted(aliases.get(name, []))
         if al:
             out.append(f"  - aliases: " + ", ".join(f"`{a}`" for a in al))
+    out += ["## Environment variables", ""]
+    for name, desc in ENV_VARS:
+        out.append(f"- **`{name}`** — {desc}")
+    out += ["", PROFILER_NOTE]
     out.append("")
     path = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "docs", "PARAMETERS.md")
