@@ -39,14 +39,32 @@ REF_RANK_ROW_ITERS_PER_SEC = 2_270_296 * 500 / 215.32
 
 def _telemetry_digest():
     """Machine-readable telemetry summary for the JSON line, when the run
-    had LGBM_TPU_TELEMETRY / tpu_telemetry active; None otherwise."""
+    had LGBM_TPU_TELEMETRY / tpu_telemetry or LGBM_TPU_PROFILE active;
+    None otherwise."""
     try:
         from lightgbm_tpu import obs
-        if obs.enabled():
+        if obs.enabled() or obs.profile_enabled():
             return obs.digest()
     except Exception:  # telemetry must never cost the bench its number
         pass
     return None
+
+
+def _embed_observability(result: dict) -> None:
+    """Fold the telemetry digest into the JSON line; profile-mode runs
+    additionally get flat peak-HBM and per-kernel roofline-fraction
+    fields so bench_history.py can track them round over round."""
+    td = _telemetry_digest()
+    if td is None:
+        return
+    result["telemetry"] = td
+    mem = td.get("memory") or {}
+    if mem.get("peak_bytes"):
+        result["peak_hbm_bytes"] = mem["peak_bytes"]
+    kernels = td.get("kernels") or {}
+    if kernels:
+        result["kernel_roofline"] = {
+            k: v["roofline_frac"] for k, v in kernels.items()}
 
 
 def _rank_data(rows: int):
@@ -198,9 +216,7 @@ def main() -> None:
         if backend_tag is not None:
             rr["backend"] = backend_tag
             rr["note"] = "CPU numbers at reduced size — NOT the TPU result"
-        td = _telemetry_digest()
-        if td is not None:
-            rr["telemetry"] = td
+        _embed_observability(rr)
         print(json.dumps(rr))
         return
     X, y = _load_data(rows)
@@ -259,9 +275,7 @@ def main() -> None:
             })
         except Exception as exc:  # rank failure must not lose the main number
             result["rank_error"] = f"{type(exc).__name__}: {exc}"[:200]
-    td = _telemetry_digest()
-    if td is not None:
-        result["telemetry"] = td
+    _embed_observability(result)
     print(json.dumps(result))
 
 

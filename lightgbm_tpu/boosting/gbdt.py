@@ -238,6 +238,8 @@ class GBDT(PredictorBase):
         # _init_grower so the wave grower can build its pass counter in
         if getattr(config, "tpu_telemetry", ""):
             obs.enable(config.tpu_telemetry)
+        if getattr(config, "tpu_profile", False):
+            obs.enable_profile()
 
         self.config = config
         self.train_ds = train_ds
@@ -275,6 +277,9 @@ class GBDT(PredictorBase):
         self._jit_helpers()
         self._telem_iters = 0
         self._telem_train_s = 0.0
+        if obs.profile_enabled():
+            self._wrap_profiled()
+            obs.memory_snapshot("train_init", buffers=self._census_buffers())
         if obs.enabled():
             obs.event("train_start", num_data=N,
                       num_features=train_ds.num_features, num_class=K,
@@ -296,6 +301,7 @@ class GBDT(PredictorBase):
 
         self._raw_cached = False  # set True when _grow_raw is _JIT_CACHE'd
         self._report_waves = False  # wave grower emits its pass count
+        self._wave_cost_args = None  # (F_kern, B_kern, mode) for profile
 
         # ---- CEGB (reference: cost_effective_gradient_boosting.hpp) -----
         self._cegb_on = False
@@ -437,10 +443,13 @@ class GBDT(PredictorBase):
         if self.uses_wave:
             from ..core.wave_grower import build_wave_grow_fn
 
-            # telemetry: have the wave grower count its kernel passes so
-            # per-iteration records carry the wave count (report_waves and
-            # cegb both add a third output — cegb wins when both apply)
-            self._report_waves = (obs.enabled() and cegb_cfg is None
+            # telemetry: have the wave grower count its kernel passes +
+            # rows histogrammed so per-iteration records carry the wave
+            # count and profile mode can attribute kernel work
+            # (report_waves and cegb both add a third output — cegb wins
+            # when both apply)
+            self._report_waves = ((obs.enabled() or obs.profile_enabled())
+                                  and cegb_cfg is None
                                   and self._telemetry_waves)
 
             def build_wave():
@@ -481,6 +490,14 @@ class GBDT(PredictorBase):
                         xbt[mixed_info.narrow_idx]).astype(np.uint8)),
                     jnp.asarray(np.ascontiguousarray(
                         xbt[mixed_info.wide_idx])))
+            # kernel-shape triple for profile mode's analytical wave-
+            # kernel attribution (ops/pallas_hist.wave_kernel_cost)
+            self._wave_cost_args = (
+                (len(mixed_info.narrow_idx) if mixed_info is not None
+                 else int(train_ds.X_bin.shape[1])),
+                (int(mixed_info.B_narrow) if mixed_info is not None
+                 else self.B_phys),
+                self._hist_mode(config))
         else:
             from ..core.grower import build_grow_fn
             from ..core.histogram import hist_onehot, hist_scatter
@@ -622,7 +639,8 @@ class GBDT(PredictorBase):
                     arrs, leaf_id, n_waves = res
                 else:
                     arrs, leaf_id = res
-                    n_waves = jnp.int32(-1)  # sentinel: not counted
+                    # sentinel [waves, rows]: not counted
+                    n_waves = jnp.full((2,), -1.0, jnp.float32)
                 grew = arrs.num_leaves > 1
                 lv = jnp.where(grew, arrs.leaf_value * lr, 0.0)
                 arrs = arrs._replace(
@@ -649,6 +667,42 @@ class GBDT(PredictorBase):
 
         self._valid_apply = _cached_jit(
             ("valid_apply", id(meta), bundled), build_valid_apply)
+
+    # ------------------------------------------------------------------
+    def _wrap_profiled(self) -> None:
+        """Profile mode: sync-bracket + cost-analyze the jitted units the
+        training loop dispatches, named after the lgbm/* scope each one
+        drives (obs/profile.py).  Wrapping happens AFTER _jit_helpers so
+        the process-wide _JIT_CACHE keeps the bare closures (other
+        boosters sharing the cache get the unwrapped functions — though
+        the profile GATE itself is process-wide, so boosters built while
+        it is on wrap their own copies; obs.enable_profile(False) to
+        stop)."""
+        if self._grad_fn is not None:
+            self._grad_fn = obs.profile_wrap("lgbm/grad", self._grad_fn)
+        if getattr(self, "_grow_apply", None) is not None:
+            self._grow_apply = obs.profile_wrap("lgbm/grow_apply",
+                                                self._grow_apply)
+        self._grow = obs.profile_wrap("lgbm/grow", self._grow)
+        self._valid_apply = obs.profile_wrap("lgbm/valid_update",
+                                             self._valid_apply)
+        self._apply_leaf = obs.profile_wrap("lgbm/apply_leaf",
+                                            self._apply_leaf)
+        self._traverse_add = obs.profile_wrap("lgbm/tree_traverse",
+                                              self._traverse_add)
+
+    def _census_buffers(self) -> dict:
+        """The logical device buffers the HBM census attributes live
+        bytes to (obs/memory.py snapshot)."""
+        return {
+            "binned_matrix": getattr(self, "_grow_bins", None),
+            "bins_rowmajor": getattr(self, "_bins", None),
+            "train_score": self._train_score,
+            "valid_bins": getattr(self, "_valid_bins", None),
+            "valid_scores": self._valid_scores,
+            "bag_mask": getattr(self, "_bag_mask", None),
+            "forest_soa": getattr(self, "_forest_cache", None),
+        }
 
     # ------------------------------------------------------------------
     def _materialize_trees(self) -> None:
@@ -899,8 +953,11 @@ class GBDT(PredictorBase):
 
         # Telemetry snapshots for the per-iteration record.  Everything in
         # the telem branches costs device syncs / metric evals, so it is
-        # gated hard: with no sink configured this is one bool check.
-        telem = obs.enabled()
+        # gated hard: with neither gate configured this is one bool check.
+        # Profile mode without a sink still takes this path — events
+        # no-op, but the kernel attribution, memory census, and release
+        # audit must feed the digest bench.py embeds.
+        telem = obs.enabled() or obs.profile_enabled()
         if telem:
             t_iter0 = time.perf_counter()
             phase0 = obs.phase_snapshot()
@@ -908,6 +965,7 @@ class GBDT(PredictorBase):
             compile_s0 = obs.counter_value("jax/compile_s")
             leaves_grown: List[int] = []
             waves_total = None
+            kern_rows = None
 
         init_scores = [0.0] * K
         if gradients is None or hessians is None:
@@ -924,6 +982,11 @@ class GBDT(PredictorBase):
                 h = h[:, None]
 
         g, h = self._bagging(self.iter_, g, h)
+        if telem and obs.profile_enabled():
+            # release audit: the pre-iteration score buffer must die once
+            # every class's update lands — a survivor means an extra
+            # reference is pinning HBM (obs/memory.py)
+            obs.expect_released("train_score", self._train_score)
         feature_mask = self._feature_mask()
         needs_renew = (self.objective is not None
                        and self.objective.is_renew_tree_output)
@@ -1029,9 +1092,12 @@ class GBDT(PredictorBase):
                 leaves_grown.append(1 if arrs is None
                                     else int(arrs.num_leaves))
                 if n_waves_dev is not None:
-                    w = int(n_waves_dev)
+                    stats = np.asarray(n_waves_dev).reshape(-1)
+                    w = int(stats[0])
                     if w >= 0:
                         waves_total = (waves_total or 0) + w
+                        if stats.size > 1:
+                            kern_rows = (kern_rows or 0) + int(stats[1])
             self.models.append(tree)
         self._model_version += 1
 
@@ -1058,16 +1124,17 @@ class GBDT(PredictorBase):
         if telem:
             self._emit_iteration_record(t_iter0, phase0, compiles0,
                                         compile_s0, leaves_grown,
-                                        waves_total)
+                                        waves_total, kern_rows)
         self.iter_ += 1
         return False
 
     def _emit_iteration_record(self, t_iter0, phase0, compiles0, compile_s0,
-                               leaves, waves) -> None:
+                               leaves, waves, kern_rows=None) -> None:
         """One structured telemetry record per boosting iteration: phase
         timings, train/valid metric values, counter snapshots, cumulative
         throughput, and a retrace warning when a steady-state iteration
-        compiled."""
+        compiled.  Profile mode adds the analytical wave-kernel
+        attribution, an HBM census snapshot, and the release audit."""
         obs.sync(self._train_score)
         iter_s = time.perf_counter() - t_iter0
         self._telem_iters = getattr(self, "_telem_iters", 0) + 1
@@ -1077,19 +1144,43 @@ class GBDT(PredictorBase):
             metrics[f"{ds_name}.{mname}"] = float(value)
         recompiles = int(obs.counter_value("jax/compiles") - compiles0)
         N = self.train_ds.num_data
+        phase_s = obs.phase_delta(phase0)
         obs.event(
             "iteration",
             iteration=self.iter_,
             num_class=self.num_tpi,
             leaves=leaves,
             waves=waves,
+            kernel_rows=kern_rows,
             iter_s=round(iter_s, 6),
-            phase_s=obs.phase_delta(phase0),
+            phase_s=phase_s,
             metrics=metrics,
             counters=obs.counters_snapshot(),
             recompiles=recompiles,
             cum_row_iters_per_s=round(
                 N * self._telem_iters / max(self._telem_train_s, 1e-9), 1))
+        if obs.profile_enabled():
+            if kern_rows and kern_rows > 0 and recompiles == 0 \
+                    and getattr(self, "_wave_cost_args", None):
+                # analytical attribution for the kernel fused inside the
+                # grower jit: rows histogrammed x per-row model cost
+                # (ops/pallas_hist.wave_kernel_cost) vs the enclosing
+                # tree-growth phase time — docs/ROOFLINE.md's measured-vs-
+                # ceiling number.  Skipped on iterations that compiled:
+                # trace/compile lands inside phase_s['tree growth'] and
+                # would drown the fraction the operator acts on.
+                from ..ops.pallas_hist import wave_kernel_cost
+                Fk, Bk, mode = self._wave_cost_args
+                flops, nbytes = wave_kernel_cost(kern_rows, Fk, Bk, mode,
+                                                 waves=waves or 1)
+                achieved = phase_s.get("tree growth", iter_s)
+                obs.record_kernel("lgbm/pallas_hist_wave", flops, nbytes,
+                                  achieved, source="analytical",
+                                  rows=kern_rows, waves=waves,
+                                  iteration=self.iter_)
+            obs.memory_snapshot(f"iteration_{self.iter_}",
+                                buffers=self._census_buffers())
+            obs.memory_audit(f"iteration_{self.iter_}")
         if recompiles > 0 and self.iter_ >= 2:
             # iterations 0-1 legitimately compile (growers, lag-path
             # helpers); later retraces mean shape / static-arg churn
@@ -1294,7 +1385,10 @@ class GBDT(PredictorBase):
                   else (early_stop["kind"], early_stop["round_period"],
                         early_stop["margin_threshold"]))
         if getattr(self, "_forest_fn_key", "unset") != es_key:
-            self._forest_fn = forest_predict_fn(self.meta, K, early_stop)
+            fn = forest_predict_fn(self.meta, K, early_stop)
+            if obs.profile_enabled():
+                fn = obs.profile_wrap("lgbm/forest_predict", fn)
+            self._forest_fn = fn
             self._forest_fn_key = es_key
         from ..utils.timetag import timetag
         with timetag("predict (bin input)"):
@@ -1302,6 +1396,8 @@ class GBDT(PredictorBase):
         with timetag("predict (forest scan)"):
             out = self._forest_fn(self._forest_cache, jnp.asarray(vbins))
             res = np.asarray(out, dtype=np.float64)
+        if obs.profile_enabled():
+            obs.memory_snapshot("predict", buffers=self._census_buffers())
         return res
 
     def _bin_for_predict(self, X: np.ndarray, sentinel: int) -> np.ndarray:

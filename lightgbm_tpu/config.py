@@ -305,6 +305,14 @@ class Config:
                                         # (telemetry.{proc}.jsonl inside) or
                                         # a .jsonl path; same switch as the
                                         # LGBM_TPU_TELEMETRY env var
+    tpu_profile: bool = False           # profile mode: sync-bracket every
+                                        # phase/kernel, emit kernel_profile
+                                        # roofline events + HBM memory
+                                        # census (LGBM_TPU_PROFILE env).
+                                        # PROCESS-WIDE once enabled (like
+                                        # tpu_telemetry); breaks async
+                                        # pipelining — attribution runs
+                                        # only, never benchmarks
 
     # ---- derived (not user-settable) ----
     is_parallel: bool = dataclasses.field(default=False, repr=False)

@@ -266,6 +266,19 @@ def _cat_winner_bitset(cat: dict, f_best, B: int):
     return _pack_bitset(member, B)
 
 
+def split_scan_cost(F: int, B: int, leaves: int = 1):
+    """Analytical (FLOPs, bytes) of ``best_split`` over ``leaves`` leaf
+    scans: ~a few dozen elementwise ops per [F, B] cell (prefix sums,
+    gain formula, constraint masks — the constant is an empirical op
+    count, not a derivation).  ``tools/prof_kernels.py`` uses this to
+    bound how much of the non-kernel wave time the split scans explain
+    (docs/ROOFLINE.md's "everything-but-kernel" hypothesis)."""
+    ops_per_cell = 48.0
+    flops = ops_per_cell * leaves * F * B
+    nbytes = float(leaves) * F * B * 3 * 4 * 2
+    return flops, nbytes
+
+
 @jax.named_scope("lgbm/split_scan")
 def best_split(hist, sum_g, sum_h, cnt, meta: DeviceMeta, cfg: SplitConfig,
                min_constraint, max_constraint, feature_mask=None,

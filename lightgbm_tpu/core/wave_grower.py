@@ -85,6 +85,9 @@ class _WaveState(NamedTuple):
     tree: TreeArrays
     cegb_coupled: jnp.ndarray = None  # f32 [F] CEGB pending coupled penalties
     n_waves: jnp.ndarray = None  # i32 kernel-pass counter (report_waves)
+    n_rows_kern: jnp.ndarray = None  # f32 rows histogrammed (tier-aware;
+    #   f32 so 10M rows x hundreds of passes can't wrap an i32 — the
+    #   ~2^-24 relative rounding is irrelevant for cost attribution)
 
 
 def build_wave_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
@@ -97,10 +100,12 @@ def build_wave_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
                        report_waves: bool = False):
     """Unjitted ``grow(bins_fm, g, h, sample_mask, feature_mask)`` using the
     Pallas wave kernel. Returns (TreeArrays, leaf_id); with
-    ``report_waves`` a third output counts the kernel passes actually
-    taken — the CPU-runnable regression guard on wave-scheduling
-    efficiency (each pass is one full-data histogram kernel launch, the
-    dominant per-tree cost on TPU).
+    ``report_waves`` a third output ``stats`` (f32 [2]) carries the
+    kernel passes actually taken and the total rows histogrammed across
+    them (tier-compaction aware) — the CPU-runnable regression guard on
+    wave-scheduling efficiency, and the exact work figure profile mode
+    multiplies by the per-row kernel cost (``ops.pallas_hist.
+    wave_kernel_cost``) to machine-check docs/ROOFLINE.md.
 
     With ``mixed`` set, ``bins_fm`` is a PAIR ``(narrow_u8 [Fn, N],
     wide [Fw, N])``: narrow physical columns ride the kernel at
@@ -378,6 +383,7 @@ def build_wave_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
 
                 if K == 1:
                     hw = tier_call(N)(0)
+                    tsize = jnp.int32(N)
                 else:
                     # smallest tier >= n_active: count tiers that fit
                     thresholds = jnp.asarray(np.asarray(tiers, np.int32))
@@ -387,9 +393,11 @@ def build_wave_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
                     hw = jax.lax.switch(
                         jnp.clip(k, 0, K - 1),
                         [tier_call(T) for T in tiers], 0)  # [F, B, C]
+                    tsize = thresholds[jnp.clip(k, 0, K - 1)]
             else:
                 hw = _wave_hist(bins_n_fm, bins_rm_w, gv, hv, cv,
                                 st.leaf_id, slot_leaf)   # [Fp, Bp, C]
+                tsize = jnp.int32(bins_n_fm.shape[1])
             if reduce_fn is not None:
                 # global histograms: every device now sees the same wave
                 # result and takes identical split decisions
@@ -446,7 +454,10 @@ def build_wave_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
                 pend_cnt=jnp.int32(0),
             )
             if report_waves:
-                st = st._replace(n_waves=st.n_waves + 1)
+                st = st._replace(
+                    n_waves=st.n_waves + 1,
+                    n_rows_kern=st.n_rows_kern
+                    + tsize.astype(jnp.float32))
             return st
 
         return jax.lax.cond(st.pend_cnt > 0, do, lambda s: s, st)
@@ -499,6 +510,7 @@ def build_wave_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
             tree=_empty_tree(L, W),
             cegb_coupled=cegb_coupled,
             n_waves=jnp.int32(0) if report_waves else None,
+            n_rows_kern=jnp.float32(0) if report_waves else None,
         )
         # Alternate split and wave phases until no ready leaf has positive
         # gain and nothing is pending.  The first body iteration has no
@@ -540,7 +552,8 @@ def build_wave_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
         if cegb is not None:
             return tr, st.leaf_id, st.cegb_coupled
         if report_waves:
-            return tr, st.leaf_id, st.n_waves
+            return tr, st.leaf_id, jnp.stack(
+                [st.n_waves.astype(jnp.float32), st.n_rows_kern])
         return tr, st.leaf_id
 
     return grow

@@ -348,13 +348,32 @@ fallback: {
 }
 
 static inline bool is_na_token(const char* p, const char* end) {
-    // na / nan / NA / NaN / N/A / null / "" — the reference's Atof returns
-    // NaN for unparseable tokens (utils/common.h AtofPrecise fallback)
+    // EXACT missing-value token set, case-insensitive: "", ?, na, nan,
+    // null, n/a.  The old heuristic treated ANY field starting with n/N
+    // as missing, so typo'd fields ("n0.5", "none3") were silently
+    // blessed as NAs.  Now such fields reach parse_field instead, whose
+    // NaN result aborts the strict parse — CSV rows via the malformed-
+    // row return, LibSVM labels via the unconditional NaN label check —
+    // so the lenient fallback surfaces the real error (ADVICE.md).
     while (p < end && (*p == ' ' || *p == '\t')) ++p;
-    if (p >= end) return true;
-    char c0 = *p | 0x20;
-    if (c0 == 'n') return true;   // na, nan, null, n/a (no number starts n)
-    if (*p == '?') return true;
+    while (end > p &&
+           (end[-1] == ' ' || end[-1] == '\t' || end[-1] == '\r')) --end;
+    size_t len = static_cast<size_t>(end - p);
+    if (len == 0) return true;
+    if (len == 1 && *p == '?') return true;
+    // signed nan ("-nan" is glibc printf's rendering of negative NaN);
+    // the sign applies to nan ONLY — "-na"/"-n/a"/"+null" stay malformed
+    if (len == 4 && (*p == '+' || *p == '-') && (p[1] | 0x20) == 'n' &&
+        (p[2] | 0x20) == 'a' && (p[3] | 0x20) == 'n')
+        return true;
+    if (len > 4) return false;
+    char buf[4];
+    for (size_t i = 0; i < len; ++i) buf[i] = p[i] | 0x20;  // ascii lower
+    if (len == 2 && buf[0] == 'n' && buf[1] == 'a') return true;
+    if (len == 3 && memcmp(buf, "nan", 3) == 0) return true;
+    if (len == 3 && buf[0] == 'n' && buf[1] == '/' && buf[2] == 'a')
+        return true;
+    if (len == 4 && memcmp(buf, "null", 4) == 0) return true;
     return false;
 }
 
@@ -362,9 +381,10 @@ static inline bool is_na_token(const char* p, const char* end) {
 // a line boundary (the Python side carries the partial tail line over to
 // the next chunk).  delim == ' ' means "any run of spaces/tabs" (the
 // np.loadtxt whitespace mode); otherwise fields split on exactly delim.
-// Unparseable/empty fields become NaN.  Rows with a DIFFERENT number of
-// fields abort the parse: returns -(line_index+1); otherwise the number of
-// rows written to out (row-major [rows, ncol]).
+// Exact NA tokens (is_na_token) and empty fields become NaN.  Rows with a
+// DIFFERENT number of fields — or an unparseable non-NA field — abort the
+// parse: returns -(line_index+1); otherwise the number of rows written to
+// out (row-major [rows, ncol]).
 int64_t csv_parse(const char* buf, int64_t len, char delim, int64_t ncol,
                   double* out, int64_t max_rows) {
     // line index (serial scan; memchr runs at ~GB/s)
@@ -410,7 +430,13 @@ int64_t csv_parse(const char* buf, int64_t len, char delim, int64_t ncol,
             if (is_na_token(fp, fe)) {
                 orow[c++] = std::numeric_limits<double>::quiet_NaN();
             } else {
-                orow[c++] = parse_field(fp, fe);
+                double v = parse_field(fp, fe);
+                // not an NA token and not a number: a typo'd field
+                // ("3.14.15", "n0.5") aborts the strict parse instead of
+                // silently training on a fabricated missing value; the
+                // lenient fallback surfaces the real error (ADVICE.md)
+                if (std::isnan(v)) { bad = r + 1; break; }
+                orow[c++] = v;
             }
             if (fe >= end) break;
             fp = fe + 1;
@@ -518,9 +544,14 @@ int64_t csv_parse_cols(const char* buf, int64_t len, char delim,
                 if (!fe) fe = end;
             }
             if (ci == cols[ki]) {
-                orow[ki++] = is_na_token(fp, fe)
-                    ? std::numeric_limits<double>::quiet_NaN()
-                    : parse_field(fp, fe);
+                if (is_na_token(fp, fe)) {
+                    orow[ki++] = std::numeric_limits<double>::quiet_NaN();
+                } else {
+                    double v = parse_field(fp, fe);
+                    // same strictness as csv_parse: typo'd fields abort
+                    if (std::isnan(v)) { bad = r + 1; break; }
+                    orow[ki++] = v;
+                }
             }
             if (fe >= end || ki >= k) break;
             fp = fe + 1;
@@ -574,10 +605,12 @@ int64_t libsvm_parse(const char* buf, int64_t len, double* labels,
         const char* fe = p;
         while (fe < end && *fe != ' ' && *fe != '\t') ++fe;
         labels[row] = parse_field(p, fe);
-        // a garbage label would silently train on NaN targets; reject the
-        // chunk so the lenient Python fallback surfaces the real error
-        // (feature VALUES stay NaN-tolerant — "na" is a missing value)
-        if (std::isnan(labels[row]) && !is_na_token(p, fe))
+        // a NaN label — garbage OR a literal na/nan token — would
+        // silently train on NaN targets; reject the chunk
+        // unconditionally so the lenient Python fallback surfaces the
+        // real error (feature VALUES stay NaN-tolerant — "na" there is
+        // a missing value)
+        if (std::isnan(labels[row]))
             return -(row + 1);
         qids[row] = -1;
         p = fe;

@@ -1,6 +1,7 @@
 """Observability subsystem: structured telemetry, phase timers, JAX
 instrumentation (see ``core`` for the event/counter API, ``trace`` for
-the recompile hook, ``report`` for JSONL merging).
+the recompile hook, ``profile`` for kernel cost attribution, ``memory``
+for the HBM census, ``report`` for JSONL merging).
 
 Quick start::
 
@@ -9,6 +10,9 @@ Quick start::
 
 or programmatically ``obs.enable("/tmp/telem")`` / the ``tpu_telemetry``
 parameter.  ``LGBM_TPU_TIMETAG=1`` keeps the plain phase-time report.
+``LGBM_TPU_PROFILE=1`` (or ``tpu_profile``) adds the sync-bracketed
+profile mode: per-kernel ``kernel_profile`` events with cost-analysis
+FLOPs/bytes and roofline fractions, plus ``memory_census`` snapshots.
 """
 from .core import (TIMETAG_ENABLED, add, count, counter_value,
                    counters_snapshot, current_phase, digest, disable,
@@ -16,6 +20,12 @@ from .core import (TIMETAG_ENABLED, add, count, counter_value,
                    phase_snapshot, record_collective,
                    record_collective_host, report, reset, sink_path, sync,
                    tracing_enabled)
+from .memory import (audit as memory_audit, expect_released, memory_digest,
+                     peak_bytes)
+from .memory import snapshot as memory_snapshot
+from .profile import (device_peaks, enable_profile, profile_digest,
+                      profile_enabled, record_kernel, roofline_seconds)
+from .profile import wrap as profile_wrap
 from .trace import compile_count, compile_seconds, install_recompile_hook
 
 __all__ = [
@@ -25,4 +35,8 @@ __all__ = [
     "record_collective", "record_collective_host", "report", "reset",
     "sink_path", "sync", "tracing_enabled",
     "compile_count", "compile_seconds", "install_recompile_hook",
+    "device_peaks", "enable_profile", "profile_digest", "profile_enabled",
+    "profile_wrap", "record_kernel", "roofline_seconds",
+    "memory_audit", "memory_digest", "memory_snapshot", "expect_released",
+    "peak_bytes",
 ]

@@ -51,6 +51,27 @@ _fh = None                      # lazily-opened per-process file handle
 _cur_phase = ""                 # innermost active phase (collective attr.)
 _atexit_on = False
 _write_warned = False
+_profile_active = False         # set by obs.profile (avoids import cycle)
+_mem_probe = None               # obs.memory per-phase-exit hook
+_reset_hooks = []               # submodule state cleared by reset()
+
+
+def _set_profile_active(on: bool) -> None:
+    """Profile mode flips this so phase timers sync-bracket device work
+    even without a telemetry sink (obs/profile.py owns the gate; core
+    can't import it — profile imports core)."""
+    global _profile_active, _mem_probe
+    _profile_active = bool(on)
+    if on:
+        from .memory import phase_probe
+        _mem_probe = phase_probe
+        _ensure_atexit()
+    else:
+        _mem_probe = None
+
+
+def _register_reset(hook) -> None:
+    _reset_hooks.append(hook)
 
 
 def enabled() -> bool:
@@ -60,7 +81,7 @@ def enabled() -> bool:
 
 def tracing_enabled() -> bool:
     """True when phase timers accumulate and :func:`sync` blocks."""
-    return TIMETAG_ENABLED or _path is not None
+    return TIMETAG_ENABLED or _path is not None or _profile_active
 
 
 def enable(path: str) -> None:
@@ -284,6 +305,9 @@ class phase:
             _cur_phase = self._prev
             if self._ta is not None:
                 self._ta.__exit__(exc_type, exc_value, tb)
+            if _mem_probe is not None:
+                # profile mode: per-phase live-byte peak (obs/memory.py)
+                _mem_probe(self.name)
             self._on = False
         return False
 
@@ -330,17 +354,29 @@ def reset() -> None:
     _cnt.clear()
     _counters.clear()
     _gauges.clear()
+    for hook in _reset_hooks:
+        hook()
 
 
 def digest() -> dict:
     """Machine-readable run summary: phase totals/call counts + counter
-    snapshot.  Embedded in bench.py's JSON line and in the atexit
-    ``summary`` event."""
-    return {
+    snapshot (+ per-kernel rooflines and the memory-census peak when
+    profile mode ran).  Embedded in bench.py's JSON line and in the
+    atexit ``summary`` event."""
+    d = {
         "phase_s": {k: round(v, 4) for k, v in _acc.items()},
         "phase_calls": dict(_cnt),
         "counters": counters_snapshot(),
     }
+    from .memory import memory_digest
+    from .profile import profile_digest
+    kernels = profile_digest()
+    if kernels:
+        d["kernels"] = kernels
+    mem = memory_digest()
+    if mem:
+        d["memory"] = mem
+    return d
 
 
 def report() -> None:

@@ -1,0 +1,269 @@
+"""Profile mode: per-kernel cost attribution against the analytical roofline.
+
+The telemetry layer (``core``) records *what* happened per iteration; this
+module explains *why it is slow*.  With the gate on (``LGBM_TPU_PROFILE=1``
+or the ``tpu_profile`` parameter) every profiled compiled program — the
+jitted units the trainer dispatches, each named after the ``lgbm/*`` scope
+it wraps — is:
+
+- **sync-bracketed**: ``block_until_ready`` after every call, so the
+  measured time is device compute, not enqueue (this deliberately breaks
+  the training loop's async pipelining — profile mode is for attribution
+  runs, never for benchmark numbers);
+- **cost-analyzed**: FLOPs and bytes-accessed come from XLA's own
+  ``lowered.compile().cost_analysis()``, cached per input signature;
+- **roofline-scored**: achieved time is compared against
+  ``max(flops/peak_flops, bytes/peak_bw)`` for the local device (peaks
+  from the table below, overridable via env), and a ``kernel_profile``
+  event carries the fraction — ``docs/ROOFLINE.md``'s hand-written model,
+  machine-checked on every run.
+
+Everything is OFF-path when disabled: ``wrap`` returns its argument
+unchanged, so the hot loop sees zero new code.  Events only reach disk
+when a telemetry sink is configured (``core.event`` gates); without one,
+the per-kernel aggregates still accumulate and surface in
+``obs.digest()`` (which ``bench.py`` embeds).
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+from typing import Dict, Optional, Tuple
+
+from ..utils import log
+from . import core
+
+# (device_kind substring, peak FLOP/s, peak HBM bytes/s).  Matmul peaks are
+# the bf16 numbers — the histogram kernels run bf16/f32 MXU passes and the
+# roofline model in docs/ROOFLINE.md uses the same convention.  First match
+# wins; the CPU fallback is a deliberately rough single-core estimate (the
+# CPU path exists for smoke-testing the machinery, not for CPU rooflines).
+DEVICE_PEAKS = (
+    ("v6", 918e12, 1640e9),
+    ("v5p", 459e12, 2765e9),
+    ("v5", 394e12, 820e9),       # v5e (docs/ROOFLINE.md's chip)
+    ("v4", 275e12, 1228e9),
+    ("v3", 123e12, 900e9),
+    ("v2", 45e12, 700e9),
+    ("cpu", 100e9, 20e9),
+)
+
+_env = os.environ.get("LGBM_TPU_PROFILE", "")
+_on = _env not in ("", "0", "false")
+
+_agg: Dict[str, dict] = {}   # kernel name -> aggregate record
+_ca_warned = set()
+
+
+def profile_enabled() -> bool:
+    """True when profile mode is on (env LGBM_TPU_PROFILE or enable)."""
+    return _on
+
+
+_announced = False
+
+
+def enable_profile(on: bool = True) -> None:
+    """Flip the PROCESS-WIDE profile gate (same scope as the telemetry
+    sink: ``tpu_profile`` on one Booster leaves it on for every later
+    Booster until ``enable_profile(False)``).  Takes effect for boosters
+    built AFTER the flip — instrumentation is decided when the jitted
+    closures are wrapped at Booster init, not per call."""
+    global _on, _announced
+    _on = bool(on)
+    core._set_profile_active(_on)
+    if _on and not _announced:
+        _announced = True
+        log.info("profile mode ON for the rest of the process: every "
+                 "phase/kernel is sync-bracketed (async dispatch "
+                 "disabled) — do not read throughput numbers from this "
+                 "run; obs.enable_profile(False) turns it off")
+
+
+_unknown_kind_warned = set()
+
+
+def device_peaks(device=None) -> Tuple[float, float]:
+    """(peak FLOP/s, peak HBM bytes/s) for ``device`` (default: local
+    device 0).  ``LGBM_TPU_PEAK_FLOPS`` / ``LGBM_TPU_PEAK_BW`` override
+    the table (each independently) — set them when profiling a chip the
+    table mispredicts; an unrecognized device_kind warns once and uses
+    the conservative CPU-class fallback."""
+    env_f = os.environ.get("LGBM_TPU_PEAK_FLOPS", "")
+    env_b = os.environ.get("LGBM_TPU_PEAK_BW", "")
+    kind = "cpu"
+    jx = sys.modules.get("jax")
+    if jx is not None:
+        try:
+            d = device if device is not None else jx.devices()[0]
+            kind = str(d.device_kind).lower()
+        except Exception:  # noqa: BLE001 — backend not up yet
+            pass
+    base = None
+    for sub, fl, bw in DEVICE_PEAKS:
+        if sub in kind:
+            base = (fl, bw)
+            break
+    if base is None:
+        if kind not in _unknown_kind_warned:
+            _unknown_kind_warned.add(kind)
+            log.warning("device_kind %r not in the peak table; roofline "
+                        "fractions use CPU-class fallback peaks — set "
+                        "LGBM_TPU_PEAK_FLOPS / LGBM_TPU_PEAK_BW for real "
+                        "numbers", kind)
+        base = (100e9, 20e9)
+    return (float(env_f) if env_f else base[0],
+            float(env_b) if env_b else base[1])
+
+
+def device_kind() -> str:
+    jx = sys.modules.get("jax")
+    if jx is None:
+        return "unknown"
+    try:
+        return str(jx.devices()[0].device_kind)
+    except Exception:  # noqa: BLE001
+        return "unknown"
+
+
+def roofline_seconds(flops: float, nbytes: float,
+                     peaks: Optional[Tuple[float, float]] = None) -> float:
+    """Analytical floor time: the slower of the compute and memory legs."""
+    pf, pb = peaks if peaks is not None else device_peaks()
+    return max(flops / pf if pf else 0.0, nbytes / pb if pb else 0.0)
+
+
+def cost_analysis_dict(compiled) -> dict:
+    """Normalize ``compiled.cost_analysis()`` across jax versions (a dict
+    in newer jax, a one-element list of dicts in 0.4.x)."""
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    return dict(ca or {})
+
+
+def extract_cost(ca: dict) -> Tuple[float, float]:
+    """(flops, bytes accessed) from an XLA cost-analysis dict."""
+    return (float(ca.get("flops", 0.0) or 0.0),
+            float(ca.get("bytes accessed", 0.0) or 0.0))
+
+
+def _sig(args, kwargs):
+    import jax
+    leaves, _ = jax.tree_util.tree_flatten((args, kwargs))
+    out = []
+    for x in leaves:
+        shp = getattr(x, "shape", None)
+        if shp is not None:
+            out.append((tuple(shp), str(getattr(x, "dtype", ""))))
+        else:
+            out.append(repr(x))
+    return tuple(out)
+
+
+def record_kernel(name: str, flops: float, nbytes: float, achieved_s: float,
+                  **extra) -> None:
+    """Fold one kernel execution into the aggregates + emit its
+    ``kernel_profile`` event.  Also the entry point for ANALYTICAL
+    attributions (kernels fused inside a larger program whose work is
+    known from the model, e.g. the wave kernel's rows-histogrammed count —
+    pass ``source="analytical"``)."""
+    rf = roofline_seconds(flops, nbytes)
+    frac = rf / achieved_s if achieved_s > 0 else 0.0
+    a = _agg.get(name)
+    if a is None:
+        a = _agg[name] = {"calls": 0, "achieved_s": 0.0, "flops": 0.0,
+                          "bytes": 0.0, "roofline_s": 0.0, "best_frac": 0.0}
+    a["calls"] += 1
+    a["achieved_s"] += achieved_s
+    a["flops"] += flops
+    a["bytes"] += nbytes
+    a["roofline_s"] += rf
+    a["best_frac"] = max(a["best_frac"], frac)
+    core.event("kernel_profile", kernel=name, phase=core.current_phase(),
+               flops=flops, bytes=nbytes, achieved_s=round(achieved_s, 6),
+               roofline_s=round(rf, 9), roofline_frac=round(frac, 6),
+               device=device_kind(), **extra)
+
+
+class _Profiled:
+    """Sync-bracketing, cost-analyzing wrapper around one jitted callable.
+
+    The cost analysis is cached per input signature (shapes/dtypes/static
+    values), so steady-state calls pay one time read + one sync — exactly
+    the bracketing profile mode promises."""
+
+    __slots__ = ("name", "fn", "_costs")
+
+    def __init__(self, name: str, fn):
+        self.name = name
+        self.fn = fn
+        self._costs: Dict[tuple, Tuple[float, float]] = {}
+
+    def __call__(self, *args, **kwargs):
+        if not _on:
+            return self.fn(*args, **kwargs)
+        import jax
+        key = _sig(args, kwargs)
+        cost = self._costs.get(key)
+        if cost is None:
+            try:
+                ca = cost_analysis_dict(
+                    self.fn.lower(*args, **kwargs).compile())
+                cost = extract_cost(ca)
+            except Exception as exc:  # noqa: BLE001 — AOT API varies
+                if self.name not in _ca_warned:
+                    _ca_warned.add(self.name)
+                    log.warning("cost_analysis unavailable for %s (%s); "
+                                "profiling time only", self.name, exc)
+                cost = (0.0, 0.0)
+            self._costs[key] = cost
+            # warm the jit dispatch cache: the AOT lower().compile()
+            # above does NOT populate it, so without this untimed call
+            # the first recorded achieved_s would be dominated by
+            # trace+compile and poison the roofline aggregates (the fn
+            # is pure; the duplicated device work is profile-mode cost)
+            jax.block_until_ready(self.fn(*args, **kwargs))
+        t0 = time.perf_counter()
+        out = self.fn(*args, **kwargs)
+        jax.block_until_ready(out)
+        record_kernel(self.name, cost[0], cost[1],
+                      time.perf_counter() - t0)
+        return out
+
+
+def wrap(name: str, fn):
+    """Instrument a jitted callable under ``lgbm/<name>``-style naming.
+    Identity when profiling is off — the disabled path costs nothing."""
+    if not _on or fn is None:
+        return fn
+    if isinstance(fn, _Profiled):
+        return fn
+    return _Profiled(name, fn)
+
+
+def profile_digest() -> dict:
+    """Per-kernel aggregates for ``obs.digest()`` / bench embedding."""
+    out = {}
+    for name, a in _agg.items():
+        ach = a["achieved_s"]
+        out[name] = {
+            "calls": a["calls"],
+            "achieved_s": round(ach, 6),
+            "flops": a["flops"],
+            "bytes": a["bytes"],
+            "roofline_s": round(a["roofline_s"], 9),
+            "roofline_frac": round(a["roofline_s"] / ach, 6) if ach else 0.0,
+            "best_frac": round(a["best_frac"], 6),
+        }
+    return out
+
+
+def reset_profile() -> None:
+    _agg.clear()
+
+
+core._register_reset(reset_profile)
+if _on:
+    core._set_profile_active(True)

@@ -61,6 +61,10 @@ def summarize(events: List[dict]) -> dict:
     emitted by every process and are near-identical — metrics/timings of
     replicated training); counters are summed across processes' final
     ``summary`` events (collective bytes et al. are per-process).
+    Multi-process runs additionally get a cross-host phase-skew table
+    (the straggler report: a phase whose wall time diverges across
+    processes is where the collective waits pile up), and profile-mode
+    runs get per-kernel roofline aggregates + the memory-census peak.
     """
     procs = sorted({e["_proc"] for e in events if e["_proc"] >= 0})
     iters0 = [e for e in events if e.get("event") == "iteration"
@@ -87,12 +91,14 @@ def summarize(events: List[dict]) -> dict:
     counters = defaultdict(float)
     summaries = [e for e in events if e.get("event") == "summary"]
     sum_phase = defaultdict(float)
+    proc_phase = defaultdict(dict)   # proc -> {phase: seconds}
     for e in summaries:
         for k, v in (e.get("counters") or {}).items():
             if isinstance(v, (int, float)):
                 counters[k] += v
         for k, v in (e.get("phase_s") or {}).items():
             sum_phase[k] += float(v)
+            proc_phase[e["_proc"]][k] = float(v)
         for k, v in (e.get("phase_calls") or {}).items():
             phase_calls[k] += int(v)
     # the atexit summaries carry authoritative totals including phases
@@ -110,7 +116,7 @@ def summarize(events: List[dict]) -> dict:
                 counters[f"collective/{kind}/{tag}bytes"] += e.get("bytes", 0)
 
     last = per_iteration[-1] if per_iteration else {}
-    return {
+    out = {
         "processes": procs,
         "iterations": len(per_iteration),
         "per_iteration": per_iteration,
@@ -123,6 +129,156 @@ def summarize(events: List[dict]) -> dict:
         "parse_errors": sum(e.get("count", 0) for e in events
                             if e.get("event") == "_parse_errors"),
     }
+    skew = phase_skew(proc_phase)
+    if skew:
+        out["phase_skew"] = skew
+    kernels = kernel_summary(events)
+    if kernels:
+        out["kernels"] = kernels
+    mem = memory_summary(events)
+    if mem:
+        out["memory"] = mem
+    return out
+
+
+def phase_skew(proc_phase: dict) -> dict:
+    """Cross-host straggler table from per-process phase totals: for each
+    phase seen by >1 process, the min/max seconds and the spread as a
+    fraction of the mean.  A phase with high spread_frac is where the
+    slow host makes everyone else wait at the next collective
+    (reference: the Network::Allreduce barrier in
+    data_parallel_tree_learner.cpp)."""
+    if len(proc_phase) < 2:
+        return {}
+    names = set()
+    for d in proc_phase.values():
+        names.update(d)
+    out = {}
+    for name in sorted(names):
+        vals = [d[name] for d in proc_phase.values() if name in d]
+        if len(vals) < 2:
+            continue
+        mean = sum(vals) / len(vals)
+        out[name] = {
+            "min_s": round(min(vals), 4),
+            "max_s": round(max(vals), 4),
+            "spread_s": round(max(vals) - min(vals), 4),
+            "spread_frac": round((max(vals) - min(vals)) / mean, 4)
+            if mean else 0.0,
+        }
+    return out
+
+
+def kernel_summary(events: List[dict]) -> dict:
+    """Aggregate ``kernel_profile`` events per kernel: call count, total
+    achieved seconds, summed analytical roofline seconds, and the
+    roofline fraction (roofline/achieved — 1.0 means running AT the
+    analytical floor)."""
+    agg = {}
+    for e in events:
+        if e.get("event") != "kernel_profile":
+            continue
+        k = e.get("kernel", "?")
+        a = agg.setdefault(k, {"calls": 0, "achieved_s": 0.0,
+                               "roofline_s": 0.0, "flops": 0.0,
+                               "bytes": 0.0})
+        a["calls"] += 1
+        a["achieved_s"] += float(e.get("achieved_s", 0.0) or 0.0)
+        a["roofline_s"] += float(e.get("roofline_s", 0.0) or 0.0)
+        a["flops"] += float(e.get("flops", 0.0) or 0.0)
+        a["bytes"] += float(e.get("bytes", 0.0) or 0.0)
+    for a in agg.values():
+        ach = a["achieved_s"]
+        a["achieved_s"] = round(ach, 6)
+        a["roofline_s"] = round(a["roofline_s"], 9)
+        a["roofline_frac"] = round(a["roofline_s"] / ach, 6) if ach else 0.0
+    return dict(sorted(agg.items()))
+
+
+def memory_summary(events: List[dict]) -> dict:
+    """Fold ``memory_census`` + ``donation_audit`` events into the census
+    digest: run peak, last per-buffer attribution, audit survivors."""
+    peak = 0
+    peak_phase = ""
+    last_buffers = {}
+    survivors = []
+    n = 0
+    for e in events:
+        if e.get("event") == "memory_census":
+            n += 1
+            basis = max(int(e.get("peak_bytes", 0) or 0),
+                        int(e.get("device_peak_bytes", 0) or 0),
+                        int(e.get("live_bytes", 0) or 0))
+            if basis > peak:
+                peak = basis
+                peak_phase = e.get("phase", "")
+            if e.get("buffers"):
+                last_buffers = e["buffers"]
+        elif e.get("event") == "donation_audit":
+            survivors.extend(e.get("survivors") or [])
+    if not n:
+        return {}
+    out = {"peak_bytes": peak, "peak_phase": peak_phase, "snapshots": n,
+           "buffers_last": last_buffers}
+    if survivors:
+        out["audit_survivors"] = sorted(set(survivors))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Event schemas — the CI smoke validates profile-mode streams against these
+# ---------------------------------------------------------------------------
+
+_NUM = (int, float)
+EVENT_SCHEMAS = {
+    # event name -> {field: (types..., required)}
+    "kernel_profile": {
+        "kernel": (str, True),
+        "phase": (str, False),
+        "flops": (_NUM, True),
+        "bytes": (_NUM, True),
+        "achieved_s": (_NUM, True),
+        "roofline_s": (_NUM, True),
+        "roofline_frac": (_NUM, True),
+        "device": (str, True),
+    },
+    "memory_census": {
+        "phase": (str, True),
+        "buffers": (dict, True),
+        "live_bytes": (int, True),
+        "live_count": (int, True),
+        "unattributed_bytes": (int, True),
+        "peak_bytes": (int, True),
+    },
+    "donation_audit": {
+        "phase": (str, True),
+        "survivors": (list, True),
+    },
+}
+
+
+def validate_events(events: List[dict], kinds=None) -> List[str]:
+    """Schema-check every event whose name is in ``EVENT_SCHEMAS`` (or in
+    ``kinds`` when given).  Returns human-readable problem strings —
+    empty means the stream is well-formed.  Pure structural validation;
+    semantic checks (nonzero FLOPs etc.) belong to the caller."""
+    problems = []
+    for i, e in enumerate(events):
+        name = e.get("event")
+        if name not in EVENT_SCHEMAS or (kinds and name not in kinds):
+            continue
+        for field, (types, required) in EVENT_SCHEMAS[name].items():
+            if field not in e:
+                if required:
+                    problems.append(f"event {i} ({name}): missing {field!r}")
+                continue
+            v = e[field]
+            # bool is an int subclass; schemas here never mean bool
+            if isinstance(v, bool) or not isinstance(v, types):
+                problems.append(
+                    f"event {i} ({name}): {field!r} has type "
+                    f"{type(v).__name__}, wanted {types}")
+    return problems
 
 
 def render(digest: dict) -> str:
@@ -160,6 +316,36 @@ def render(digest: dict) -> str:
         if digest.get("cum_row_iters_per_s"):
             out.append(f"cumulative row-iterations/s: "
                        f"{digest['cum_row_iters_per_s']:,}")
+    if digest.get("phase_skew"):
+        out.append("")
+        out.append(f"{'phase skew (cross-process)':<28}{'min_s':>9}"
+                   f"{'max_s':>9}{'spread':>9}{'frac':>7}")
+        for name, s in sorted(digest["phase_skew"].items(),
+                              key=lambda kv: -kv[1]["spread_frac"]):
+            out.append(f"{name:<28}{s['min_s']:>9.3f}{s['max_s']:>9.3f}"
+                       f"{s['spread_s']:>9.3f}{s['spread_frac']:>6.1%}")
+    if digest.get("kernels"):
+        out.append("")
+        out.append(f"{'kernel':<28}{'calls':>6}{'achieved':>10}"
+                   f"{'roofline':>10}{'frac':>8}")
+        for name, k in sorted(digest["kernels"].items(),
+                              key=lambda kv: -kv[1]["achieved_s"]):
+            out.append(f"{name:<28}{k['calls']:>6}"
+                       f"{k['achieved_s']:>9.3f}s"
+                       f"{k['roofline_s']:>9.4f}s"
+                       f"{k['roofline_frac']:>8.4f}")
+    if digest.get("memory"):
+        m = digest["memory"]
+        out.append("")
+        out.append(f"memory census: peak {m['peak_bytes']:,} bytes "
+                   f"(phase {m.get('peak_phase', '?')!r}, "
+                   f"{m.get('snapshots', 0)} snapshots)")
+        for name, b in sorted((m.get("buffers_last") or {}).items(),
+                              key=lambda kv: -kv[1]):
+            out.append(f"  {name:<26} {b:>14,}")
+        if m.get("audit_survivors"):
+            out.append(f"  RELEASE-AUDIT SURVIVORS: "
+                       f"{', '.join(m['audit_survivors'])}")
     if digest["counters"]:
         out.append("")
         out.append("counters:")

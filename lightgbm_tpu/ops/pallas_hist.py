@@ -38,6 +38,11 @@ C_MAX = 128
 _DEF_BR = 1024
 _DEF_FB = 32  # uint8 sublane tile
 
+# pallas-tpu renamed TPUCompilerParams -> CompilerParams between the jax
+# versions we run on (CPU CI container vs TPU image); take whichever exists
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or \
+    getattr(pltpu, "TPUCompilerParams")
+
 
 def _hist_kernel(bins_ref, gh_ref, out_ref, *, B: int, FB: int):
     i = pl.program_id(1)
@@ -92,7 +97,7 @@ def hist_pallas_channels(bins_fm, gh, B: int, block_rows: int = _DEF_BR,
         out_specs=pl.BlockSpec((FB, B, C), lambda j, i: (j, 0, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((Fp, B, C), jnp.float32),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary")),
     )(bins_fm, gh)
     return out[:F]
@@ -184,6 +189,39 @@ def _resolve_mode(highest) -> str:
     return "highest" if highest else "bf16"
 
 
+# MXU passes per precision mode (see _hist_wave_kernel)
+WAVE_MXU_PASSES = {"highest": 3, "2xbf16": 2, "bf16": 1}
+
+
+def wave_kernel_cost(rows, F: int, B: int, mode="2xbf16",
+                     feat_block: int = _DEF_FB, waves: int = 1):
+    """Analytical (FLOPs, HBM bytes) of ``hist_pallas_wave`` over ``rows``
+    total rows across ``waves`` kernel launches — ``docs/ROOFLINE.md``'s
+    hand-written cost model in code, so profile mode and
+    ``tools/prof_kernels.py`` compare measured kernel time against the
+    same numbers the doc quotes.
+
+    FLOPs are what the MXU is CHARGED, not useful work: the one-hot
+    operand is 255/256 zeros but every lane is paid for.  Mirrors the
+    kernel's feature packing (B <= 64 packs 128//B features per matmul);
+    an unpacked B < 128 operand still occupies one full 128-lane group.
+    Bytes count the HBM legs only — bins + packed [N, 4] vectors read
+    once per ROW, the [F, B, C] output written once per LAUNCH (hence
+    ``waves``); the one-hot factor lives in VMEM and never touches HBM.
+    ``rows`` is the tier-compacted total (the wave grower's
+    ``report_waves`` stats carry exactly this figure).
+    """
+    mode = _resolve_mode(mode)
+    passes = WAVE_MXU_PASSES[mode]
+    pack = max(1, 128 // B) if 128 % B == 0 and \
+        feat_block % max(1, 128 // B) == 0 else 1
+    lanes = max(pack * B, C_MAX) / pack      # charged output rows / feature
+    flops = passes * 2.0 * float(rows) * F * lanes * C_MAX
+    nbytes = (float(rows) * (F * 1 + 4 * 4)
+              + max(int(waves), 1) * F * B * C_MAX * 4)
+    return flops, nbytes
+
+
 @functools.partial(jax.jit,
                    static_argnames=("B", "block_rows", "feat_block", "highest",
                                     "interpret"))
@@ -234,7 +272,7 @@ def hist_pallas_wave(bins_fm, gv, hv, cv, leaf_id, slot_leaf, B: int,
         out_specs=pl.BlockSpec((FB, B, C_MAX), lambda j, i: (j, 0, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((Fp, B, C_MAX), jnp.float32),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary")),
         interpret=interpret,
     )(bins_fm, vecs, slot_leaf.reshape(1, C_MAX))

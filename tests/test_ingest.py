@@ -145,13 +145,56 @@ def test_two_round_cli_matches_one_round(csv_problem, tmp_path):
 def test_parse_cols_trailing_delim_and_garbage():
     """Review-found edge cases: a trailing delimiter after the last wanted
     column must not read past the cols array, and garbage-prefixed fields
-    ("3.14.15") parse as NaN, not a silent prefix."""
+    ("3.14.15") abort the strict parse — never a silent prefix, never a
+    fabricated NaN (the lenient np.loadtxt fallback surfaces the error)."""
     from lightgbm_tpu import native
     got = native.csv_parse_cols(b"5,1,\n7,2,\n", ",", [0])
     np.testing.assert_array_equal(got, [[5], [7]])
-    got = native.csv_parse(b"3.14.15,2\n12abc,4\n", ",", 2)
-    assert np.isnan(got[0, 0]) and got[0, 1] == 2
-    assert np.isnan(got[1, 0]) and got[1, 1] == 4
+    assert native.csv_parse(b"3.14.15,2\n", ",", 2) is None
+    assert native.csv_parse(b"12abc,4\n", ",", 2) is None
+    assert native.csv_parse_cols(b"1,3.14.15\n", ",", [1]) is None
+
+
+def test_libsvm_nan_labels_rejected_unconditionally():
+    """ADVICE.md: any NaN label — garbage OR a literal na/nan token —
+    must abort the strict LibSVM parse (None -> Python fallback raises),
+    never train on NaN targets.  Feature VALUES stay NaN-tolerant."""
+    from lightgbm_tpu import native
+    if native.lib() is None:
+        pytest.skip("native library unavailable")
+    assert native.libsvm_parse(b"n0pe 1:0.5\n") is None     # typo'd label
+    assert native.libsvm_parse(b"nan 1:0.5\n") is None      # literal token
+    assert native.libsvm_parse(b"na 1:0.5\n") is None
+    assert native.libsvm_parse(b"1 1:1\nNaN 1:2\n") is None  # mid-chunk
+    out = native.libsvm_parse(b"1 qid:3 1:na 2:0.5\n")       # NA feature ok
+    assert out is not None
+    labels, _, _, _, vals, _ = out
+    assert labels[0] == 1 and np.isnan(vals[0]) and vals[1] == 0.5
+
+
+def test_is_na_token_exact_set():
+    """ADVICE.md: the NA token set is exact (na/nan/null/n/a/empty/?,
+    case-insensitive) — an n-prefixed typo is NOT silently missing: it
+    aborts the strict parse (malformed-row return) so the lenient
+    fallback surfaces the real error."""
+    from lightgbm_tpu import native
+    if native.lib() is None:
+        pytest.skip("native library unavailable")
+    got = native.csv_parse(b"na,NaN,NULL,n/a,?, \n", ",", 6)
+    assert np.isnan(got).all(), got
+    # glibc printf renders negative NaN as "-nan"; sign-prefixed nan is
+    # in the token set, but the sign blesses nan only
+    got = native.csv_parse(b"-nan,+NaN\n", ",", 2)
+    assert np.isnan(got).all(), got
+    assert native.csv_parse(b"-na,1\n", ",", 2) is None
+    assert native.csv_parse(b"-n/a,1\n", ",", 2) is None
+    for typo in (b"n0.5,2\n", b"none,4\n", b"noNe3,6\n", b"negative,1\n"):
+        assert native.csv_parse(typo, ",", 2) is None, typo
+    assert native.libsvm_parse(b"n0.5 1:1\n") is None
+    assert native.libsvm_parse(b"none 1:1\n") is None
+    # numbers and NA tokens still coexist on one row
+    got = native.csv_parse(b"1.5,na,2e3\n", ",", 3)
+    assert got[0, 0] == 1.5 and np.isnan(got[0, 1]) and got[0, 2] == 2000
 
 
 def test_two_round_no_trailing_newline(tmp_path):

@@ -1,0 +1,309 @@
+"""Observability tooling: telemetry_report multi-process merging,
+bench_history trajectory/regression flagging, the prof_kernels harness's
+CPU smoke, and the end-to-end profile-mode CI smoke (train tiny with
+telemetry+profile, then run the tools over the artifacts and
+schema-validate the event stream)."""
+import json
+import os
+import runpy
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.obs.report import (load_events, phase_skew, render,
+                                     summarize, validate_events)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = os.path.join(REPO, "tools")
+
+
+def _write_events(path, events):
+    with open(path, "w") as fh:
+        for e in events:
+            fh.write(json.dumps(e) + "\n")
+
+
+def _iter_event(proc, i, phase_s):
+    return {"event": "iteration", "t": 1.0 + i, "iteration": i,
+            "num_class": 1, "leaves": [7], "waves": None,
+            "iter_s": sum(phase_s.values()), "phase_s": phase_s,
+            "metrics": {"training.auc": 0.9 + 0.001 * i + 0.0001 * proc},
+            "counters": {}, "recompiles": 0,
+            "cum_row_iters_per_s": 1000.0 * (i + 1)}
+
+
+def _summary_event(phase_s, counters):
+    return {"event": "summary", "t": 99.0, "phase_s": phase_s,
+            "phase_calls": {k: 3 for k in phase_s}, "counters": counters}
+
+
+# ---------------------------------------------------------------------------
+# telemetry_report: multi-process merge
+# ---------------------------------------------------------------------------
+
+def test_report_merges_multiprocess_files(tmp_path):
+    """Per-process telemetry.{i}.jsonl files merge into one digest:
+    iteration rows from process 0, counters summed across processes,
+    and the cross-host phase-skew table computed from the per-process
+    summaries."""
+    p0 = {"tree growth": 2.0, "boosting (grad/hess)": 0.5}
+    p1 = {"tree growth": 3.0, "boosting (grad/hess)": 0.5}
+    _write_events(tmp_path / "telemetry.0.jsonl",
+                  [_iter_event(0, i, p0) for i in range(3)]
+                  + [_summary_event(p0, {"collective/psum/traced_bytes":
+                                         1000})])
+    _write_events(tmp_path / "telemetry.1.jsonl",
+                  [_iter_event(1, i, p1) for i in range(3)]
+                  + [_summary_event(p1, {"collective/psum/traced_bytes":
+                                         1200})])
+    digest = summarize(load_events(str(tmp_path)))
+    assert digest["processes"] == [0, 1]
+    assert digest["iterations"] == 3
+    # process-0 metrics picked for the per-iteration rows
+    assert digest["per_iteration"][0]["metrics"]["training.auc"] == 0.9
+    # counters summed across both processes' summaries
+    assert digest["counters"]["collective/psum/traced_bytes"] == 2200
+    # phase totals come from the summaries (both procs)
+    assert digest["phase_s"]["tree growth"] == 5.0
+    # the straggler table: proc 1 is 1s slower in tree growth
+    skew = digest["phase_skew"]["tree growth"]
+    assert skew["min_s"] == 2.0 and skew["max_s"] == 3.0
+    assert skew["spread_s"] == 1.0
+    assert skew["spread_frac"] == pytest.approx(1.0 / 2.5)
+    # identical phases show no skew
+    assert digest["phase_skew"]["boosting (grad/hess)"]["spread_s"] == 0.0
+    text = render(digest)
+    assert "phase skew" in text and "tree growth" in text
+
+
+def test_phase_skew_single_process_empty():
+    assert phase_skew({0: {"a": 1.0}}) == {}
+
+
+def test_report_tool_cli_multiprocess(tmp_path, capsys, monkeypatch):
+    p0 = {"tree growth": 1.0}
+    _write_events(tmp_path / "telemetry.0.jsonl",
+                  [_iter_event(0, 0, p0), _summary_event(p0, {})])
+    _write_events(tmp_path / "telemetry.1.jsonl",
+                  [_iter_event(1, 0, p0), _summary_event(p0, {})])
+    tool = os.path.join(TOOLS, "telemetry_report.py")
+    monkeypatch.setattr(sys, "argv", [tool, str(tmp_path), "--json"])
+    with pytest.raises(SystemExit) as ei:
+        runpy.run_path(tool, run_name="__main__")
+    assert ei.value.code == 0
+    digest = json.loads(capsys.readouterr().out)
+    assert digest["processes"] == [0, 1]
+
+
+# ---------------------------------------------------------------------------
+# bench_history: trajectory + regression flagging
+# ---------------------------------------------------------------------------
+
+def _bench_round(n, value, per_iter_s, backend=None, **extra):
+    parsed = {"metric": "train_throughput", "value": value,
+              "unit": "row_iters/s", "vs_baseline": value / 2.2e7,
+              "rows": 1000, "iters": 5, "num_leaves": 31, "max_bin": 255,
+              "per_iter_s": per_iter_s, "compile_s": 3.0,
+              "train_auc": 0.9}
+    if backend:
+        parsed["backend"] = backend
+    parsed.update(extra)
+    return {"n": n, "cmd": "python bench.py", "rc": 0, "parsed": parsed}
+
+
+def _history(tmp_path, rounds, *args):
+    sys.path.insert(0, TOOLS)
+    try:
+        import bench_history
+    finally:
+        sys.path.remove(TOOLS)
+    for i, r in enumerate(rounds, 1):
+        with open(tmp_path / f"BENCH_r{i:02d}.json", "w") as fh:
+            json.dump(r, fh)
+    rows = bench_history.collect([str(tmp_path)])
+    return bench_history, rows
+
+
+def test_bench_history_flags_regression(tmp_path):
+    bh, rows = _history(tmp_path, [
+        _bench_round(1, 1000.0, 1.0),
+        _bench_round(2, 2000.0, 0.5),
+        _bench_round(3, 1200.0, 0.9,           # 40% throughput drop vs r02
+                     peak_hbm_bytes=5_000_000),
+    ])
+    assert [r["round"] for r in rows] == ["r01", "r02", "r03"]
+    regs = bh.find_regressions(rows, threshold=0.1)
+    by_metric = {r["metric"]: r for r in regs}
+    assert "value" in by_metric
+    assert by_metric["value"]["best_round"] == "r02"
+    assert by_metric["value"]["change_frac"] == pytest.approx(-0.4)
+    assert "per_iter_s" in by_metric      # lower-is-better direction
+    assert by_metric["per_iter_s"]["change_frac"] == pytest.approx(0.8)
+    # peak_hbm_bytes only exists in r03 — no prior, no flag
+    assert "peak_hbm_bytes" not in by_metric
+    text = bh.render(rows, regs)
+    assert "REGRESSIONS" in text and "value" in text
+
+
+def test_bench_history_no_flags_when_improving(tmp_path):
+    bh, rows = _history(tmp_path, [
+        _bench_round(1, 1000.0, 1.0),
+        _bench_round(2, 3000.0, 0.3),
+    ])
+    assert bh.find_regressions(rows, threshold=0.1) == []
+
+
+def test_bench_history_contexts_not_comparable(tmp_path):
+    """A CPU-fallback round must not 'regress' against a real round."""
+    bh, rows = _history(tmp_path, [
+        _bench_round(1, 100000.0, 0.1),
+        _bench_round(2, 500.0, 2.0, backend="cpu-fallback"),
+    ])
+    assert bh.find_regressions(rows, threshold=0.1) == []
+
+
+def test_bench_history_unparsed_round_and_telemetry_fold(tmp_path):
+    """parsed:null rounds ride along noteless-metric; embedded telemetry
+    digests contribute peak-HBM and kernel roofline trajectory metrics."""
+    td = {"phase_s": {"tree growth": 1.0}, "phase_calls": {},
+          "counters": {"jax/compiles": 7},
+          "kernels": {"lgbm/grow_apply": {"calls": 3, "achieved_s": 1.0,
+                                          "roofline_s": 0.2,
+                                          "roofline_frac": 0.2}},
+          "memory": {"peak_bytes": 123456, "peak_phase": "tree growth"}}
+    bh, rows = _history(tmp_path, [
+        {"n": 1, "cmd": "python bench.py", "rc": 0, "parsed": None},
+        _bench_round(2, 1000.0, 1.0, telemetry=td),
+    ])
+    assert rows[0]["note"] == "no parsed bench line"
+    m = rows[1]["metrics"]
+    assert m["peak_hbm_bytes"] == 123456
+    assert m["kernel_roofline/lgbm/grow_apply"] == 0.2
+    assert m["jax_compiles"] == 7
+
+
+def test_bench_history_cli_exit_codes(tmp_path, monkeypatch, capsys):
+    tool = os.path.join(TOOLS, "bench_history.py")
+    for i, r in enumerate([_bench_round(1, 2000.0, 0.5),
+                           _bench_round(2, 1000.0, 1.0)], 1):
+        with open(tmp_path / f"BENCH_r{i:02d}.json", "w") as fh:
+            json.dump(r, fh)
+    monkeypatch.setattr(sys, "argv", [tool, str(tmp_path), "--json"])
+    with pytest.raises(SystemExit) as ei:
+        runpy.run_path(tool, run_name="__main__")
+    assert ei.value.code == 0          # flags reported, exit 0 by default
+    out = json.loads(capsys.readouterr().out)
+    assert any(g["metric"] == "value" for g in out["regressions"])
+    monkeypatch.setattr(sys, "argv", [tool, str(tmp_path),
+                                      "--fail-on-regression"])
+    with pytest.raises(SystemExit) as ei:
+        runpy.run_path(tool, run_name="__main__")
+    assert ei.value.code == 1
+
+
+# ---------------------------------------------------------------------------
+# prof_kernels: CPU interpret smoke
+# ---------------------------------------------------------------------------
+
+def test_prof_kernels_interpret_smoke(tmp_path, monkeypatch, capsys):
+    """The promoted harness runs its kernel leg on CPU via PROF_INTERPRET
+    and reports measured + roofline + fraction with nonzero cost-model
+    numbers (the between-TPU-windows guard the old prof_decompose.py
+    never had)."""
+    for k, v in {"PROF_INTERPRET": "1", "PROF_ROWS": "1536",
+                 "PROF_FEATURES": "4", "PROF_LEAVES": "7",
+                 "PROF_CAPACITY": "4", "PROF_REPEAT": "1",
+                 "PROF_LEGS": "kernel", "PROF_JSON": "1"}.items():
+        monkeypatch.setenv(k, v)
+    tool = os.path.join(TOOLS, "prof_kernels.py")
+    monkeypatch.setattr(sys, "argv", [tool])
+    with pytest.raises(SystemExit) as ei:
+        runpy.run_path(tool, run_name="__main__")
+    assert ei.value.code == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    payload = json.loads(out[-1])
+    leg = payload["legs"]["kernel full pass"]
+    assert leg["seconds"] > 0
+    assert leg["flops"] > 0 and leg["bytes"] > 0
+    assert leg["roofline_s"] > 0 and leg["roofline_frac"] > 0
+
+
+def test_wave_kernel_cost_matches_roofline_doc():
+    """wave_kernel_cost at the HIGGS bench shape reproduces the 3.67
+    TFLOP / ~9.3 ms numbers docs/ROOFLINE.md quotes for v5e."""
+    from lightgbm_tpu.obs.profile import roofline_seconds
+    from lightgbm_tpu.ops.pallas_hist import wave_kernel_cost
+    flops, nbytes = wave_kernel_cost(1_000_000, 28, 256, "2xbf16")
+    assert flops == pytest.approx(2 * 2 * 256 * 128 * 1e6 * 28)
+    t = roofline_seconds(flops, nbytes, peaks=(394e12, 820e9))
+    assert t == pytest.approx(9.3e-3, rel=0.02)
+    # feature packing: B=64 really is 4x cheaper
+    flops64, _ = wave_kernel_cost(1_000_000, 28, 64, "2xbf16")
+    assert flops64 == pytest.approx(flops / 4)
+
+
+# ---------------------------------------------------------------------------
+# end-to-end CI smoke: profile-mode train -> tools over the artifacts
+# ---------------------------------------------------------------------------
+
+def test_profile_smoke_end_to_end(tmp_path):
+    """Tier-1-safe acceptance smoke: train a tiny model with telemetry +
+    profile enabled in a fresh CPU interpreter, then run
+    telemetry_report.py and bench_history.py over the artifacts and
+    schema-validate the kernel_profile / memory_census events."""
+    sink = tmp_path / "telem"
+    code = (
+        "import json, numpy as np, lightgbm_tpu as lgb\n"
+        "from lightgbm_tpu import obs\n"
+        "rng = np.random.default_rng(0)\n"
+        "X = rng.normal(size=(400, 5)); y = (X[:, 0] > 0).astype(float)\n"
+        "p = {'objective': 'binary', 'num_leaves': 5, 'tpu_profile': True,\n"
+        "     'min_data_in_leaf': 5, 'verbose': -1}\n"
+        "bst = lgb.train(p, lgb.Dataset(X, label=y, params=p), 3)\n"
+        "assert bst.num_trees() == 3\n"
+        "assert obs.profile_enabled() and obs.peak_bytes() > 0\n")
+    env = dict(os.environ)
+    env["LGBM_TPU_TELEMETRY"] = str(sink)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, env=env, timeout=240)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+    events = load_events(str(sink))
+    assert validate_events(events) == [], validate_events(events)
+    kp = [e for e in events if e.get("event") == "kernel_profile"]
+    assert kp and all(e["flops"] > 0 and e["bytes"] > 0
+                      and e["roofline_frac"] > 0 for e in kp)
+    mc = [e for e in events if e.get("event") == "memory_census"]
+    assert mc and mc[-1]["peak_bytes"] > 0
+
+    # telemetry_report over the artifact
+    rep = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "telemetry_report.py"),
+         str(sink), "--json"], capture_output=True, text=True, timeout=60)
+    assert rep.returncode == 0, rep.stderr
+    digest = json.loads(rep.stdout)
+    assert digest["iterations"] == 3
+    assert digest["kernels"] and digest["memory"]["peak_bytes"] > 0
+
+    # bench_history over a bench-shaped round embedding that digest
+    row = {"n": 1, "rc": 0,
+           "parsed": {"value": 1000.0, "rows": 400, "iters": 3,
+                      "num_leaves": 5, "max_bin": 255,
+                      "peak_hbm_bytes": digest["memory"]["peak_bytes"],
+                      "telemetry": {"kernels": digest["kernels"],
+                                    "memory": digest["memory"],
+                                    "counters": digest["counters"]}}}
+    with open(tmp_path / "BENCH_r01.json", "w") as fh:
+        json.dump(row, fh)
+    bh = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "bench_history.py"),
+         str(tmp_path), "--json"], capture_output=True, text=True,
+        timeout=60)
+    assert bh.returncode == 0, bh.stderr
+    hist = json.loads(bh.stdout)
+    assert hist["rounds"][0]["metrics"]["peak_hbm_bytes"] > 0
+    assert hist["regressions"] == []

@@ -1,0 +1,255 @@
+"""Merge per-round bench results (+ telemetry digests) into a metric
+trajectory table and flag regressions.
+
+Every round the driver runs ``python bench.py`` and stores its one JSON
+line (plus exit metadata) as ``BENCH_r{NN}.json``.  Those files answer
+"what was the number THIS round"; nothing answered "is the number moving
+the wrong way".  This tool does:
+
+    python tools/bench_history.py [path ...] [--json] [--threshold 0.1]
+                                  [--fail-on-regression]
+
+``path`` entries are bench-round JSON files, telemetry digest JSON files
+(``telemetry_report.py --json`` output), or directories to glob for
+``BENCH_r*.json`` (default: the repo root).  Rounds whose bench produced
+no parseable line (``"parsed": null`` — e.g. round 1's empty tail) are
+listed but carry no metrics.
+
+Regression flagging compares each metric of the LATEST comparable round
+against the best earlier comparable round — comparable meaning the same
+(backend, rows, iters, num_leaves, max_bin) context, so a CPU-fallback
+round never "regresses" against a real TPU round.  Direction is
+per-metric (throughput up is good, per-iter seconds down is good); a
+move worse than ``--threshold`` (default 10%) is flagged.
+``--fail-on-regression`` turns flags into exit code 1 for CI use.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import List, Optional
+
+# metric name (or prefix ending in *) -> True when higher is better
+_DIRECTIONS = [
+    ("value", True),
+    ("vs_baseline", True),
+    ("train_auc", True),
+    ("train_ndcg10", True),
+    ("rank_row_iters_per_s", True),
+    ("rank_vs_baseline", True),
+    ("rank_train_ndcg10", True),
+    ("kernel_roofline/*", True),
+    ("per_iter_s", False),
+    ("rank_per_iter_s", False),
+    ("compile_s", False),
+    ("rank_compile_s", False),
+    ("binning_s", False),
+    ("rank_binning_s", False),
+    ("implied_higgs_500iter_s", False),
+    ("implied_mslr_500iter_s", False),
+    ("peak_hbm_bytes", False),
+]
+
+# the headline columns of the human table, in order
+_TABLE_COLS = ["value", "vs_baseline", "per_iter_s", "compile_s",
+               "train_auc", "rank_row_iters_per_s", "peak_hbm_bytes"]
+
+_CONTEXT_KEYS = ("backend", "rows", "iters", "num_leaves", "max_bin")
+
+
+def metric_direction(name: str) -> Optional[bool]:
+    """True = higher is better, False = lower, None = untracked."""
+    for pat, up in _DIRECTIONS:
+        if pat.endswith("*"):
+            if name.startswith(pat[:-1]):
+                return up
+        elif name == pat:
+            return up
+    return None
+
+
+def _round_tag(path: str, payload: dict) -> str:
+    m = re.search(r"r(\d+)", os.path.basename(path))
+    if m:
+        return f"r{int(m.group(1)):02d}"
+    n = payload.get("n")
+    return f"r{int(n):02d}" if isinstance(n, int) else os.path.basename(path)
+
+
+def load_round(path: str) -> dict:
+    """One trajectory row from a bench-round file or a telemetry digest.
+
+    Returns {"round", "context", "metrics", "note"?}; metrics is flat
+    {name: number} with telemetry-derived entries namespaced
+    (``phase_s/<phase>``, ``kernel_roofline/<kernel>``)."""
+    with open(path) as fh:
+        payload = json.load(fh)
+    row = {"round": _round_tag(path, payload), "path": path, "metrics": {}}
+    parsed = payload.get("parsed", payload)
+    if parsed is None:
+        row["note"] = "no parsed bench line"
+        row["context"] = None
+        return row
+    if "per_iteration" in parsed:  # a telemetry_report.py --json digest
+        row["context"] = ("telemetry",)
+        if parsed.get("cum_row_iters_per_s"):
+            row["metrics"]["value"] = float(parsed["cum_row_iters_per_s"])
+        for k, v in (parsed.get("phase_s") or {}).items():
+            row["metrics"][f"phase_s/{k}"] = float(v)
+        for k, v in (parsed.get("metrics_last") or {}).items():
+            row["metrics"][k] = float(v)
+        _fold_digest(row["metrics"], parsed)
+        return row
+    row["context"] = tuple(parsed.get(k) for k in _CONTEXT_KEYS)
+    for k, v in parsed.items():
+        if isinstance(v, bool) or k == "n":
+            continue
+        if isinstance(v, (int, float)):
+            row["metrics"][k] = v
+    if isinstance(parsed.get("kernel_roofline"), dict):
+        for k, v in parsed["kernel_roofline"].items():
+            row["metrics"][f"kernel_roofline/{k}"] = float(v)
+    td = parsed.get("telemetry")
+    if isinstance(td, dict):
+        _fold_digest(row["metrics"], td)
+    return row
+
+
+def _fold_digest(metrics: dict, digest: dict) -> None:
+    """Pull trajectory-worthy numbers out of an obs digest."""
+    counters = digest.get("counters") or {}
+    if "jax/compiles" in counters:
+        metrics.setdefault("jax_compiles", float(counters["jax/compiles"]))
+    mem = digest.get("memory") or {}
+    if mem.get("peak_bytes"):
+        metrics.setdefault("peak_hbm_bytes", float(mem["peak_bytes"]))
+    for k, v in (digest.get("kernels") or {}).items():
+        metrics.setdefault(f"kernel_roofline/{k}",
+                           float(v.get("roofline_frac", 0.0)))
+
+
+def collect(paths: List[str]) -> List[dict]:
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            files.extend(sorted(glob.glob(os.path.join(p, "BENCH_r*.json"))))
+        else:
+            files.append(p)
+    rows = []
+    for f in files:
+        try:
+            rows.append(load_round(f))
+        except (OSError, ValueError) as exc:
+            rows.append({"round": os.path.basename(f), "path": f,
+                         "context": None, "metrics": {},
+                         "note": f"unreadable: {exc}"})
+    rows.sort(key=lambda r: r["round"])
+    return rows
+
+
+def find_regressions(rows: List[dict], threshold: float) -> List[dict]:
+    """Latest comparable round vs the best earlier comparable value, per
+    tracked metric."""
+    latest = next((r for r in reversed(rows) if r["metrics"]), None)
+    if latest is None:
+        return []
+    prior = [r for r in rows
+             if r is not latest and r["metrics"]
+             and r["context"] == latest["context"]]
+    if not prior:
+        return []
+    out = []
+    for name, cur in latest["metrics"].items():
+        up = metric_direction(name)
+        if up is None:
+            continue
+        vals = [(r["round"], r["metrics"][name]) for r in prior
+                if name in r["metrics"]]
+        if not vals:
+            continue
+        best_round, best = (max if up else min)(vals, key=lambda rv: rv[1])
+        if not best:
+            continue
+        change = (cur - best) / abs(best)
+        worse = -change if up else change
+        if worse > threshold:
+            out.append({
+                "metric": name, "round": latest["round"],
+                "value": cur, "best": best, "best_round": best_round,
+                "change_frac": round(change, 4),
+                "direction": "higher_is_better" if up
+                else "lower_is_better",
+            })
+    return sorted(out, key=lambda r: -abs(r["change_frac"]))
+
+
+def render(rows: List[dict], regressions: List[dict]) -> str:
+    cols = [c for c in _TABLE_COLS
+            if any(c in r["metrics"] for r in rows)]
+    out = [f"{'round':<6}{'context':<34}"
+           + "".join(f"{c:>22}" for c in cols)]
+    for r in rows:
+        ctx = "-" if r["context"] is None else \
+            ",".join(str(x) for x in r["context"])
+        line = f"{r['round']:<6}{ctx[:33]:<34}"
+        for c in cols:
+            v = r["metrics"].get(c)
+            if v is None:
+                line += f"{'-':>22}"
+            elif abs(v) >= 1e6:
+                line += f"{v:>22,.0f}"
+            else:
+                line += f"{v:>22,.4g}"
+        if r.get("note"):
+            line += f"  ({r['note']})"
+        out.append(line)
+    if regressions:
+        out.append("")
+        out.append("REGRESSIONS (latest vs best comparable prior round):")
+        for g in regressions:
+            out.append(
+                f"  {g['metric']:<32} {g['value']:>14,.6g} vs best "
+                f"{g['best']:>14,.6g} ({g['best_round']}) "
+                f"{g['change_frac']:+.1%} [{g['direction']}]")
+    else:
+        out.append("")
+        out.append("no regressions against comparable prior rounds")
+    return "\n".join(out)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description="Bench-round trajectory table + regression flags")
+    ap.add_argument("paths", nargs="*",
+                    default=[os.path.dirname(os.path.dirname(
+                        os.path.abspath(__file__)))],
+                    help="BENCH_r*.json files, telemetry digests, or "
+                         "directories (default: repo root)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable digest instead of the table")
+    ap.add_argument("--threshold", type=float, default=0.1,
+                    help="relative worsening that counts as a regression "
+                         "(default 0.10)")
+    ap.add_argument("--fail-on-regression", action="store_true",
+                    help="exit 1 when any regression is flagged")
+    args = ap.parse_args()
+    rows = collect(args.paths)
+    if not rows:
+        print("no bench rounds found", file=sys.stderr)
+        return 1
+    regressions = find_regressions(rows, args.threshold)
+    if args.json:
+        print(json.dumps({"rounds": rows, "regressions": regressions}))
+    else:
+        print(render(rows, regressions))
+    if regressions and args.fail_on_regression:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

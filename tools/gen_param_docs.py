@@ -65,6 +65,28 @@ ENV_VARS = [
      "`python tools/telemetry_report.py <path>`.  Equivalent to the "
      "`tpu_telemetry` parameter.  Implies the same per-phase device "
      "synchronization as `LGBM_TPU_TIMETAG`."),
+    ("LGBM_TPU_PROFILE",
+     "set to `1` for profile mode (equivalent to the `tpu_profile` "
+     "parameter): every training phase and jitted `lgbm/*` unit is "
+     "sync-bracketed and cost-analyzed — `kernel_profile` events carry "
+     "XLA `cost_analysis()` FLOPs/bytes, achieved seconds, the "
+     "analytical roofline seconds, and the achieved roofline fraction; "
+     "`memory_census` events attribute live HBM bytes to logical "
+     "buffers (binned matrix, scores, forest SoA, ...) and track the "
+     "run peak; a release audit warns when a buffer expected to be "
+     "consumed survives its phase.  Events need a telemetry sink "
+     "configured; the aggregates land in the digest (and bench.py's "
+     "`peak_hbm_bytes` / `kernel_roofline` fields) either way.  The "
+     "gate is PROCESS-WIDE (like the telemetry sink): once on — via env "
+     "or any Booster's `tpu_profile` — every later Booster is "
+     "instrumented until `obs.enable_profile(False)`.  Profiling breaks "
+     "async dispatch by design — never benchmark with it on."),
+    ("LGBM_TPU_PEAK_FLOPS",
+     "override the profile mode's device peak FLOP/s (used with "
+     "`LGBM_TPU_PEAK_BW`) when the built-in per-chip table "
+     "(`obs/profile.py DEVICE_PEAKS`) mispredicts the hardware."),
+    ("LGBM_TPU_PEAK_BW",
+     "override the profile mode's device peak HBM bytes/s."),
     ("JAX_PLATFORMS",
      "standard JAX backend selector (`cpu` forces the XLA host path)."),
 ]

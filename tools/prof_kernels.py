@@ -1,0 +1,267 @@
+"""Wave-grower cost decomposition — the supported attribution harness.
+
+Promoted from the round-5 throwaway ``prof_decompose.py``: same four cost
+hypotheses, now sharing the cost-model code the profile mode uses
+(``obs.profile`` device peaks + ``ops.pallas_hist.wave_kernel_cost``), so
+every leg prints measured time NEXT TO its analytical roofline and the
+achieved fraction — the numbers ``docs/ROOFLINE.md``'s "measured" column
+is filled from, and the first thing to run in a TPU window.
+
+Legs (``PROF_LEGS`` comma-list, default all):
+  kernel    — bare ``hist_pallas_wave`` full passes vs the MXU roofline
+  full      — ``build_wave_grow_fn`` as shipped
+  nokernel  — kernel stubbed to shaped noise (everything-but-kernel)
+  nocompact — ``compact=False`` (no tier gathers, full-N kernel per wave)
+  gathers   — compaction-primitive microbenches (index build + tier
+              gathers, the nocompact-vs-full arbitration)
+
+Env knobs: ``PROF_ROWS`` (1_000_000), ``PROF_FEATURES`` (28),
+``PROF_LEAVES`` (255), ``PROF_MAXBIN`` (255), ``PROF_CAPACITY`` (42),
+``PROF_REPEAT`` (3), ``PROF_LEGS``, ``PROF_JSON=1`` (append one
+machine-readable JSON line), ``PROF_INTERPRET=1`` (Pallas interpreter
+mode — the CPU smoke path CI exercises between TPU windows).
+
+With a telemetry sink configured (``LGBM_TPU_TELEMETRY``) every timed leg
+also emits a ``kernel_profile`` event, so ``tools/telemetry_report.py``
+and ``bench_history.py`` see harness runs like training runs.
+
+Run: PYTHONPATH=/root/repo:/root/.axon_site python tools/prof_kernels.py
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+import lightgbm_tpu as lgb  # noqa: E402
+from lightgbm_tpu import obs  # noqa: E402
+from lightgbm_tpu.core import wave_grower  # noqa: E402
+from lightgbm_tpu.core.histogram import hist_onehot_cost  # noqa: E402
+from lightgbm_tpu.core.meta import (SplitConfig,  # noqa: E402
+                                    build_device_meta)
+from lightgbm_tpu.core.splitter import split_scan_cost  # noqa: E402
+from lightgbm_tpu.obs.profile import (cost_analysis_dict,  # noqa: E402
+                                      device_peaks, extract_cost,
+                                      roofline_seconds)
+from lightgbm_tpu.ops import pallas_hist  # noqa: E402
+
+INTERP = os.environ.get("PROF_INTERPRET", "") not in ("", "0")
+MODE = "2xbf16"
+
+
+def _env_int(name, default):
+    return int(os.environ.get(name, default))
+
+
+def timeit(fn, *args, n=3, warmup=1):
+    for _ in range(warmup):
+        jax.block_until_ready(fn(*args))
+    t0 = time.time()
+    out = None
+    for _ in range(n):
+        out = jax.block_until_ready(fn(*args))
+    return (time.time() - t0) / n, out
+
+
+def build_problem(rows: int, F: int, leaves: int, max_bin: int):
+    """Synthetic HIGGS-shaped problem + device-resident inputs."""
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(rows, F))
+    w = rng.normal(size=min(8, F))
+    y = (X[:, :len(w)] @ w + 0.5 * X[:, 0] * X[:, 1]
+         + rng.logistic(size=rows) > 0).astype(np.float64)
+    params = {"objective": "binary", "num_leaves": leaves,
+              "min_data_in_leaf": max(rows // 10_000, 5), "verbose": -1,
+              "max_bin": max_bin}
+    ds = lgb.Dataset(X, label=y, params=params)
+    ds.construct()
+    cfg = lgb.Config.from_params(params)
+    meta, B = build_device_meta(ds._handle, cfg)
+    scfg = SplitConfig.from_config(cfg)
+    binsT = jnp.asarray(np.ascontiguousarray(ds._handle.X_bin.T))
+    g = jnp.asarray(rng.normal(size=rows).astype(np.float32))
+    h = jnp.asarray((rng.random(rows) * 0.25).astype(np.float32))
+    mask = jnp.ones(rows, jnp.float32)
+    fmask = jnp.ones(F, bool)
+    return dict(meta=meta, B=B, scfg=scfg, binsT=binsT, g=g, h=h,
+                mask=mask, fmask=fmask, rows=rows, F=F,
+                capacity=_env_int("PROF_CAPACITY", 42),
+                block_rows=_env_int("PROF_BLOCK_ROWS", 1024))
+
+
+def _report(results: dict, name: str, seconds: float, flops=None,
+            nbytes=None, extra=None):
+    """Record one measured leg: print, remember, and (sink permitting)
+    emit the kernel_profile event through the shared profile machinery."""
+    rec = {"seconds": round(seconds, 6)}
+    line = f"{name:<26} {seconds * 1e3:9.2f} ms"
+    if flops is not None:
+        rf = roofline_seconds(flops, nbytes or 0.0)
+        rec.update(flops=flops, bytes=nbytes,
+                   roofline_s=round(rf, 9),
+                   roofline_frac=round(rf / seconds, 6) if seconds else 0.0)
+        line += (f"  roofline {rf * 1e3:9.3f} ms"
+                 f"  frac {rec['roofline_frac']:8.4f}")
+        obs.record_kernel(f"prof/{name}", flops, nbytes or 0.0, seconds,
+                          source="prof_kernels")
+    if extra:
+        rec.update(extra)
+    results[name] = rec
+    print(line, flush=True)
+
+
+def leg_kernel(p, results, n_rep: int):
+    """Bare wave-kernel full passes vs the analytical MXU roofline AND
+    XLA's own cost_analysis of the compiled kernel."""
+    rows, F, B = p["rows"], p["F"], p["B"]
+    rng = np.random.default_rng(1)
+    Pcap = max(1, min(p["capacity"], pallas_hist.C_MAX // 3))
+    sl = np.full(pallas_hist.C_MAX, -1, np.int32)
+    sl[:3 * Pcap] = np.repeat(np.arange(Pcap), 3)
+    slot_leaf = jnp.asarray(sl)
+    leaf_id = jnp.asarray(rng.integers(0, Pcap, rows, dtype=np.int32))
+    kf = jax.jit(lambda: pallas_hist.hist_pallas_wave(
+        p["binsT"], p["g"], p["h"], p["mask"], leaf_id, slot_leaf, B=B,
+        block_rows=p["block_rows"], highest=MODE, interpret=INTERP))
+    flops, nbytes = pallas_hist.wave_kernel_cost(rows, F, B, MODE)
+    extra = {}
+    try:
+        ca = extract_cost(cost_analysis_dict(kf.lower().compile()))
+        extra = {"xla_flops": ca[0], "xla_bytes": ca[1]}
+    except Exception as exc:  # noqa: BLE001 — interpret mode may decline
+        extra = {"xla_cost_error": f"{type(exc).__name__}"}
+    dt, _ = timeit(kf, n=n_rep)
+    _report(results, "kernel full pass", dt, flops, nbytes, extra)
+
+
+def leg_grow(p, results, name: str, n_rep: int, compact=True,
+             stub_kernel=False):
+    """One grower variant, timed end to end per tree."""
+    rows, F, B = p["rows"], p["F"], p["B"]
+    real = pallas_hist.hist_pallas_wave
+    if stub_kernel:
+        def stub(bins_fm, gv, hv, cv, leaf_id, slot_leaf, B, **kw):
+            """Shape-compatible fake histograms with enough structure that
+            the grower keeps splitting (positive counts/hessians, wiggly g
+            sums) — measures everything-but-kernel."""
+            Fdim = bins_fm.shape[0]
+            i = jnp.arange(B, dtype=jnp.float32)[None, :, None]
+            c = jnp.arange(pallas_hist.C_MAX, dtype=jnp.float32)[None, None, :]
+            f = jnp.arange(Fdim, dtype=jnp.float32)[:, None, None]
+            base = jnp.sin(i * 0.37 + c * 1.3 + f * 2.1)
+            kind = (jnp.arange(pallas_hist.C_MAX) % 3)[None, None, :]
+            out = jnp.where(kind == 0, base * 3.0,
+                            jnp.where(kind == 1, 40.0 + 0.0 * base,
+                                      160.0 + 0.0 * base))
+            s = (gv[0] + hv[0] + cv[0] + leaf_id[0].astype(jnp.float32)) * 0
+            return out + s
+        wave_grower.hist_pallas_wave = stub
+    try:
+        grow = jax.jit(wave_grower.build_wave_grow_fn(
+            p["meta"], p["scfg"], B, wave_capacity=p["capacity"],
+            highest=MODE, gain_gate=0.5, block_rows=p["block_rows"],
+            compact=compact, interpret=INTERP, report_waves=True))
+        t0 = time.time()
+        tr, lid, stats = grow(p["binsT"], p["g"], p["h"], p["mask"],
+                              p["fmask"])
+        jax.block_until_ready(lid)
+        compile_s = time.time() - t0
+        dt, (tr, lid, stats) = timeit(grow, p["binsT"], p["g"], p["h"],
+                                      p["mask"], p["fmask"], n=n_rep)
+    finally:
+        wave_grower.hist_pallas_wave = real
+    waves, kern_rows = (int(x) for x in np.asarray(stats))
+    leaves = int(tr.num_leaves)
+    flops = nbytes = None
+    if not stub_kernel:
+        # kernel share of this tree, from the EXACT rows histogrammed
+        flops, nbytes = pallas_hist.wave_kernel_cost(kern_rows, F, B, MODE,
+                                                     waves=waves)
+    _report(results, name, dt, flops, nbytes,
+            {"leaves": leaves, "waves": waves, "kernel_rows": kern_rows,
+             "compile_s": round(compile_s, 1),
+             "full_pass_equiv": round(kern_rows / rows, 2)})
+
+
+def leg_gathers(p, results, n_rep: int):
+    """Compaction-primitive microbenches: the nocompact-vs-full
+    arbitration (are tier gathers cheaper than the kernel rows saved?)."""
+    rows = p["rows"]
+    rng = np.random.default_rng(2)
+    active = jnp.asarray(rng.random(rows) < 0.3)
+    T = max(rows // 2, 1)
+    binsT = p["binsT"]
+    bins_rm = jnp.asarray(np.asarray(binsT).T.copy())
+
+    def idx_build():
+        pos = jnp.cumsum(active.astype(jnp.int32))
+        return jnp.zeros((rows,), jnp.int32).at[
+            jnp.where(active, pos - 1, rows)
+        ].set(jnp.arange(rows, dtype=jnp.int32), mode="drop")
+
+    dt, idx = timeit(jax.jit(idx_build), n=n_rep)
+    _report(results, "index build", dt)
+    idx_t = idx[:T]
+    dt, _ = timeit(jax.jit(
+        lambda i: jnp.transpose(jnp.take(bins_rm, i, axis=0))), idx_t,
+        n=n_rep)
+    _report(results, f"tier gather T={T}", dt)
+    g3 = jax.jit(lambda i: jnp.stack([p["g"], p["h"], p["mask"]], 1)[i])
+    dt, _ = timeit(g3, idx_t, n=n_rep)
+    _report(results, "vec3 gather", dt)
+
+
+def main() -> int:
+    rows = _env_int("PROF_ROWS", 1_000_000)
+    F = _env_int("PROF_FEATURES", 28)
+    leaves = _env_int("PROF_LEAVES", 255)
+    max_bin = _env_int("PROF_MAXBIN", 255)
+    n_rep = _env_int("PROF_REPEAT", 3)
+    legs = [s for s in os.environ.get(
+        "PROF_LEGS", "kernel,full,nokernel,nocompact,gathers").split(",")
+        if s]
+    pf, pb = device_peaks()
+    print(f"backend: {jax.default_backend()}  interpret: {INTERP}  "
+          f"peaks: {pf / 1e12:.1f} TFLOP/s, {pb / 1e9:.0f} GB/s",
+          flush=True)
+    p = build_problem(rows, F, leaves, max_bin)
+    results = {}
+    if "kernel" in legs:
+        leg_kernel(p, results, n_rep)
+    if "full" in legs:
+        leg_grow(p, results, "grow full", n_rep)
+    if "nokernel" in legs:
+        leg_grow(p, results, "grow nokernel", n_rep, stub_kernel=True)
+    if "nocompact" in legs:
+        leg_grow(p, results, "grow nocompact", n_rep, compact=False)
+    if "gathers" in legs:
+        leg_gathers(p, results, n_rep)
+
+    # the split-scan hypothesis (ROOFLINE.md step 3): expected non-kernel
+    # floor from the analytical scan cost alone
+    sf, sb = split_scan_cost(F, p["B"], leaves=2 * p["capacity"])
+    print(f"split-scan model (per wave, 2P leaves): "
+          f"{roofline_seconds(sf, sb) * 1e3:.3f} ms", flush=True)
+    oh = hist_onehot_cost(rows, F, p["B"])
+    print(f"XLA one-hot fallback roofline (same pass): "
+          f"{roofline_seconds(*oh) * 1e3:.3f} ms", flush=True)
+
+    if os.environ.get("PROF_JSON", "") not in ("", "0"):
+        print(json.dumps({
+            "tool": "prof_kernels", "backend": jax.default_backend(),
+            "interpret": INTERP, "rows": rows, "features": F,
+            "leaves": leaves, "max_bin": max_bin, "mode": MODE,
+            "peak_flops": pf, "peak_bw": pb, "legs": results}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
