@@ -65,6 +65,12 @@ def _embed_observability(result: dict) -> None:
     if kernels:
         result["kernel_roofline"] = {
             k: v["roofline_frac"] for k, v in kernels.items()}
+    counters = td.get("counters") or {}
+    if counters.get("health/checks"):
+        # health-mode runs carry their verdict in the bench line itself,
+        # so a captured number is self-certifying (tools/tpu_window.py)
+        result["health_checks"] = int(counters["health/checks"])
+        result["health_failures"] = int(counters.get("health/failures", 0))
 
 
 def _rank_data(rows: int):

@@ -11,6 +11,7 @@ from typing import List
 
 import numpy as np
 
+from .. import obs
 from ..utils import log
 from .gbdt import GBDT
 
@@ -34,6 +35,12 @@ class DART(GBDT):
         if ret:
             return ret
         self._normalize()
+        if obs.health_enabled():
+            # the 3-step shrinkage dance patches scores OUTSIDE the
+            # guarded gradient path; certify the renormalized state
+            # (super() already advanced iter_, so name the finished one)
+            obs.check_score(self._train_score, phase="dart normalize",
+                            iteration=self.iter_ - 1)
         if not self.config.uniform_drop:
             self.tree_weight.append(self.shrinkage_rate)
             self.sum_weight += self.shrinkage_rate

@@ -240,6 +240,10 @@ class GBDT(PredictorBase):
             obs.enable(config.tpu_telemetry)
         if getattr(config, "tpu_profile", False):
             obs.enable_profile()
+        if getattr(config, "tpu_health", ""):
+            obs.enable_health(config.tpu_health)
+        self._fp_freq = max(int(getattr(config, "tpu_fingerprint_freq", 1)),
+                            0)
 
         self.config = config
         self.train_ds = train_ds
@@ -967,6 +971,7 @@ class GBDT(PredictorBase):
             waves_total = None
             kern_rows = None
 
+        health_on = obs.health_enabled()
         init_scores = [0.0] * K
         if gradients is None or hessians is None:
             for k in range(K):
@@ -974,12 +979,18 @@ class GBDT(PredictorBase):
             with timetag("boosting (grad/hess)"):
                 g, h = self._grad_fn(self._train_score)
                 sync(h)
+            if health_on and self.objective is not None:
+                self.objective.health_tap(g, h, self.iter_)
         else:
             g = jnp.asarray(np.asarray(gradients, dtype=np.float32).reshape(K, N).T)
             h = jnp.asarray(np.asarray(hessians, dtype=np.float32).reshape(K, N).T)
             if g.ndim == 1:
                 g = g[:, None]
                 h = h[:, None]
+            if health_on:
+                obs.check_gradients(g, h, phase="boosting (grad/hess)",
+                                    iteration=self.iter_,
+                                    objective="custom")
 
         g, h = self._bagging(self.iter_, g, h)
         if telem and obs.profile_enabled():
@@ -1053,6 +1064,12 @@ class GBDT(PredictorBase):
                 if lag_ok:
                     pend_nl.append(None)
 
+            if health_on and arrs is not None:
+                # gain/histogram sentinel: one small device fetch per
+                # tree (syncs the lag path — health mode trades async
+                # pipelining for certainty, like profile mode)
+                obs.check_tree(arrs, phase="tree growth",
+                               iteration=self.iter_, class_id=k)
             if nl > 1:
                 should_continue = True
                 if slow_path:
@@ -1121,12 +1138,32 @@ class GBDT(PredictorBase):
                 obs.event("train_stop", iteration=self.iter_,
                           reason="no_splits")
             return True
+        if health_on and self._fp_freq and self.iter_ % self._fp_freq == 0:
+            self._health_fingerprint()
         if telem:
             self._emit_iteration_record(t_iter0, phase0, compiles0,
                                         compile_s0, leaves_grown,
                                         waves_total, kern_rows)
         self.iter_ += 1
         return False
+
+    def _health_fingerprint(self) -> None:
+        """Model-state fingerprint for this iteration (score vector + the
+        iteration's still-deferred device trees), emitted as a
+        ``fingerprint`` telemetry event; under multi-process training the
+        stats are compared across ranks and a mismatch aborts
+        (obs/health.py divergence_audit)."""
+        K = self.num_tpi
+        n = list.__len__(self.models)
+        arrs = []
+        for i in range(max(n - K, 0), n):
+            t = list.__getitem__(self.models, i)
+            if isinstance(t, _DeferredTree):
+                arrs.append(t.arrs)
+        rec = obs.model_fingerprint(self._train_score, arrs,
+                                    iteration=self.iter_)
+        if rec is not None:
+            obs.divergence_audit(rec["stats"], iteration=self.iter_)
 
     def _emit_iteration_record(self, t_iter0, phase0, compiles0, compile_s0,
                                leaves, waves, kern_rows=None) -> None:

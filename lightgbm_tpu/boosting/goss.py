@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from .. import obs
 from ..utils import log
 from .gbdt import GBDT
 
@@ -54,4 +55,11 @@ class GOSS(GBDT):
         amp = jnp.where(sampled_rest, multiply, 1.0)[:, None].astype(jnp.float32)
         self._bag_mask = mask.astype(jnp.float32)
         self._bag_mask_host = np.asarray(mask)
-        return g * amp, h * amp
+        g, h = g * amp, h * amp
+        if obs.health_enabled():
+            # the amplifier multiplies the sampled rest by (1-a)/b, which
+            # can overflow f32 for tiny other_rate — attribute that here,
+            # not to the objective's (already checked) raw gradients
+            obs.check_gradients(g, h, phase="goss amplification",
+                                iteration=it, objective="goss")
+        return g, h

@@ -305,6 +305,24 @@ class Config:
                                         # (telemetry.{proc}.jsonl inside) or
                                         # a .jsonl path; same switch as the
                                         # LGBM_TPU_TELEMETRY env var
+    tpu_health: str = ""                # training-health sentinels
+                                        # (obs/health.py): "" off,
+                                        # monitor = per-iteration numerics
+                                        # guards + model fingerprints +
+                                        # cross-rank divergence audit with
+                                        # health/fingerprint telemetry
+                                        # events, strict = additionally
+                                        # abort on the first failure with
+                                        # phase/node/feature attribution.
+                                        # PROCESS-WIDE once on (like
+                                        # tpu_telemetry); syncs the device
+                                        # per iteration (LGBM_TPU_HEALTH
+                                        # env var)
+    tpu_fingerprint_freq: int = 1       # iterations between model-state
+                                        # fingerprints (and the divergence
+                                        # audit under multi-process
+                                        # training) when tpu_health is on;
+                                        # 0 disables fingerprinting
     tpu_profile: bool = False           # profile mode: sync-bracket every
                                         # phase/kernel, emit kernel_profile
                                         # roofline events + HBM memory
@@ -409,6 +427,14 @@ class Config:
         if self.tpu_block_rows < 128 or self.tpu_block_rows % 128 != 0:
             log.fatal("tpu_block_rows should be a positive multiple of 128 "
                       "(TPU lane-tile alignment)")
+        # normalize the health-mode synonyms to the canonical "",
+        # "monitor", "strict" via the ONE parser in obs/health.py —
+        # unknown values are fatal on the parameter path (the env path
+        # warns instead: it cannot raise at import time)
+        from .obs.health import parse_mode
+        self.tpu_health = parse_mode(self.tpu_health, fatal=True)
+        if self.tpu_fingerprint_freq < 0:
+            log.fatal("tpu_fingerprint_freq should be >= 0")
 
     # ------------------------------------------------------------------
     def num_model_per_iteration(self) -> int:

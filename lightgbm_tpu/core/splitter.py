@@ -279,6 +279,56 @@ def split_scan_cost(F: int, B: int, leaves: int = 1):
     return flops, nbytes
 
 
+def tree_health_stats(tree) -> jnp.ndarray:
+    """Device-side reduction of a grown tree's numeric-health invariants
+    (obs/health.py's gain/histogram tap — one small fetch per tree).
+
+    Every quantity here flows from the histogram channels: split gains
+    from the scan above, leaf weights/counts from the g/h/c sums the
+    growers thread through parent-minus-child subtraction.  Two invariant
+    families are reduced:
+
+    - finiteness of split gains and of leaf/internal values and weights
+      over the ACTIVE nodes/leaves (unused fixed-capacity slots are
+      zero-filled by construction and excluded);
+    - conservation: the leaves of a split tree partition the root, so
+      ``sum(leaf_count) == internal_count[0]`` (exact — counts ride the
+      f32 histogram count channel) and ``sum(leaf_weight) ~=
+      internal_weight[0]`` (f32/2xbf16 accumulation tolerance), the
+      cheapest end-to-end check that histogram totals were not corrupted
+      anywhere in the wave/serial growth pipeline.
+
+    Returns f32 [10]: [n_bad_gain, n_bad_value, n_bad_weight,
+    first_bad_node, first_bad_feature, leaf_count_sum, root_count,
+    leaf_weight_sum, root_weight, num_leaves].
+    """
+    nl = tree.num_leaves
+    n = tree.split_gain.shape[0]
+    node_act = jnp.arange(n) < (nl - 1)
+    leaf_act = jnp.arange(tree.leaf_value.shape[0]) < nl
+    bad_gain = node_act & ~jnp.isfinite(tree.split_gain)
+    bad_val = ((leaf_act & ~jnp.isfinite(tree.leaf_value)) |
+               jnp.pad(node_act & ~jnp.isfinite(tree.internal_value),
+                       (0, tree.leaf_value.shape[0] - n)))
+    bad_w = ((leaf_act & ~jnp.isfinite(tree.leaf_weight)) |
+             jnp.pad(node_act & ~jnp.isfinite(tree.internal_weight),
+                     (0, tree.leaf_weight.shape[0] - n)))
+    first_bad = jnp.argmax(bad_gain).astype(jnp.int32)
+    f32 = jnp.float32
+    return jnp.stack([
+        jnp.sum(bad_gain).astype(f32),
+        jnp.sum(bad_val).astype(f32),
+        jnp.sum(bad_w).astype(f32),
+        first_bad.astype(f32),
+        tree.split_feature[first_bad].astype(f32),
+        jnp.sum(jnp.where(leaf_act, tree.leaf_count, 0)).astype(f32),
+        tree.internal_count[0].astype(f32),
+        jnp.sum(jnp.where(leaf_act, tree.leaf_weight, 0.0)),
+        tree.internal_weight[0],
+        nl.astype(f32),
+    ])
+
+
 @jax.named_scope("lgbm/split_scan")
 def best_split(hist, sum_g, sum_h, cnt, meta: DeviceMeta, cfg: SplitConfig,
                min_constraint, max_constraint, feature_mask=None,

@@ -44,6 +44,18 @@ class Objective:
     def get_gradients(self, score) -> Tuple["jnp.ndarray", "jnp.ndarray"]:
         raise NotImplementedError
 
+    def health_tap(self, g, h, iteration: int) -> bool:
+        """Numerics sentinel over this objective's gradient/hessian
+        output — the trainer calls it once per iteration when
+        ``LGBM_TPU_HEALTH`` / ``tpu_health`` is on, so a non-finite
+        gradient is attributed to the OBJECTIVE that produced it (the
+        exp/log link functions are where NaNs are born) rather than to
+        whatever downstream phase first consumed it.  True = healthy."""
+        from ..obs import health
+        return health.check_gradients(g, h, phase="boosting (grad/hess)",
+                                      iteration=iteration,
+                                      objective=self.name)
+
     def boost_from_score(self, class_id: int = 0) -> float:
         return 0.0
 

@@ -13,6 +13,9 @@ parameter.  ``LGBM_TPU_TIMETAG=1`` keeps the plain phase-time report.
 ``LGBM_TPU_PROFILE=1`` (or ``tpu_profile``) adds the sync-bracketed
 profile mode: per-kernel ``kernel_profile`` events with cost-analysis
 FLOPs/bytes and roofline fractions, plus ``memory_census`` snapshots.
+``LGBM_TPU_HEALTH=monitor|strict`` (or ``tpu_health``) arms the
+training-health sentinels (``health``): per-iteration numerics guards,
+model-state fingerprints, and the cross-rank divergence audit.
 """
 from .core import (TIMETAG_ENABLED, add, count, counter_value,
                    counters_snapshot, current_phase, digest, disable,
@@ -20,6 +23,9 @@ from .core import (TIMETAG_ENABLED, add, count, counter_value,
                    phase_snapshot, record_collective,
                    record_collective_host, report, reset, sink_path, sync,
                    tracing_enabled)
+from .health import (TrainingHealthError, check_gradients, check_score,
+                     check_tree, divergence_audit, enable_health,
+                     health_enabled, health_mode, model_fingerprint)
 from .memory import (audit as memory_audit, expect_released, memory_digest,
                      peak_bytes)
 from .memory import snapshot as memory_snapshot
@@ -39,4 +45,7 @@ __all__ = [
     "profile_wrap", "record_kernel", "roofline_seconds",
     "memory_audit", "memory_digest", "memory_snapshot", "expect_released",
     "peak_bytes",
+    "TrainingHealthError", "check_gradients", "check_score", "check_tree",
+    "divergence_audit", "enable_health", "health_enabled", "health_mode",
+    "model_fingerprint",
 ]

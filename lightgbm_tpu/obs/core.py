@@ -129,11 +129,23 @@ def _process_index() -> int:
     land in the right per-process file from the first write."""
     jx = sys.modules.get("jax")
     if jx is not None:
+        state = None
         try:
-            from jax._src.distributed import global_state
-            if global_state.client is not None:
-                return int(jx.process_index())
-        except Exception:  # noqa: BLE001 — private API moved; best effort
+            # the ONE guarded access point for the private API (see its
+            # docstring + the loud contract test); lazy so the telemetry
+            # layer never imports jax machinery itself
+            from ..parallel.distributed import jax_distributed_state
+            state = jax_distributed_state()
+        except Exception:  # noqa: BLE001
+            pass
+        if state is not None:
+            if state.client is not None:
+                try:
+                    return int(jx.process_index())
+                except Exception:  # noqa: BLE001
+                    pass
+        else:
+            # private API moved: best effort via the public probe
             try:
                 return int(jx.process_index())
             except Exception:  # noqa: BLE001

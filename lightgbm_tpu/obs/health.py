@@ -1,0 +1,372 @@
+"""Training-health sentinels: numerics guards, model fingerprints, and
+the cross-rank divergence audit.
+
+The telemetry layer (``core``) records what happened; profile mode
+(``profile``) explains why it is slow; this module certifies the run was
+NUMERICALLY TRUSTWORTHY — the missing piece that turns a rare TPU lease
+window into a committable datapoint instead of a number that might hide
+silent NaNs or cross-process model drift.
+
+Three check families, all gated on one process-wide mode switch
+(``LGBM_TPU_HEALTH`` env var or the ``tpu_health`` parameter):
+
+- **gradient/hessian guards** (:func:`check_gradients`, tapped by
+  ``objective/base.py health_tap``, the GOSS amplifier, and the custom-
+  gradient path): non-finite values are counted on device in one small
+  jitted reduction and attributed to the phase + iteration (+ objective
+  and first bad row);
+- **split/histogram guards** (:func:`check_tree`, reducing
+  ``core/splitter.py tree_health_stats``): non-finite split gains or
+  leaf values are attributed to the node and feature; leaf-count /
+  leaf-weight conservation against the root catches corrupted histogram
+  totals end to end;
+- **model-state fingerprints** (:func:`model_fingerprint`): a cheap
+  device reduction of the score vector + the iteration's tree arrays,
+  hashed into a digest and emitted as a ``fingerprint`` event.  Under
+  multi-process training :func:`divergence_audit` gathers every rank's
+  fingerprint stats (``parallel/distributed.py rank_allgather_stats``,
+  the min/max-over-the-hash comparison with which-rank attribution) and
+  RAISES on mismatch — replicated state that drifted is unrecoverable,
+  so the audit aborts in monitor mode too.
+
+Modes: ``""`` (off — every entry point is one boolean check, the <5%
+off-path overhead guard holds), ``monitor`` (check + warn + ``health``
+events into the telemetry stream), ``strict`` (abort with a
+:class:`TrainingHealthError` naming the phase/iteration and, for split
+checks, the node/feature).  Checks synchronize the device once per
+guarded quantity per iteration — health mode trades the training loop's
+async pipelining for certainty, the same contract as profile mode.
+
+Multi-process note: this engine's distributed design REPLICATES scores,
+gradients, and trees on every rank (rows are sharded only inside the
+grower's collectives — parallel/mesh.py), so a numerics failure is seen
+by every rank in the same iteration and a strict abort fires everywhere
+at once rather than wedging peers at the next collective.  The one
+state that CAN silently drift per-rank is exactly what the fingerprint
+audit compares — and a divergence aborts all ranks symmetrically, since
+every rank evaluates the same gathered stats.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+from typing import Optional
+
+import numpy as np
+
+from ..utils import log
+from ..utils.log import LightGBMError
+from . import core
+
+
+class TrainingHealthError(LightGBMError):
+    """A health sentinel tripped (strict mode, or any divergence)."""
+
+
+MODE_OFF, MODE_MONITOR, MODE_STRICT = "", "monitor", "strict"
+
+# conservation tolerances (check_tree): counts ride the f32 histogram
+# count channel and are exact below ~2^24 rows/leaf; weights accumulate
+# through parent-minus-child chains in f32 over (2x)bf16 histogram terms
+_COUNT_ATOL = 0.5
+_WEIGHT_RTOL = 5e-2
+
+_mode = MODE_OFF
+_jit = {}              # cached jitted reductions (never cleared: tiny)
+_gather_override = None  # test hook: callable(stats) -> [R, n] array
+
+
+def parse_mode(value, fatal: bool = False) -> str:
+    """The ONE health-mode parser (config.py's ``tpu_health`` validation
+    routes here too, so the synonym lists cannot drift).  ``fatal=True``
+    rejects unknown values (the parameter path); the env path cannot
+    raise at import time, so an unknown value arms 'monitor' with an
+    explicit downgrade warning — NOT the 'strict' the user may have
+    meant."""
+    v = str(value).strip().lower()
+    if v in ("", "0", "false", "off", "no", "none"):
+        return MODE_OFF
+    if v in ("strict", "abort"):
+        return MODE_STRICT
+    if v in ("1", "true", "on", "yes", "monitor", "warn"):
+        return MODE_MONITOR
+    if fatal:
+        log.fatal("tpu_health should be off, monitor or strict "
+                  f"(got {value!r})")
+    log.warning("unknown LGBM_TPU_HEALTH value %r; arming 'monitor' "
+                "(NOT 'strict') — fix the value if you wanted aborts",
+                value)
+    return MODE_MONITOR
+
+
+def enable_health(mode="monitor") -> None:
+    """Flip the PROCESS-WIDE health gate (same scope as the telemetry
+    sink / profile gate): ``""``/``0`` off, ``monitor``/``1`` check and
+    report, ``strict`` check and abort."""
+    global _mode
+    _mode = parse_mode(mode)
+
+
+def health_mode() -> str:
+    return _mode
+
+
+def health_enabled() -> bool:
+    return bool(_mode)
+
+
+def _fail(check: str, msg: str, *, phase: str, iteration: int,
+          detail: dict) -> bool:
+    core.count("health/failures")
+    core.event("health", check=check, phase=phase, iteration=iteration,
+               ok=False, mode=_mode, detail=detail)
+    if _mode == MODE_STRICT:
+        raise TrainingHealthError(msg)
+    log.warning("HEALTH: %s", msg)
+    return False
+
+
+# ---------------------------------------------------------------------------
+# Numerics sentinels
+# ---------------------------------------------------------------------------
+
+def _grad_stats_fn():
+    fn = _jit.get("grad")
+    if fn is None:
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def fn(g, h):
+            bg = ~jnp.isfinite(g.reshape(-1))
+            bh = ~jnp.isfinite(h.reshape(-1))
+            return jnp.stack([jnp.sum(bg), jnp.sum(bh),
+                              jnp.argmax(bg), jnp.argmax(bh)]
+                             ).astype(jnp.int32)
+        _jit["grad"] = fn
+    return fn
+
+
+def check_gradients(g, h, *, phase: str, iteration: int,
+                    objective: Optional[str] = None) -> bool:
+    """Finite-check gradients/hessians; True when healthy (or off)."""
+    if not _mode:
+        return True
+    core.count("health/checks")
+    s = np.asarray(_grad_stats_fn()(g, h))
+    if s[0] == 0 and s[1] == 0:
+        return True
+    # the argmax is over the flattened [N, K] buffer: map it back to a
+    # (row, class) pair so multiclass attribution points at a real row
+    shape = tuple(g.shape)
+    k = shape[1] if len(shape) == 2 else 1
+    flat = int(s[2] if s[0] else s[3])
+    detail = {"nonfinite_grad": int(s[0]), "nonfinite_hess": int(s[1]),
+              "first_bad_row": flat // k,
+              "size": int(np.prod(shape))}
+    if k > 1:
+        detail["first_bad_class"] = flat % k
+    if objective:
+        detail["objective"] = objective
+    msg = (f"non-finite gradients/hessians at iteration {iteration} in "
+           f"phase '{phase}'"
+           + (f" (objective={objective})" if objective else "")
+           + f": {int(s[0])} bad gradient and {int(s[1])} bad hessian "
+           f"value(s), first at row {detail['first_bad_row']}"
+           + (f" class {flat % k}" if k > 1 else ""))
+    return _fail("gradients", msg, phase=phase, iteration=iteration,
+                 detail=detail)
+
+
+def check_score(score, *, phase: str, iteration: int) -> bool:
+    """Finite-check a score/prediction buffer (DART renormalization
+    patches scores outside the guarded gradient path)."""
+    if not _mode:
+        return True
+    core.count("health/checks")
+    fn = _jit.get("score")
+    if fn is None:
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def fn(s):
+            bad = ~jnp.isfinite(s.reshape(-1))
+            return jnp.stack([jnp.sum(bad), jnp.argmax(bad)]
+                             ).astype(jnp.int32)
+        _jit["score"] = fn
+    s = np.asarray(fn(score))
+    if s[0] == 0:
+        return True
+    shape = tuple(score.shape)
+    k = shape[1] if len(shape) == 2 else 1
+    row = int(s[1]) // k
+    msg = (f"non-finite score values at iteration {iteration} in phase "
+           f"'{phase}': {int(s[0])} bad value(s), first at row {row}")
+    return _fail("score", msg, phase=phase, iteration=iteration,
+                 detail={"nonfinite": int(s[0]), "first_bad_row": row})
+
+
+def check_tree(arrs, *, phase: str, iteration: int, class_id: int = 0
+               ) -> bool:
+    """Split-gain finiteness + histogram-total conservation for one grown
+    tree (``core/splitter.py tree_health_stats``); True when healthy.
+
+    Attribution: a non-finite gain names the node and its split feature;
+    a conservation breach reports the leaf-sum vs root totals.  Constant
+    trees (num_leaves <= 1 — including the lag-path's zeroed dead trees)
+    carry no invariants and pass.
+    """
+    if not _mode:
+        return True
+    core.count("health/checks")
+    fn = _jit.get("tree")
+    if fn is None:
+        import jax
+
+        from ..core.splitter import tree_health_stats
+        fn = _jit["tree"] = jax.jit(tree_health_stats)
+    s = np.asarray(fn(arrs), np.float64)
+    (n_bad_gain, n_bad_val, n_bad_w, first_node, first_feat,
+     leaf_cnt, root_cnt, leaf_w, root_w, nl) = s
+    if nl <= 1:
+        return True
+    base = {"class_id": class_id, "num_leaves": int(nl)}
+    if n_bad_gain or n_bad_val or n_bad_w:
+        detail = dict(base, nonfinite_gain=int(n_bad_gain),
+                      nonfinite_value=int(n_bad_val),
+                      nonfinite_weight=int(n_bad_w))
+        if n_bad_gain:
+            # first_node/first_feat come from argmax over the bad-gain
+            # mask — meaningful ONLY when a gain actually went bad
+            detail["node"] = int(first_node)
+            detail["feature"] = int(first_feat)
+        msg = (f"non-finite tree state at iteration {iteration} in phase "
+               f"'{phase}' (class {class_id}): {int(n_bad_gain)} bad split "
+               f"gain(s), {int(n_bad_val)} bad value(s), {int(n_bad_w)} "
+               f"bad weight(s)"
+               + (f"; first bad gain at node {int(first_node)} "
+                  f"(feature {int(first_feat)})" if n_bad_gain else ""))
+        return _fail("tree", msg, phase=phase, iteration=iteration,
+                     detail=detail)
+    cnt_bad = abs(leaf_cnt - root_cnt) > max(_COUNT_ATOL, 1e-6 * root_cnt)
+    w_bad = abs(leaf_w - root_w) > _WEIGHT_RTOL * max(abs(root_w), 1e-6)
+    if cnt_bad or w_bad:
+        detail = dict(base, leaf_count_sum=leaf_cnt, root_count=root_cnt,
+                      leaf_weight_sum=leaf_w, root_weight=root_w)
+        msg = (f"histogram-total conservation breach at iteration "
+               f"{iteration} in phase '{phase}' (class {class_id}): "
+               f"leaves sum to count={leaf_cnt:g}/weight={leaf_w:g} but "
+               f"the root histogrammed count={root_cnt:g}/"
+               f"weight={root_w:g}")
+        return _fail("conservation", msg, phase=phase, iteration=iteration,
+                     detail=detail)
+    return True
+
+
+# ---------------------------------------------------------------------------
+# Model-state fingerprints + cross-rank divergence audit
+# ---------------------------------------------------------------------------
+
+def _fp_fns():
+    fns = _jit.get("fp")
+    if fns is None:
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def score_fp(s):
+            f = s.reshape(-1).astype(jnp.float32)
+            return jnp.stack([jnp.sum(f), jnp.sum(f * f),
+                              jnp.min(f), jnp.max(f)])
+
+        @jax.jit
+        def tree_fp(t):
+            return jnp.stack([
+                jnp.sum(t.leaf_value), jnp.sum(jnp.abs(t.leaf_value)),
+                jnp.sum(t.threshold_bin.astype(jnp.float32)),
+                jnp.sum(t.split_feature.astype(jnp.float32)),
+                t.num_leaves.astype(jnp.float32)])
+        fns = _jit["fp"] = (score_fp, tree_fp)
+    return fns
+
+
+def model_fingerprint(score, trees=(), *, iteration: int) -> Optional[dict]:
+    """Cheap per-iteration fingerprint of the model state: device
+    reductions of the score vector and the iteration's tree arrays,
+    combined into an f64 stats vector + a blake2b digest.  Emits a
+    ``fingerprint`` event; returns ``{"iteration", "stats", "digest"}``
+    (None when health is off).
+
+    Identical replicated training MUST produce identical stats on every
+    rank (the reductions are deterministic for identical inputs on the
+    same backend) — that property is what :func:`divergence_audit`
+    compares.
+    """
+    if not _mode:
+        return None
+    score_fp, tree_fp = _fp_fns()
+    parts = [np.asarray(score_fp(score), np.float64)]
+    for t in trees:
+        parts.append(np.asarray(tree_fp(t), np.float64))
+    stats = np.concatenate(parts) if parts else np.zeros(0)
+    digest = hashlib.blake2b(stats.astype("<f8").tobytes(),
+                             digest_size=8).hexdigest()
+    core.event("fingerprint", iteration=iteration, digest=digest,
+               stats=[float(x) for x in stats], trees=len(trees))
+    return {"iteration": iteration, "stats": stats, "digest": digest}
+
+
+def _digest_of(vec: np.ndarray) -> str:
+    return hashlib.blake2b(np.asarray(vec, np.float64).astype("<f8")
+                           .tobytes(), digest_size=8).hexdigest()
+
+
+def divergence_audit(stats: np.ndarray, *, iteration: int) -> bool:
+    """Compare this rank's fingerprint stats against every other rank's
+    (no-op off multi-process).  Emits a ``divergence`` event with the
+    per-stat min/max spread and per-rank digests; RAISES
+    :class:`TrainingHealthError` on mismatch in EVERY mode — ranks whose
+    replicated model state drifted cannot produce a meaningful run, so
+    monitoring it is aborting it.
+    """
+    if not _mode:
+        return True
+    stats = np.asarray(stats, np.float64)
+    if _gather_override is not None:
+        gathered = np.asarray(_gather_override(stats), np.float64)
+    else:
+        from ..parallel.distributed import rank_allgather_stats
+        gathered = rank_allgather_stats(stats)
+    if gathered is None or gathered.shape[0] <= 1:
+        return True
+    core.count("health/divergence_checks")
+    digests = [_digest_of(gathered[r]) for r in range(gathered.shape[0])]
+    spread = gathered.max(axis=0) - gathered.min(axis=0)
+    ok = len(set(digests)) == 1
+    core.event("divergence", iteration=iteration, ok=ok,
+               ranks=gathered.shape[0], digests=digests,
+               spread=[float(x) for x in spread])
+    if ok:
+        return True
+    core.count("health/failures")
+    # blame the MINORITY: ranks whose digest differs from the modal one
+    # (digests [A, A, B] names rank 2, not rank 0); with no majority —
+    # every rank distinct — all ranks are suspects
+    counts = {}
+    for d in digests:
+        counts[d] = counts.get(d, 0) + 1
+    modal, modal_n = max(counts.items(), key=lambda kv: kv[1])
+    bad = ([r for r, d in enumerate(digests) if d != modal]
+           if modal_n > 1 else list(range(len(digests))))
+    worst = int(np.argmax(spread))
+    msg = (f"cross-rank model divergence at iteration {iteration}: "
+           f"rank(s) {bad} disagree with the majority fingerprint "
+           f"(digests {digests}); worst stat index {worst} spreads "
+           f"{spread[worst]:g} across ranks")
+    raise TrainingHealthError(msg)
+
+
+_env_mode = os.environ.get("LGBM_TPU_HEALTH", "")
+if _env_mode:
+    enable_health(_env_mode)

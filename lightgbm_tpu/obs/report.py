@@ -138,6 +138,9 @@ def summarize(events: List[dict]) -> dict:
     mem = memory_summary(events)
     if mem:
         out["memory"] = mem
+    health = health_summary(events)
+    if health:
+        out["health"] = health
     return out
 
 
@@ -225,6 +228,34 @@ def memory_summary(events: List[dict]) -> dict:
     return out
 
 
+def health_summary(events: List[dict]) -> dict:
+    """Fold ``health``/``fingerprint``/``divergence`` events (obs/health)
+    into one digest section: failure count + first failure's attribution,
+    fingerprint coverage, and the divergence audit's verdict.  Empty when
+    the run had no health instrumentation."""
+    fails = [e for e in events
+             if e.get("event") == "health" and not e.get("ok", True)]
+    fps = [e for e in events if e.get("event") == "fingerprint"]
+    div = [e for e in events if e.get("event") == "divergence"]
+    if not (fails or fps or div):
+        return {}
+    out = {
+        "failures": len(fails),
+        "fingerprints": len(fps),
+        "divergence_checks": len(div),
+        "divergence_failures": sum(1 for e in div
+                                   if not e.get("ok", True)),
+    }
+    if fails:
+        f = fails[0]
+        out["first_failure"] = {k: f.get(k) for k in
+                                ("check", "phase", "iteration", "detail")}
+    if fps:
+        out["last_fingerprint"] = {"iteration": fps[-1].get("iteration"),
+                                   "digest": fps[-1].get("digest")}
+    return out
+
+
 # ---------------------------------------------------------------------------
 # Event schemas — the CI smoke validates profile-mode streams against these
 # ---------------------------------------------------------------------------
@@ -254,6 +285,28 @@ EVENT_SCHEMAS = {
         "phase": (str, True),
         "survivors": (list, True),
     },
+    # training-health sentinels (obs/health.py)
+    "health": {
+        "check": (str, True),
+        "phase": (str, True),
+        "iteration": (int, True),
+        "mode": (str, True),
+        "ok": (bool, True),
+        "detail": (dict, False),
+    },
+    "fingerprint": {
+        "iteration": (int, True),
+        "digest": (str, True),
+        "stats": (list, True),
+        "trees": (int, False),
+    },
+    "divergence": {
+        "iteration": (int, True),
+        "ok": (bool, True),
+        "ranks": (int, True),
+        "digests": (list, True),
+        "spread": (list, False),
+    },
 }
 
 
@@ -273,8 +326,11 @@ def validate_events(events: List[dict], kinds=None) -> List[str]:
                     problems.append(f"event {i} ({name}): missing {field!r}")
                 continue
             v = e[field]
-            # bool is an int subclass; schemas here never mean bool
-            if isinstance(v, bool) or not isinstance(v, types):
+            types_t = types if isinstance(types, tuple) else (types,)
+            # bool is an int subclass; only fields that SAY bool take one
+            bad = (bool not in types_t if isinstance(v, bool)
+                   else not isinstance(v, types))
+            if bad:
                 problems.append(
                     f"event {i} ({name}): {field!r} has type "
                     f"{type(v).__name__}, wanted {types}")
@@ -346,6 +402,23 @@ def render(digest: dict) -> str:
         if m.get("audit_survivors"):
             out.append(f"  RELEASE-AUDIT SURVIVORS: "
                        f"{', '.join(m['audit_survivors'])}")
+    if digest.get("health"):
+        h = digest["health"]
+        out.append("")
+        verdict = ("DIVERGED" if h.get("divergence_failures")
+                   else "FAILED" if h.get("failures") else "healthy")
+        out.append(f"training health: {verdict} — {h['failures']} check "
+                   f"failure(s), {h['fingerprints']} fingerprint(s), "
+                   f"{h['divergence_checks']} divergence audit(s)")
+        if h.get("first_failure"):
+            f = h["first_failure"]
+            out.append(f"  first failure: {f.get('check')} at iteration "
+                       f"{f.get('iteration')} in phase {f.get('phase')!r} "
+                       f"{f.get('detail')}")
+        if h.get("last_fingerprint"):
+            lf = h["last_fingerprint"]
+            out.append(f"  last fingerprint: iteration "
+                       f"{lf.get('iteration')} digest {lf.get('digest')}")
     if digest["counters"]:
         out.append("")
         out.append("counters:")

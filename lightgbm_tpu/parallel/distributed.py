@@ -163,6 +163,29 @@ def shutdown() -> None:
     _mesh.NETWORK.update(machines="", num_machines=1, rank=0)
 
 
+def jax_distributed_state():
+    """The PRIVATE ``jax._src.distributed.global_state`` handle, or None
+    when this jax version no longer exposes it.
+
+    This is the only way to ask "is a multi-host runtime up?" without
+    initializing a backend (the public ``jax.process_count()`` probe can
+    hang ~30 min on a wedged accelerator lease).  jax gives no stability
+    promise for ``_src``; the ``pyproject.toml`` pin (``jax>=0.4.26,<0.6``)
+    marks the vetted range and
+    ``tests/test_distributed.py::test_jax_private_distributed_api_contract``
+    fails loudly the day the attribute moves — update THIS function and
+    re-vet the pin when it does.  Every consumer (``_runtime_active``
+    here, ``obs/core.py _process_index``) routes through this helper, so
+    it is the single place to fix."""
+    try:
+        from jax._src.distributed import global_state
+        if not hasattr(global_state, "client"):
+            return None
+        return global_state
+    except Exception:  # noqa: BLE001 — private API moved
+        return None
+
+
 def _runtime_active() -> bool:
     """True when a multi-host runtime is up — via init_distributed OR an
     external jax.distributed.initialize (an embedding launcher).  Reads
@@ -170,15 +193,14 @@ def _runtime_active() -> bool:
     never touched on the single-host fast path."""
     if _initialized:
         return True
-    try:
-        from jax._src.distributed import global_state
-        return global_state.client is not None
-    except Exception:  # noqa: BLE001
-        # private API moved: fall back to the public (backend-initializing)
-        # check — skipping pooling in a real multi-host run would silently
-        # diverge the mappers, which is far worse than a slow probe
-        import jax
-        return jax.process_count() > 1
+    state = jax_distributed_state()
+    if state is not None:
+        return state.client is not None
+    # private API moved: fall back to the public (backend-initializing)
+    # check — skipping pooling in a real multi-host run would silently
+    # diverge the mappers, which is far worse than a slow probe
+    import jax
+    return jax.process_count() > 1
 
 
 def _allgather_exact(arr):
@@ -292,3 +314,25 @@ def global_bin_sample_sparse(sample_csc, num_local_rows: int):
          (np.concatenate(rows), np.concatenate(cols))),
         shape=(int(meta[:, 0].sum()), f)).tocsc()
     return pooled, int(meta[:, 2].sum())
+
+
+def rank_allgather_stats(vec):
+    """Rank-compare collective for the divergence audit (obs/health.py):
+    gather one small f64 stats vector from EVERY process, bit-exact (the
+    64-bit payload rides the uint32-pair path of ``_allgather_exact``).
+
+    Returns ``[num_processes, len(vec)]`` with rows in rank order — a
+    strict superset of a psum'd min/max over the fingerprint hash: the
+    caller gets the min/max spread AND which rank diverged.  None outside
+    an initialized multi-host runtime (single-process callers skip the
+    audit entirely, no backend is touched)."""
+    import numpy as np
+
+    if not _runtime_active():
+        return None
+    import jax
+
+    if jax.process_count() <= 1:
+        return None
+    v = np.ascontiguousarray(np.asarray(vec, np.float64).reshape(-1))
+    return _allgather_exact(v).reshape(jax.process_count(), -1)

@@ -89,6 +89,8 @@ _SLOW = {
     "test_sklearn.py::test_early_stopping_eval_set",
     "test_wave.py::test_wave_pass_count_regression_guard",
     "test_obs.py::test_off_path_overhead_guard",
+    "test_tools.py::test_tpu_window_dry_run_end_to_end",
+    "test_tools.py::test_run_suite_reports_failure",
 }
 
 

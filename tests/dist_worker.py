@@ -160,6 +160,27 @@ for it in range(5):
 
 result["dp_trees"] = dp_trees
 result["serial_trees"] = serial_trees
+
+# ---- 4. cross-rank divergence audit (obs/health.py) ------------------
+# Replicated training just produced identical scores on both ranks: the
+# audit must pass on the honest state and fire after rank 1 corrupts its
+# copy — the real-collective leg of the simulated test in test_health.py.
+from lightgbm_tpu import obs  # noqa: E402
+
+obs.enable_health("monitor")
+score_d = jnp.asarray(score)
+rec = obs.model_fingerprint(score_d, iteration=0)
+assert obs.divergence_audit(rec["stats"], iteration=0)
+corrupted = score_d.at[0].add(1.0) if rank == 1 else score_d
+rec2 = obs.model_fingerprint(corrupted, iteration=1)
+caught = False
+try:
+    obs.divergence_audit(rec2["stats"], iteration=1)
+except obs.TrainingHealthError:
+    caught = True  # both ranks see the mismatch and abort
+obs.enable_health("")
+result["divergence_caught"] = caught
+
 result["ok"] = True
 with open(out_path, "w") as fh:
     json.dump(result, fh)

@@ -1,0 +1,306 @@
+"""Training-health sentinels (lightgbm_tpu/obs/health.py): strict mode
+must abort with phase/node/feature attribution, monitor mode must stream
+schema-valid health/fingerprint events, the divergence audit must catch a
+corrupted rank, and the off mode must stay a boolean check."""
+import json
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import obs
+from lightgbm_tpu.obs import health
+from lightgbm_tpu.obs.report import (health_summary, load_events, render,
+                                     summarize, validate_events)
+
+
+def _toy(n=400, f=5, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float64)
+    return X, y
+
+
+_PARAMS = {"objective": "binary", "num_leaves": 7, "min_data_in_leaf": 5,
+           "verbose": -1}
+
+
+@pytest.fixture(autouse=True)
+def _health_off_after():
+    """The gate is process-wide (like telemetry); never leak it."""
+    yield
+    obs.enable_health("")
+    obs.disable()
+    obs.reset()
+
+
+def _booster(params=_PARAMS):
+    X, y = _toy()
+    ds = lgb.Dataset(X, label=y, params=params)
+    return lgb.Booster(params=params, train_set=ds), len(y)
+
+
+# ---------------------------------------------------------------------------
+# numerics guards
+# ---------------------------------------------------------------------------
+
+def test_strict_mode_aborts_on_nan_gradients_with_attribution():
+    """Acceptance: a seeded non-finite gradient aborts strict mode with
+    the phase AND iteration named (custom-gradient tap in gbdt.py)."""
+    bst, n = _booster()
+    obs.enable_health("strict")
+    bst.update()  # healthy iteration passes under strict
+    def bad_fobj(preds, train_data):
+        g = np.zeros(n)
+        h = np.ones(n)
+        g[7] = np.nan
+        return g, h
+    with pytest.raises(obs.TrainingHealthError) as ei:
+        bst.update(fobj=bad_fobj)
+    msg = str(ei.value)
+    assert "boosting (grad/hess)" in msg
+    assert "iteration 1" in msg
+    assert "row 7" in msg
+    # TrainingHealthError is a LightGBMError: existing callers' broad
+    # except clauses keep working
+    assert isinstance(ei.value, lgb.LightGBMError)
+
+
+def test_monitor_mode_records_failure_without_abort(tmp_path):
+    """Monitor mode: the same injection trains on, but the telemetry
+    stream carries a schema-valid health event with the attribution."""
+    sink = tmp_path / "telem"
+    obs.enable(str(sink))
+    obs.enable_health("monitor")
+    bst, n = _booster()
+    def bad_fobj(preds, train_data):
+        g = np.zeros(n)
+        h = np.ones(n)
+        g[3] = np.inf
+        return g, h
+    bst.update(fobj=bad_fobj)  # no raise
+    obs.disable()
+    events = load_events(str(sink))
+    bad = [e for e in events if e.get("event") == "health"
+           and not e.get("ok", True)]
+    assert bad, "monitor mode dropped the failure event"
+    assert bad[0]["check"] == "gradients"
+    assert bad[0]["phase"] == "boosting (grad/hess)"
+    assert bad[0]["iteration"] == 0
+    assert bad[0]["detail"]["first_bad_row"] == 3
+    assert validate_events(events) == []
+    assert obs.counter_value("health/failures") >= 1
+
+
+def test_multiclass_gradient_attribution_maps_flat_index_to_row():
+    """[N, K] gradients: the flat argmax must map back to (row, class),
+    not report a flat index as the row."""
+    import jax.numpy as jnp
+    obs.enable_health("strict")
+    g = jnp.zeros((10, 3)).at[7, 2].set(jnp.nan)  # flat index 23
+    h = jnp.ones((10, 3))
+    with pytest.raises(obs.TrainingHealthError, match="row 7 class 2"):
+        obs.check_gradients(g, h, phase="boosting (grad/hess)",
+                            iteration=0, objective="multiclass")
+    s = jnp.zeros((10, 3)).at[4, 1].set(jnp.inf)  # flat index 13
+    with pytest.raises(obs.TrainingHealthError, match="row 4"):
+        obs.check_score(s, phase="dart normalize", iteration=0)
+
+
+def test_objective_tap_attributes_objective_name(tmp_path):
+    """The per-objective tap runs every iteration and healthy runs emit
+    fingerprints but no failures."""
+    sink = tmp_path / "telem"
+    obs.enable(str(sink))
+    obs.enable_health("strict")  # strict over a healthy run: no abort
+    bst, _ = _booster()
+    for _ in range(3):
+        bst.update()
+    obs.disable()
+    events = load_events(str(sink))
+    fps = [e for e in events if e.get("event") == "fingerprint"]
+    assert [e["iteration"] for e in fps] == [0, 1, 2]
+    assert all(len(e["digest"]) == 16 for e in fps)
+    # identical state => identical digest is the cross-rank contract;
+    # successive iterations must differ (scores moved)
+    assert fps[0]["digest"] != fps[1]["digest"]
+    assert not [e for e in events if e.get("event") == "health"
+                and not e.get("ok", True)]
+    assert validate_events(events) == []
+
+
+def test_fingerprint_interval_param(tmp_path):
+    sink = tmp_path / "telem"
+    params = dict(_PARAMS, tpu_health="monitor", tpu_fingerprint_freq=2,
+                  tpu_telemetry=str(sink))
+    bst, _ = _booster(params)
+    for _ in range(4):
+        bst.update()
+    obs.disable()
+    events = load_events(str(sink))
+    fps = [e["iteration"] for e in events
+           if e.get("event") == "fingerprint"]
+    assert fps == [0, 2]
+
+
+def test_tree_check_attributes_node_and_feature():
+    """check_tree: a non-finite split gain names the node and feature;
+    a conservation breach names the leaf-vs-root totals."""
+    import jax.numpy as jnp
+
+    from lightgbm_tpu.core.grower import _empty_tree
+    obs.enable_health("strict")
+    t = _empty_tree(8, 1)
+    t = t._replace(split_gain=t.split_gain.at[2].set(jnp.nan),
+                   split_feature=t.split_feature.at[2].set(4),
+                   num_leaves=jnp.int32(4),
+                   internal_count=t.internal_count.at[0].set(10),
+                   internal_weight=t.internal_weight.at[0].set(5.0))
+    with pytest.raises(obs.TrainingHealthError, match=r"node 2 \(feature 4\)"):
+        obs.check_tree(t, phase="tree growth", iteration=5, class_id=1)
+    # conservation: leaves must partition the root
+    t2 = _empty_tree(8, 1)
+    t2 = t2._replace(
+        num_leaves=jnp.int32(2),
+        internal_count=t2.internal_count.at[0].set(100),
+        internal_weight=t2.internal_weight.at[0].set(50.0),
+        leaf_count=t2.leaf_count.at[0].set(40).at[1].set(40),
+        leaf_weight=t2.leaf_weight.at[0].set(20.0).at[1].set(20.0))
+    with pytest.raises(obs.TrainingHealthError, match="conservation"):
+        obs.check_tree(t2, phase="tree growth", iteration=0)
+    # a healthy tree and a constant tree both pass
+    t3 = _empty_tree(8, 1)
+    assert obs.check_tree(t3, phase="tree growth", iteration=0)
+
+
+def test_goss_amplification_tap_runs(tmp_path):
+    """GOSS's amplified gradients pass through their own health tap."""
+    sink = tmp_path / "telem"
+    params = dict(_PARAMS, boosting="goss", learning_rate=0.5,
+                  top_rate=0.3, other_rate=0.2, tpu_health="monitor",
+                  tpu_telemetry=str(sink))
+    bst, _ = _booster(params)
+    for _ in range(4):  # sampling starts after 1/lr = 2 iterations
+        bst.update()
+    obs.disable()
+    assert obs.counter_value("health/checks") > 4
+    events = load_events(str(sink))
+    assert not [e for e in events if e.get("event") == "health"
+                and not e.get("ok", True)]
+
+
+def test_dart_score_check_runs():
+    params = dict(_PARAMS, boosting="dart", drop_rate=0.5, skip_drop=0.0,
+                  tpu_health="strict")
+    bst, _ = _booster(params)
+    for _ in range(4):
+        bst.update()  # healthy DART under strict: no abort
+    assert bst.num_trees() == 4
+
+
+# ---------------------------------------------------------------------------
+# divergence audit
+# ---------------------------------------------------------------------------
+
+def test_divergence_audit_simulated_corrupt_rank(monkeypatch):
+    """Simulated multi-rank: identical stats pass; one corrupted rank's
+    stats raise with which-rank attribution (the real 2-process path is
+    tests/test_distributed.py::test_two_process_data_parallel_bitmatch)."""
+    import jax.numpy as jnp
+    obs.enable_health("monitor")
+    rec = obs.model_fingerprint(jnp.ones((32, 1)), iteration=0)
+    monkeypatch.setattr(health, "_gather_override",
+                        lambda s: np.stack([s, s, s]))
+    assert obs.divergence_audit(rec["stats"], iteration=0)
+
+    def corrupt(s):
+        g = np.stack([s, s, s])
+        g[1, 0] += 1e-3  # rank 1's score sum drifted
+        return g
+    monkeypatch.setattr(health, "_gather_override", corrupt)
+    # divergence raises even in monitor mode: drifted replicated state
+    # cannot produce a meaningful run.  The MINORITY rank is blamed —
+    # rank 1, not rank 0.
+    with pytest.raises(obs.TrainingHealthError, match=r"rank\(s\) \[1\]"):
+        obs.divergence_audit(rec["stats"], iteration=1)
+    # 2-rank tie: no majority, both ranks are suspects
+    monkeypatch.setattr(health, "_gather_override",
+                        lambda s: np.stack([s, s + 1.0]))
+    with pytest.raises(obs.TrainingHealthError, match=r"rank\(s\) \[0, 1\]"):
+        obs.divergence_audit(rec["stats"], iteration=2)
+
+
+def test_divergence_audit_single_process_noop():
+    obs.enable_health("monitor")
+    assert obs.divergence_audit(np.ones(4), iteration=0)
+
+
+# ---------------------------------------------------------------------------
+# schemas, summaries, off-path
+# ---------------------------------------------------------------------------
+
+def test_health_event_schemas():
+    ok_events = [
+        {"event": "health", "check": "gradients", "phase": "p",
+         "iteration": 1, "mode": "strict", "ok": False,
+         "detail": {"nonfinite_grad": 1}},
+        {"event": "fingerprint", "iteration": 0, "digest": "ab" * 8,
+         "stats": [1.0, 2.0], "trees": 1},
+        {"event": "divergence", "iteration": 2, "ok": True, "ranks": 2,
+         "digests": ["a", "a"], "spread": [0.0]},
+    ]
+    assert validate_events(ok_events) == []
+    bad_events = [
+        {"event": "health", "check": "gradients", "phase": "p",
+         "iteration": 1, "mode": "strict", "ok": "nope"},   # ok not bool
+        {"event": "fingerprint", "iteration": 0, "stats": []},  # no digest
+        {"event": "divergence", "iteration": 2, "ok": True,
+         "ranks": "two", "digests": []},                    # ranks not int
+    ]
+    problems = validate_events(bad_events)
+    assert len(problems) == 3, problems
+
+
+def test_health_summary_and_render():
+    events = [
+        {"event": "health", "check": "gradients", "phase": "p",
+         "iteration": 3, "mode": "monitor", "ok": False,
+         "detail": {"nonfinite_grad": 2}, "_proc": 0},
+        {"event": "fingerprint", "iteration": 3, "digest": "ab" * 8,
+         "stats": [1.0], "trees": 1, "_proc": 0},
+        {"event": "divergence", "iteration": 3, "ok": False, "ranks": 2,
+         "digests": ["a", "b"], "_proc": 0},
+    ]
+    hs = health_summary(events)
+    assert hs["failures"] == 1
+    assert hs["divergence_failures"] == 1
+    assert hs["first_failure"]["iteration"] == 3
+    assert hs["last_fingerprint"]["digest"] == "ab" * 8
+    digest = summarize(events)
+    assert digest["health"] == hs
+    text = render(digest)
+    assert "DIVERGED" in text and "gradients" in text
+
+
+def test_health_off_is_boolean_check():
+    """Off mode: every entry point returns immediately — no jax work, no
+    events, nothing for the off-path overhead guard to see."""
+    assert not obs.health_enabled()
+    assert obs.check_gradients(None, None, phase="p", iteration=0)
+    assert obs.check_score(None, phase="p", iteration=0)
+    assert obs.check_tree(None, phase="p", iteration=0)
+    assert obs.model_fingerprint(None, iteration=0) is None
+    assert obs.divergence_audit(np.zeros(1), iteration=0)
+
+
+def test_config_normalizes_health_modes():
+    cfg = lgb.Config.from_params({"tpu_health": "ON", "verbose": -1})
+    assert cfg.tpu_health == "monitor"
+    cfg = lgb.Config.from_params({"tpu_health": "strict", "verbose": -1})
+    assert cfg.tpu_health == "strict"
+    cfg = lgb.Config.from_params({"tpu_health": "0", "verbose": -1})
+    assert cfg.tpu_health == ""
+    with pytest.raises(lgb.LightGBMError, match="tpu_health"):
+        lgb.Config.from_params({"tpu_health": "sometimes", "verbose": -1})
+    with pytest.raises(lgb.LightGBMError, match="tpu_fingerprint_freq"):
+        lgb.Config.from_params({"tpu_fingerprint_freq": -1, "verbose": -1})

@@ -81,6 +81,26 @@ ENV_VARS = [
      "or any Booster's `tpu_profile` — every later Booster is "
      "instrumented until `obs.enable_profile(False)`.  Profiling breaks "
      "async dispatch by design — never benchmark with it on."),
+    ("LGBM_TPU_HEALTH",
+     "training-health sentinels (equivalent to the `tpu_health` "
+     "parameter): `monitor` (or `1`) finite-checks every iteration's "
+     "gradients/hessians (attributed to the objective that produced "
+     "them, plus GOSS's amplifier and DART's renormalized scores), "
+     "split gains and leaf values (attributed to node + feature), and "
+     "histogram-total conservation (leaf count/weight sums vs the "
+     "root); emits `health` events on failure and per-iteration "
+     "`fingerprint` events (cheap hash of the score vector + tree "
+     "arrays, interval set by `tpu_fingerprint_freq`); under "
+     "multi-process training the fingerprints are compared across "
+     "ranks each iteration and a mismatch ABORTS with which-rank "
+     "attribution (`divergence` event).  `strict` additionally aborts "
+     "on the first numerics failure with a `TrainingHealthError` "
+     "naming the phase/iteration (and node/feature).  PROCESS-WIDE "
+     "once on, like the telemetry sink; checks synchronize the device "
+     "each iteration, so expect a few percent overhead — off (unset) "
+     "costs one boolean per check site.  `tools/tpu_window.py` runs "
+     "every capture leg with `monitor` on so a TPU-window datapoint "
+     "certifies itself."),
     ("LGBM_TPU_PEAK_FLOPS",
      "override the profile mode's device peak FLOP/s (used with "
      "`LGBM_TPU_PEAK_BW`) when the built-in per-chip table "
