@@ -240,6 +240,11 @@ class GBDT(PredictorBase):
             obs.enable(config.tpu_telemetry)
         if getattr(config, "tpu_profile", False):
             obs.enable_profile()
+        # persistent XLA compilation cache: must be configured before the
+        # first jit compile this Booster triggers (env var alone works too)
+        from ..utils.compile_cache import enable_compile_cache
+        enable_compile_cache(getattr(config, "tpu_compile_cache_dir", "")
+                             or None)
         if getattr(config, "tpu_health", ""):
             obs.enable_health(config.tpu_health)
         self._fp_freq = max(int(getattr(config, "tpu_fingerprint_freq", 1)),
@@ -306,6 +311,7 @@ class GBDT(PredictorBase):
         self._raw_cached = False  # set True when _grow_raw is _JIT_CACHE'd
         self._report_waves = False  # wave grower emits its pass count
         self._wave_cost_args = None  # (F_kern, B_kern, mode) for profile
+        self._wave_batched = False  # wave path applies splits one-pass
 
         # ---- CEGB (reference: cost_effective_gradient_boosting.hpp) -----
         self._cegb_on = False
@@ -424,9 +430,13 @@ class GBDT(PredictorBase):
                     wave_capacity=int(config.tpu_wave_capacity),
                     highest=self._hist_mode(config),
                     gain_gate=float(config.tpu_wave_gain_gate),
-                    block_rows=int(config.tpu_block_rows))
+                    block_rows=int(config.tpu_block_rows),
+                    batched_apply=bool(
+                        getattr(config, "tpu_batched_split_apply", True)))
             use_wave = tl == "data" and wave_kw is not None
             self.uses_wave = use_wave
+            self._wave_batched = bool(
+                use_wave and wave_kw.get("batched_apply", True))
             self._grow = make_engine_grower(
                 tl, self.meta, self.split_cfg, self.B, mesh,
                 wave_kw=wave_kw if use_wave else None,
@@ -456,6 +466,9 @@ class GBDT(PredictorBase):
                                   and cegb_cfg is None
                                   and self._telemetry_waves)
 
+            batched = bool(getattr(config, "tpu_batched_split_apply", True))
+            self._wave_batched = batched
+
             def build_wave():
                 return build_wave_grow_fn(
                     self.meta, self.split_cfg, self.B,
@@ -465,7 +478,8 @@ class GBDT(PredictorBase):
                     block_rows=int(config.tpu_block_rows),
                     B_phys=self.B_phys, bundled=self._bundled,
                     cegb=cegb_cfg, mixed=mixed_info,
-                    report_waves=self._report_waves)
+                    report_waves=self._report_waves,
+                    batched_apply=batched)
             if cegb_cfg is None:
                 mixed_key = (None if mixed_info is None else
                              (mixed_info.narrow_idx.tobytes(),
@@ -477,7 +491,7 @@ class GBDT(PredictorBase):
                        self._hist_mode(config),
                        float(config.tpu_wave_gain_gate),
                        int(config.tpu_block_rows), mixed_key,
-                       self._report_waves)
+                       self._report_waves, batched)
                 self._grow_raw = _cached_jit(key, build_wave)
                 self._raw_cached = True
             else:
@@ -1182,6 +1196,18 @@ class GBDT(PredictorBase):
         recompiles = int(obs.counter_value("jax/compiles") - compiles0)
         N = self.train_ds.num_data
         phase_s = obs.phase_delta(phase0)
+        # partition attribution: how many full [N] row-partition walks
+        # this iteration paid for — the batched wave apply pays one per
+        # wave, the sequential paths one per split (splitter.py
+        # partition_cost models the traffic of each)
+        splits = sum(max(int(nl) - 1, 0) for nl in leaves)
+        part_batched = bool(self.uses_wave and self._wave_batched)
+        # batched passes == wave count, known only when the grower reports
+        # it (report_waves; the engine/mesh growers don't) — None, not a
+        # guess, when it isn't: a wrong pass count would poison the exact
+        # attribution this field exists for
+        part_passes = ((int(waves) if waves else None) if part_batched
+                       else splits)
         obs.event(
             "iteration",
             iteration=self.iter_,
@@ -1194,6 +1220,8 @@ class GBDT(PredictorBase):
             metrics=metrics,
             counters=obs.counters_snapshot(),
             recompiles=recompiles,
+            partition_passes=part_passes,
+            partition_batched=part_batched,
             cum_row_iters_per_s=round(
                 N * self._telem_iters / max(self._telem_train_s, 1e-9), 1))
         if obs.profile_enabled():
@@ -1212,9 +1240,27 @@ class GBDT(PredictorBase):
                                                  waves=waves or 1)
                 achieved = phase_s.get("tree growth", iter_s)
                 obs.record_kernel("lgbm/pallas_hist_wave", flops, nbytes,
-                                  achieved, source="analytical",
+                                  achieved, phase="tree growth",
+                                  source="analytical",
                                   rows=kern_rows, waves=waves,
                                   iteration=self.iter_)
+            if splits > 0 and recompiles == 0 and part_passes:
+                # partition-unit attribution (same analytical contract as
+                # the wave kernel's): roofline_frac here is the share of
+                # the tree-growth phase the split-apply row walks explain
+                # — the non-kernel term docs/ROOFLINE.md tracks.  Skipped
+                # when the batched pass count is unknown (mesh growers
+                # don't report waves) rather than emitting a wrong model
+                from ..core.splitter import partition_cost
+                pflops, pbytes = partition_cost(
+                    N, splits=splits, batched=part_batched,
+                    waves=waves or 1)
+                obs.record_kernel(
+                    "lgbm/partition", pflops, pbytes,
+                    phase_s.get("tree growth", iter_s),
+                    phase="tree growth", source="analytical",
+                    passes=part_passes, batched=part_batched,
+                    iteration=self.iter_)
             obs.memory_snapshot(f"iteration_{self.iter_}",
                                 buffers=self._census_buffers())
             obs.memory_audit(f"iteration_{self.iter_}")

@@ -300,6 +300,21 @@ class Config:
                                         # leaves with gain >= gate * best
                                         # ready gain (1 = strict best-first
                                         # order, 0 = max wave throughput)
+    tpu_batched_split_apply: bool = True  # apply each wave's committed
+                                        # splits to the row partition in
+                                        # ONE vectorized pass (O(N) per
+                                        # wave) instead of one full-array
+                                        # walk per split (O(splits x N));
+                                        # trees are identical either way —
+                                        # false keeps the sequential walk
+                                        # as the differential-test oracle
+    tpu_compile_cache_dir: str = ""     # persistent XLA compilation-cache
+                                        # directory: compiled growers
+                                        # survive process restarts, so
+                                        # steady-state reruns skip the
+                                        # multi-second compile (also via
+                                        # LGBM_TPU_COMPILE_CACHE env var;
+                                        # "" leaves the cache off)
     tpu_mesh_shape: str = ""            # e.g. "data:8" or "data:4,feature:2"
     tpu_telemetry: str = ""             # structured-telemetry sink: a dir
                                         # (telemetry.{proc}.jsonl inside) or

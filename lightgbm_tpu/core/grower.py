@@ -113,9 +113,9 @@ def _empty_tree(L: int, W: int = 1) -> TreeArrays:
 def go_left_bins(col, threshold, default_left, missing_type, num_bin, default_bin):
     """Bin-space split decision for every row (reference:
     src/io/dense_bin.hpp:152-231 Split).  ``col`` int32 [N]."""
-    is_missing = (((missing_type == MISSING_NAN) & (col == num_bin - 1))
-                  | ((missing_type == MISSING_ZERO) & (col == default_bin)))
-    return jnp.where(is_missing, default_left, col <= threshold)
+    from .splitter import split_decision
+    return split_decision(col, threshold, default_left, False,
+                          jnp.uint32(0), missing_type, num_bin, default_bin)
 
 
 def go_left_node(col, threshold, default_left, is_cat, cat_words,
@@ -123,11 +123,10 @@ def go_left_node(col, threshold, default_left, is_cat, cat_words,
     """Numerical-or-categorical bin-space decision for one node over all
     rows (reference: Tree::Decision / CategoricalDecisionInner,
     tree.h:221-303).  ``cat_words`` u32 [W]."""
-    from .splitter import bitset_contains
-    num_go = go_left_bins(col, threshold, default_left, missing_type,
-                          num_bin, default_bin)
-    cat_go = bitset_contains(cat_words[None, :], col)
-    return jnp.where(is_cat, cat_go, num_go)
+    from .splitter import split_decision
+    word = cat_words[col // 32]
+    return split_decision(col, threshold, default_left, is_cat, word,
+                          missing_type, num_bin, default_bin)
 
 
 class CegbConfig(NamedTuple):

@@ -10,9 +10,9 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from .grower import TreeArrays, decode_feature_col, go_left_bins
+from .grower import TreeArrays, decode_feature_col
 from .meta import DeviceMeta
-from .splitter import bitset_contains
+from .splitter import split_decision
 
 
 @jax.named_scope("lgbm/tree_traverse")
@@ -39,13 +39,16 @@ def predict_leaf_bins(tree: TreeArrays, bins, meta: DeviceMeta,
                                   axis=1)[:, 0].astype(jnp.int32)
         if phys:
             col = decode_feature_col(col, f, meta)
-        gl = go_left_bins(col, tree.threshold_bin[nd], tree.default_left[nd],
-                          meta.missing_types[f], meta.num_bins[f],
-                          meta.default_bins[f])
         # categorical nodes: membership in the node's bin-space bitset
-        # (reference: Tree::CategoricalDecisionInner, tree.h:265-303)
-        gl = jnp.where(meta.is_categorical[f],
-                       bitset_contains(tree.cat_bitset[nd], col), gl)
+        # (reference: Tree::CategoricalDecisionInner, tree.h:265-303) —
+        # the word holding col's bit is gathered per row, then the shared
+        # split_decision helper routes numerical/missing/categorical alike
+        word = jnp.take_along_axis(tree.cat_bitset[nd],
+                                   (col // 32)[:, None], axis=1)[:, 0]
+        gl = split_decision(col, tree.threshold_bin[nd],
+                            tree.default_left[nd], meta.is_categorical[f],
+                            word, meta.missing_types[f], meta.num_bins[f],
+                            meta.default_bins[f])
         nxt = jnp.where(gl, tree.left_child[nd], tree.right_child[nd])
         return jnp.where(active, nxt, node)
 

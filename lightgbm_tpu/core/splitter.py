@@ -136,6 +136,31 @@ def bitset_contains(words, idx):
     return (jnp.right_shift(word, b) & jnp.uint32(1)) != 0
 
 
+def split_decision(col, threshold, default_left, is_cat, cat_word,
+                   missing_type, num_bin, default_bin):
+    """Bin-space go-left decision, fully vectorized — the ONE place the
+    reference's Tree::Decision / DenseBin::Split semantics live
+    (reference: src/io/dense_bin.hpp:152-231, tree.h:221-303), shared by
+    tree growth (``core/grower.py go_left_bins/go_left_node``), the wave
+    grower's batched split apply (``core/wave_grower.py``) and device
+    prediction (``core/predict.py``).
+
+    All args broadcastable arrays: ``col`` i32 bin values; ``cat_word``
+    u32 — the bitset word already gathered for ``col`` (word index
+    ``col // 32``; pass 0 for numerical-only callers).  Missing routing:
+    the NaN bin (``num_bin - 1`` under MISSING_NAN) and the default bin
+    (under MISSING_ZERO) take ``default_left``; everything else compares
+    ``col <= threshold``.  Categorical nodes test bit ``col % 32`` of
+    ``cat_word`` instead.
+    """
+    is_missing = (((missing_type == MISSING_NAN) & (col == num_bin - 1))
+                  | ((missing_type == MISSING_ZERO) & (col == default_bin)))
+    num_go = jnp.where(is_missing, default_left, col <= threshold)
+    cat_go = (jnp.right_shift(cat_word, (col % 32).astype(jnp.uint32))
+              & jnp.uint32(1)) != 0
+    return jnp.where(is_cat, cat_go, num_go)
+
+
 def _categorical_best(g, h, c, sum_g, sum_h, cnt, meta: DeviceMeta,
                       cfg: SplitConfig, min_c, max_c, min_gain_shift):
     """Per-feature best categorical split over raw per-bin histograms
@@ -276,6 +301,43 @@ def split_scan_cost(F: int, B: int, leaves: int = 1):
     ops_per_cell = 48.0
     flops = ops_per_cell * leaves * F * B
     nbytes = float(leaves) * F * B * 3 * 4 * 2
+    return flops, nbytes
+
+
+def partition_cost(N: int, splits: int = 1, batched: bool = True,
+                   waves: int = 1):
+    """Analytical (FLOPs, HBM bytes) of applying ``splits`` committed
+    splits to the ``leaf_id: i32[N]`` row-partition vector —
+    ``wave_kernel_cost``'s sibling for the NON-kernel side of the wave
+    loop, the dominant term docs/ROOFLINE.md attributes the measured
+    ~9x gap to.
+
+    The sequential path (``_split_once``, ``tpu_batched_split_apply=
+    false``) re-walks the full row vector once PER SPLIT: each pass
+    reads one bin column (1 byte/row), reads + writes ``leaf_id``
+    (4+4 bytes/row) and runs the split decision.  The batched one-pass
+    apply (``core/wave_grower.py build_split_apply_fn``) walks the rows
+    once PER WAVE regardless of how many splits the wave committed,
+    paying slightly more per row (slot-table + bitset-word gathers).
+    So O(splits * N) row traffic collapses to O(waves * N):
+
+        sequential: passes = splits,  ~16 bytes + ~12 ops / row-pass
+        batched:    passes = waves,   ~21 bytes + ~24 ops / row-pass
+
+    The byte/op constants are empirical tallies of the emitted gathers
+    and elementwise ops, not derivations — same contract as
+    ``split_scan_cost``.  ``tools/prof_kernels.py``'s "partition" leg
+    measures both variants against this model; profile mode emits the
+    analytical attribution per iteration (``lgbm/partition``).
+    """
+    if batched:
+        passes = float(max(int(waves), 1))
+        ops_per_row, bytes_per_row = 24.0, 21.0
+    else:
+        passes = float(max(int(splits), 1))
+        ops_per_row, bytes_per_row = 12.0, 16.0
+    flops = ops_per_row * passes * N
+    nbytes = bytes_per_row * passes * N
     return flops, nbytes
 
 

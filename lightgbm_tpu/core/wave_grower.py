@@ -35,9 +35,109 @@ from ..ops.pallas_hist import C_MAX, hist_pallas_wave
 from .grower import TreeArrays, _empty_tree, decode_feature_col, go_left_node
 from .histogram import expand_bundled, fix_default_bins, hist_wave_xla
 from .meta import DeviceMeta, SplitConfig
-from .splitter import best_split, bitset_words, leaf_output
+from .splitter import best_split, bitset_words, leaf_output, split_decision
 
 NEG_INF = -jnp.inf
+
+
+class WaveSplits(NamedTuple):
+    """One split phase's committed splits, slot-per-entry — the batched
+    form of ``_split_once``'s per-split partition arguments.  ``ok`` rows
+    with False are empty slots (phase committed fewer than P splits)."""
+    ok: jnp.ndarray            # bool [P] slot committed a split
+    leaf: jnp.ndarray          # i32 [P] split leaf (left child keeps id)
+    new: jnp.ndarray           # i32 [P] right child's new leaf id
+    feature: jnp.ndarray       # i32 [P] inner feature index
+    threshold: jnp.ndarray     # i32 [P] bin-space threshold
+    default_left: jnp.ndarray  # bool [P]
+    cat_bitset: jnp.ndarray    # u32 [P, W] left-going bin set
+
+
+def build_split_apply_fn(meta: DeviceMeta, L: int, bundled: bool = False,
+                         mixed: "MixedWidth" = None):
+    """One-pass vectorized wave-split application.
+
+    Returns ``apply(leaf_id, bins_rm, ws: WaveSplits) -> leaf_id`` that
+    re-partitions ALL N rows for every split the phase committed in a
+    single pass: each row looks up its leaf's pending split in a
+    [P]-sized slot table, reads its own bin value with one contiguous
+    row-read from the ROW-MAJOR bins twin, and routes itself through the
+    shared ``core/splitter.py split_decision`` (NaN/zero default
+    direction and categorical bitsets included).  The sequential oracle
+    (``_split_once``) instead walks the full [N] ``leaf_id`` once per
+    split — O(P*N) row traffic per wave where this pass pays O(N)
+    (``core/splitter.py partition_cost`` models both).
+
+    ``bins_rm``: row-major bins [N, F_phys] (the ``(narrow, wide)``
+    row-major pair under ``mixed``).  ``L`` bounds leaf ids; slot tables
+    carry two dead rows past it for empty slots.
+    """
+    if mixed is not None:
+        Fn, Fw = len(mixed.narrow_idx), len(mixed.wide_idx)
+        _pos = np.zeros(Fn + Fw, np.int32)
+        _pos[mixed.narrow_idx] = np.arange(Fn, dtype=np.int32)
+        _pos[mixed.wide_idx] = np.arange(Fw, dtype=np.int32)
+        _isw = np.zeros(Fn + Fw, bool)
+        _isw[mixed.wide_idx] = True
+        pos_c = jnp.asarray(_pos)
+        is_wide_c = jnp.asarray(_isw)
+
+    @jax.named_scope("lgbm/wave_partition")
+    def apply(leaf_id, bins_rm, ws: WaveSplits):
+        P = ws.leaf.shape[0]
+        W = ws.cat_bitset.shape[1]
+        # leaf -> slot table; empty slots scatter to dead row L+1, rows
+        # whose leaf has no pending split resolve to pad slot P
+        leaf_w = jnp.where(ws.ok, ws.leaf, L + 1)
+        slot_tbl = jnp.full((L + 2,), P, jnp.int32).at[leaf_w].set(
+            jnp.arange(P, dtype=jnp.int32))
+        srow = slot_tbl[jnp.clip(leaf_id, 0, L + 1)]           # [N]
+        has = srow < P
+
+        def pad1(a, fill):
+            return jnp.concatenate([a, jnp.full((1,), fill, a.dtype)])
+        f_s = pad1(ws.feature, 0)                              # [P+1]
+        t_s = pad1(ws.threshold, 0)
+        dl_s = pad1(ws.default_left, False)
+        new_s = pad1(ws.new, 0)
+        # per-slot feature metadata: tiny [P+1] gathers from [F] meta
+        cat_s = meta.is_categorical[f_s]
+        mt_s = meta.missing_types[f_s]
+        nb_s = meta.num_bins[f_s]
+        db_s = meta.default_bins[f_s]
+        phys_s = meta.feat2phys[f_s] if bundled else f_s
+
+        # per-row bin value: one row-read per row (pad-slot rows read
+        # feature 0 and are discarded by the ``has`` mask)
+        pr = phys_s[srow]                                      # [N]
+        if mixed is None:
+            colp = jnp.take_along_axis(
+                bins_rm, pr[:, None], axis=1)[:, 0].astype(jnp.int32)
+        else:
+            rm_n, rm_w = bins_rm
+            pos_r = pos_c[pr][:, None]
+            coln = jnp.take_along_axis(
+                rm_n, jnp.minimum(pos_r, rm_n.shape[1] - 1), axis=1)[:, 0]
+            colw = jnp.take_along_axis(
+                rm_w, jnp.minimum(pos_r, rm_w.shape[1] - 1), axis=1)[:, 0]
+            colp = jnp.where(is_wide_c[pr], colw.astype(jnp.int32),
+                             coln.astype(jnp.int32))
+        if bundled:
+            # EFB decode (grower.decode_feature_col, vectorized per row)
+            off_r = meta.feat_offset[f_s][srow]
+            inb = (colp >= off_r) & (colp < off_r + nb_s[srow])
+            col = jnp.where(inb, colp - off_r, db_s[srow])
+        else:
+            col = colp
+        # the bitset word holding this row's bin bit, one flat gather
+        cb_flat = jnp.concatenate(
+            [ws.cat_bitset, jnp.zeros((1, W), jnp.uint32)]).reshape(-1)
+        word = cb_flat[srow * W + col // 32]
+        go = split_decision(col, t_s[srow], dl_s[srow], cat_s[srow], word,
+                            mt_s[srow], nb_s[srow], db_s[srow])
+        return jnp.where(has & ~go, new_s[srow], leaf_id)
+
+    return apply
 
 
 class MixedWidth(NamedTuple):
@@ -97,7 +197,8 @@ def build_wave_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
                        reduce_fn=None, B_phys: int = None,
                        bundled: bool = False, cegb=None,
                        mixed: MixedWidth = None,
-                       report_waves: bool = False):
+                       report_waves: bool = False,
+                       batched_apply: bool = True):
     """Unjitted ``grow(bins_fm, g, h, sample_mask, feature_mask)`` using the
     Pallas wave kernel. Returns (TreeArrays, leaf_id); with
     ``report_waves`` a third output ``stats`` (f32 [2]) carries the
@@ -132,6 +233,14 @@ def build_wave_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
     higher-gain children still waiting for their wave.  0 disables the
     gate (split everything positive, max throughput); 1 is strict
     best-of-phase only.
+
+    ``batched_apply`` (default True) applies each split phase's committed
+    splits to ``leaf_id`` in ONE vectorized pass (``build_split_apply_fn``)
+    instead of one full-array partition walk per split; the [L]-sized
+    bookkeeping runs in a ``lax.scan`` over the P slots so the commit
+    order — and therefore the tree — is exactly the sequential path's.
+    ``False`` keeps the per-split ``_split_once`` walk: the
+    differential-testing oracle (``tpu_batched_split_apply=false``).
 
     ``highest`` selects the histogram matmul precision mode: True/"highest"
     keeps f32 operands (exact, ~3 MXU passes); "2xbf16" (the engine
@@ -207,59 +316,103 @@ def build_wave_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
         return bs._replace(gain=jnp.where(depth_ok, bs.gain, NEG_INF))
 
     # ---------------- split phase --------------------------------------
-    @jax.named_scope("lgbm/wave_split_phase")
-    def _split_once(st: _WaveState, bins_fm, feature_mask, phase_max):
+    def _pick_split(st: _WaveState, phase_max):
+        """Best ready leaf this step + whether its split may commit."""
         gains = jnp.where(st.hist_ready[:L], st.best_gain[:L], NEG_INF)
         leaf = jnp.argmax(gains).astype(jnp.int32)
         ok = ((gains[leaf] > 0.0)
               & (gains[leaf] >= gain_gate * phase_max)
               & (st.tree.num_leaves < L)
               & (st.pend_cnt < P))
+        return leaf, ok
+
+    def _commit_split_meta(st: _WaveState, leaf):
+        """Commit ``leaf``'s cached best split into the [L]-sized state
+        (tree arrays, child stats, monotone windows, pend queues, CEGB)
+        — everything a split does EXCEPT the [N] ``leaf_id`` partition,
+        which the caller applies per split (``_split_once``) or batched
+        per phase (``_split_phase_batched``).  Returns
+        ``(st, feature, threshold, default_left, cat_bitset, new)``."""
+        new = st.tree.num_leaves.astype(jnp.int32)  # next leaf index
+        k = new - 1                                  # node index
+        f = st.best_feat[leaf]
+        t = st.best_thr[leaf]
+        dl = st.best_dl[leaf]
+        cb = st.best_cb[leaf]
+        lg, lh, lc = st.best_lg[leaf], st.best_lh[leaf], st.best_lc[leaf]
+        pg, ph, pc = st.leaf_g[leaf], st.leaf_h[leaf], st.leaf_c[leaf]
+        rg, rh, rc = pg - lg, ph - lh, pc - lc
+        min_c, max_c = st.leaf_min_c[leaf], st.leaf_max_c[leaf]
+        out_l, out_r = st.best_lout[leaf], st.best_rout[leaf]
+        mono = meta.monotone[f]
+        mid = (out_l + out_r) / 2.0
+        l_min = jnp.where(mono < 0, mid, min_c)
+        l_max = jnp.where(mono > 0, mid, max_c)
+        r_min = jnp.where(mono > 0, mid, min_c)
+        r_max = jnp.where(mono < 0, mid, max_c)
+
+        tr = st.tree
+        parent_node = st.leaf_parent[leaf]
+        has_parent = parent_node >= 0
+        pn = jnp.maximum(parent_node, 0)
+        new_lc_ptr = jnp.where(has_parent & ~st.leaf_is_right[leaf],
+                               k, tr.left_child[pn])
+        new_rc_ptr = jnp.where(has_parent & st.leaf_is_right[leaf],
+                               k, tr.right_child[pn])
+        cc = st.cegb_coupled
+        if cegb is not None:
+            cc = cc.at[f].set(0.0)
+        tr = tr._replace(
+            split_feature=tr.split_feature.at[k].set(f),
+            threshold_bin=tr.threshold_bin.at[k].set(t),
+            default_left=tr.default_left.at[k].set(dl),
+            split_gain=tr.split_gain.at[k].set(st.best_gain[leaf]),
+            internal_value=tr.internal_value.at[k].set(st.leaf_out[leaf]),
+            internal_count=tr.internal_count.at[k].set(pc.astype(jnp.int32)),
+            internal_weight=tr.internal_weight.at[k].set(ph),
+            left_child=tr.left_child.at[pn].set(new_lc_ptr).at[k].set(~leaf),
+            right_child=tr.right_child.at[pn].set(new_rc_ptr).at[k].set(~new),
+            num_leaves=tr.num_leaves + 1,
+            cat_bitset=tr.cat_bitset.at[k].set(cb),
+        )
+
+        small = jnp.where(lc < rc, leaf, new)
+        large = jnp.where(lc < rc, new, leaf)
+        d = st.leaf_depth[leaf] + 1
+
+        def upd(a, v1, v2):
+            return a.at[leaf].set(v1).at[new].set(v2)
+
+        st = st._replace(
+            leaf_g=upd(st.leaf_g, lg, rg),
+            leaf_h=upd(st.leaf_h, lh, rh),
+            leaf_c=upd(st.leaf_c, lc, rc),
+            leaf_depth=upd(st.leaf_depth, d, d),
+            leaf_min_c=upd(st.leaf_min_c, l_min, r_min),
+            leaf_max_c=upd(st.leaf_max_c, l_max, r_max),
+            leaf_out=upd(st.leaf_out, out_l, out_r),
+            hist_ready=upd(st.hist_ready, False, False),
+            best_gain=upd(st.best_gain, NEG_INF, NEG_INF),
+            leaf_parent=upd(st.leaf_parent, k, k),
+            leaf_is_right=upd(st.leaf_is_right, False, True),
+            pend_small=st.pend_small.at[st.pend_cnt].set(small),
+            pend_large=st.pend_large.at[st.pend_cnt].set(large),
+            pend_cnt=st.pend_cnt + 1,
+            tree=tr,
+            cegb_coupled=cc,
+        )
+        return st, f, t, dl, cb, new
+
+    @jax.named_scope("lgbm/wave_split_phase")
+    def _split_once(st: _WaveState, bins_fm, feature_mask, phase_max):
+        """Sequential oracle: commit ONE split and immediately re-walk the
+        full [N] leaf_id for it — the reference's one-split-at-a-time
+        partition order, kept behind ``batched_apply=False`` for
+        differential testing."""
+        leaf, ok = _pick_split(st, phase_max)
 
         def do(st: _WaveState) -> _WaveState:
-            new = st.tree.num_leaves.astype(jnp.int32)  # next leaf index
-            k = new - 1                                  # node index
-            f = st.best_feat[leaf]
-            t = st.best_thr[leaf]
-            dl = st.best_dl[leaf]
-            cb = st.best_cb[leaf]
-            lg, lh, lc = st.best_lg[leaf], st.best_lh[leaf], st.best_lc[leaf]
-            pg, ph, pc = st.leaf_g[leaf], st.leaf_h[leaf], st.leaf_c[leaf]
-            rg, rh, rc = pg - lg, ph - lh, pc - lc
-            min_c, max_c = st.leaf_min_c[leaf], st.leaf_max_c[leaf]
-            out_l, out_r = st.best_lout[leaf], st.best_rout[leaf]
-            mono = meta.monotone[f]
-            mid = (out_l + out_r) / 2.0
-            l_min = jnp.where(mono < 0, mid, min_c)
-            l_max = jnp.where(mono > 0, mid, max_c)
-            r_min = jnp.where(mono > 0, mid, min_c)
-            r_max = jnp.where(mono < 0, mid, max_c)
-
-            tr = st.tree
-            parent_node = st.leaf_parent[leaf]
-            has_parent = parent_node >= 0
-            pn = jnp.maximum(parent_node, 0)
-            new_lc_ptr = jnp.where(has_parent & ~st.leaf_is_right[leaf],
-                                   k, tr.left_child[pn])
-            new_rc_ptr = jnp.where(has_parent & st.leaf_is_right[leaf],
-                                   k, tr.right_child[pn])
-            cc = st.cegb_coupled
-            if cegb is not None:
-                cc = cc.at[f].set(0.0)
-            tr = tr._replace(
-                split_feature=tr.split_feature.at[k].set(f),
-                threshold_bin=tr.threshold_bin.at[k].set(t),
-                default_left=tr.default_left.at[k].set(dl),
-                split_gain=tr.split_gain.at[k].set(st.best_gain[leaf]),
-                internal_value=tr.internal_value.at[k].set(st.leaf_out[leaf]),
-                internal_count=tr.internal_count.at[k].set(pc.astype(jnp.int32)),
-                internal_weight=tr.internal_weight.at[k].set(ph),
-                left_child=tr.left_child.at[pn].set(new_lc_ptr).at[k].set(~leaf),
-                right_child=tr.right_child.at[pn].set(new_rc_ptr).at[k].set(~new),
-                num_leaves=tr.num_leaves + 1,
-                cat_bitset=tr.cat_bitset.at[k].set(cb),
-            )
-
+            st, f, t, dl, cb, new = _commit_split_meta(st, leaf)
             col = _phys_col(bins_fm, meta.feat2phys[f] if bundled else f)
             if bundled:
                 col = decode_feature_col(col, f, meta)
@@ -267,36 +420,45 @@ def build_wave_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
                                    meta.missing_types[f], meta.num_bins[f],
                                    meta.default_bins[f])
             in_leaf = st.leaf_id == leaf
-            leaf_id = jnp.where(in_leaf & ~go_left, new, st.leaf_id)
-
-            small = jnp.where(lc < rc, leaf, new)
-            large = jnp.where(lc < rc, new, leaf)
-            d = st.leaf_depth[leaf] + 1
-
-            def upd(a, v1, v2):
-                return a.at[leaf].set(v1).at[new].set(v2)
-
             return st._replace(
-                leaf_id=leaf_id,
-                leaf_g=upd(st.leaf_g, lg, rg),
-                leaf_h=upd(st.leaf_h, lh, rh),
-                leaf_c=upd(st.leaf_c, lc, rc),
-                leaf_depth=upd(st.leaf_depth, d, d),
-                leaf_min_c=upd(st.leaf_min_c, l_min, r_min),
-                leaf_max_c=upd(st.leaf_max_c, l_max, r_max),
-                leaf_out=upd(st.leaf_out, out_l, out_r),
-                hist_ready=upd(st.hist_ready, False, False),
-                best_gain=upd(st.best_gain, NEG_INF, NEG_INF),
-                leaf_parent=upd(st.leaf_parent, k, k),
-                leaf_is_right=upd(st.leaf_is_right, False, True),
-                pend_small=st.pend_small.at[st.pend_cnt].set(small),
-                pend_large=st.pend_large.at[st.pend_cnt].set(large),
-                pend_cnt=st.pend_cnt + 1,
-                tree=tr,
-                cegb_coupled=cc,
-            )
+                leaf_id=jnp.where(in_leaf & ~go_left, new, st.leaf_id))
 
         return jax.lax.cond(ok, do, lambda s: s, st)
+
+    if batched_apply:
+        _apply_splits = build_split_apply_fn(meta, L, bundled=bundled,
+                                             mixed=mixed)
+        W_slots = bitset_words(B)
+
+    @jax.named_scope("lgbm/wave_split_phase")
+    def _split_phase_batched(st: _WaveState, bins_rm, feature_mask,
+                             phase_max):
+        """Batched split phase: commit up to P splits' [L]-sized metadata
+        in a ``lax.scan`` (the commit ORDER — argmax over the updated
+        gains each step — is exactly the sequential fori_loop's, so the
+        tree is identical), then update ``leaf_id`` for ALL rows in one
+        vectorized pass.  A leaf splits at most once per phase
+        (``hist_ready``/``best_gain`` are cleared on commit), so the
+        per-leaf slot lookup is exact."""
+        def step(st, _):
+            leaf, ok = _pick_split(st, phase_max)
+
+            def do(st):
+                st, f, t, dl, cb, new = _commit_split_meta(st, leaf)
+                return st, WaveSplits(jnp.bool_(True), leaf, new, f, t,
+                                      dl, cb)
+
+            def skip(st):
+                return st, WaveSplits(
+                    jnp.bool_(False), jnp.int32(-1), jnp.int32(-1),
+                    jnp.int32(0), jnp.int32(0), jnp.bool_(False),
+                    jnp.zeros((W_slots,), jnp.uint32))
+
+            return jax.lax.cond(ok, do, skip, st)
+
+        st, slots = jax.lax.scan(step, st, None, length=P)
+        return st._replace(
+            leaf_id=_apply_splits(st.leaf_id, bins_rm, slots))
 
     # ---------------- wave phase ---------------------------------------
     def _wave(st: _WaveState, bins_fm, bins_rm, gv, hv, cv, feature_mask):
@@ -526,20 +688,26 @@ def build_wave_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
         # row-major twin of the resident feature-major bins: materialized
         # once per tree (a ~50us transpose at 1M rows), it turns every
         # compaction gather from F strided byte-touches per row into one
-        # contiguous F-byte read (see _wave).  The wide twin also feeds the
-        # XLA side-pass, so mixed mode builds it even when not compacting.
+        # contiguous F-byte read (see _wave), and gives the batched split
+        # apply its one-row-read-per-row bin lookup.  The wide twin also
+        # feeds the XLA side-pass, so mixed mode builds it always.
         if mixed is not None:
             bins_rm = (jnp.transpose(bins_fm[0]), jnp.transpose(bins_fm[1]))
         else:
-            bins_rm = jnp.transpose(bins_fm) if compact else bins_fm
+            bins_rm = (jnp.transpose(bins_fm)
+                       if (compact or batched_apply) else bins_fm)
 
         def loop_body(st):
             ready = jnp.where(st.hist_ready[:L], st.best_gain[:L], NEG_INF)
             phase_max = jnp.max(ready)
 
-            def split_body(_, st):
-                return _split_once(st, bins_fm, feature_mask, phase_max)
-            st = jax.lax.fori_loop(0, P, split_body, st)
+            if batched_apply:
+                st = _split_phase_batched(st, bins_rm, feature_mask,
+                                          phase_max)
+            else:
+                def split_body(_, st):
+                    return _split_once(st, bins_fm, feature_mask, phase_max)
+                st = jax.lax.fori_loop(0, P, split_body, st)
             return _wave(st, bins_fm, bins_rm, gv, hv, cv, feature_mask)
 
         st = jax.lax.while_loop(loop_cond, loop_body, st)

@@ -25,6 +25,11 @@ def train(params: Dict[str, Any], train_set: Dataset,
           callbacks: Optional[List] = None) -> Booster:
     """Train a booster (reference: engine.py:18-250)."""
     params = dict(params or {})
+    # persistent XLA compilation cache: configure before the Booster's
+    # first jit compile (param surface here; LGBM_TPU_COMPILE_CACHE works
+    # without params — see utils/compile_cache.py)
+    from .utils.compile_cache import enable_compile_cache
+    enable_compile_cache(params.get("tpu_compile_cache_dir") or None)
     for alias in ("num_boost_round", "num_iterations", "num_iteration",
                   "n_iter", "num_tree", "num_trees", "num_round", "num_rounds",
                   "n_estimators"):
@@ -223,6 +228,8 @@ def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
         raise TypeError(f"Training only accepts Dataset object, "
                         f"met {type(train_set).__name__}")
     params = dict(params or {})
+    from .utils.compile_cache import enable_compile_cache
+    enable_compile_cache(params.get("tpu_compile_cache_dir") or None)
     for alias in ("num_boost_round", "num_iterations", "num_iteration",
                   "n_iter", "num_tree", "num_trees", "num_round", "num_rounds",
                   "n_estimators"):

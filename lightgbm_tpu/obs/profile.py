@@ -163,12 +163,14 @@ def _sig(args, kwargs):
 
 
 def record_kernel(name: str, flops: float, nbytes: float, achieved_s: float,
-                  **extra) -> None:
+                  phase: str = None, **extra) -> None:
     """Fold one kernel execution into the aggregates + emit its
     ``kernel_profile`` event.  Also the entry point for ANALYTICAL
     attributions (kernels fused inside a larger program whose work is
     known from the model, e.g. the wave kernel's rows-histogrammed count —
-    pass ``source="analytical"``)."""
+    pass ``source="analytical"``).  ``phase`` overrides the phase
+    attribution for callers emitting outside the phase timer that did the
+    work (the per-iteration analytical records)."""
     rf = roofline_seconds(flops, nbytes)
     frac = rf / achieved_s if achieved_s > 0 else 0.0
     a = _agg.get(name)
@@ -181,7 +183,8 @@ def record_kernel(name: str, flops: float, nbytes: float, achieved_s: float,
     a["bytes"] += nbytes
     a["roofline_s"] += rf
     a["best_frac"] = max(a["best_frac"], frac)
-    core.event("kernel_profile", kernel=name, phase=core.current_phase(),
+    core.event("kernel_profile", kernel=name,
+               phase=phase if phase is not None else core.current_phase(),
                flops=flops, bytes=nbytes, achieved_s=round(achieved_s, 6),
                roofline_s=round(rf, 9), roofline_frac=round(frac, 6),
                device=device_kind(), **extra)

@@ -232,14 +232,24 @@ def make_feature_parallel_grower(meta: DeviceMeta, cfg: SplitConfig, B: int,
 
 
 def make_data_parallel_wave_grower(meta: DeviceMeta, cfg: SplitConfig, B: int,
-                                   mesh: Mesh, **wave_kw):
+                                   mesh: Mesh, batched_apply: bool = True,
+                                   **wave_kw):
     """Row-sharded WAVE growth: the Pallas kernel histograms local rows,
     psum makes the result global, every device replays identical split
     decisions (reference: data_parallel_tree_learner.cpp composed with the
     GPU learner's kernel).  Takes feature-major bins [F, N] sharded on the
-    row axis."""
+    row axis.
+
+    ``batched_apply`` threads the one-pass split application through the
+    sharded path: the split-phase scan runs on replicated [L]-sized state
+    (identical on every device, like the histograms after psum), while
+    each device re-partitions only its LOCAL row shard in the single
+    vectorized pass — the per-device partition traffic drops from
+    O(splits x N/D) to O(N/D) per wave exactly as on one device.  False
+    keeps the sequential per-split walk (the differential oracle)."""
     from ..core.wave_grower import build_wave_grow_fn
-    grow = build_wave_grow_fn(meta, cfg, B, reduce_fn=_psum, **wave_kw)
+    grow = build_wave_grow_fn(meta, cfg, B, reduce_fn=_psum,
+                              batched_apply=batched_apply, **wave_kw)
     return _shard_map(grow, mesh,
                       (P(None, AXIS), P(AXIS), P(AXIS), P(AXIS), P()),
                       (P(), P(AXIS)))

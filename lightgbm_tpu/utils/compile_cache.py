@@ -1,0 +1,87 @@
+"""Persistent XLA compilation-cache wiring.
+
+Every training process pays the grower compile (4.4 s headline / 9.9 s
+rank leg at the BENCH_r05 shapes) even though the compiled program is
+byte-identical run to run — pure overhead on every bench round and every
+restart.  JAX ships a content-addressed persistent cache; this module is
+the ONE switch that turns it on for this package, from either surface:
+
+- the ``tpu_compile_cache_dir`` parameter (``engine.train`` / any
+  ``Booster`` construction), or
+- the ``LGBM_TPU_COMPILE_CACHE`` environment variable (``bench.py``,
+  CLI, anything that cannot pass params).
+
+``enable_compile_cache`` is idempotent and must run BEFORE the first
+``jit`` compilation it should capture; later calls with the same
+directory are no-ops.  ``compile_cache_info`` reports the directory in
+effect and whether it was WARM (held entries) when enabled — bench.py
+embeds both so a recorded compile_s figure says which kind of compile it
+measured.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from . import log
+
+_state = {"dir": None, "warm": None}
+
+
+def _entry_count(path: str) -> int:
+    try:
+        return sum(len(fs) for _, _, fs in os.walk(path))
+    except OSError:
+        return 0
+
+
+def enable_compile_cache(path: Optional[str] = None) -> Optional[str]:
+    """Point JAX's persistent compilation cache at ``path`` (falling back
+    to ``$LGBM_TPU_COMPILE_CACHE``; no-op when neither is set).
+
+    Returns the cache directory in effect, or None when the cache stays
+    off or JAX refused the configuration (logged, never raised — a cache
+    failure must not cost a training run)."""
+    p = path or os.environ.get("LGBM_TPU_COMPILE_CACHE", "")
+    if not p:
+        return _state["dir"]
+    p = os.path.abspath(os.path.expanduser(str(p)))
+    if _state["dir"] == p:
+        return p
+    warm = _entry_count(p) > 0
+    try:
+        os.makedirs(p, exist_ok=True)
+        import jax
+        jax.config.update("jax_compilation_cache_dir", p)
+        # cache EVERYTHING: the default minimums (1s compile, 4KB entry)
+        # would skip the many small helper jits around the grower
+        for knob, val in (("jax_persistent_cache_min_compile_time_secs", 0.0),
+                          ("jax_persistent_cache_min_entry_size_bytes", -1)):
+            try:
+                jax.config.update(knob, val)
+            except Exception:  # noqa: BLE001 — knob absent on this jax
+                pass
+        # jax initializes the cache backend lazily at the FIRST compile
+        # and then ignores config changes; if anything compiled before
+        # this call (warm process, earlier Booster), the no-dir decision
+        # is already frozen — reset so the new directory takes effect
+        try:
+            from jax.experimental.compilation_cache.compilation_cache import \
+                reset_cache
+            reset_cache()
+        except Exception:  # noqa: BLE001 — moved/absent on this jax
+            pass
+    except Exception as exc:  # noqa: BLE001
+        log.warning("persistent compilation cache disabled (%s: %s)",
+                    type(exc).__name__, exc)
+        return None
+    _state["dir"] = p
+    _state["warm"] = warm
+    log.info("persistent XLA compilation cache at %s (%s)", p,
+             "warm" if warm else "cold")
+    return p
+
+
+def compile_cache_info() -> dict:
+    """{"dir": path-or-None, "warm": bool-or-None} as of enable time."""
+    return dict(_state)

@@ -91,6 +91,13 @@ _SLOW = {
     "test_obs.py::test_off_path_overhead_guard",
     "test_tools.py::test_tpu_window_dry_run_end_to_end",
     "test_tools.py::test_run_suite_reports_failure",
+    "test_wave_apply.py::test_batched_apply_differential[categorical_bitset-7]",
+    "test_wave_apply.py::test_batched_apply_differential[categorical_bitset-23]",
+    "test_wave_apply.py::test_batched_apply_differential[tie_gain-7]",
+    "test_wave_apply.py::test_batched_apply_differential[tie_gain-23]",
+    "test_wave_apply.py::test_batched_apply_differential[bagging-7]",
+    "test_wave_apply.py::test_batched_apply_differential[bagging-23]",
+    "test_wave_apply.py::test_batched_apply_mesh_parallel",
 }
 
 

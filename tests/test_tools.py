@@ -184,6 +184,24 @@ def test_bench_history_unparsed_round_and_telemetry_fold(tmp_path):
     assert m["jax_compiles"] == 7
 
 
+def test_bench_history_canary_trend(tmp_path):
+    """Degraded-backend rounds stay out of regression baselines but their
+    per_iter_s/value movement is surfaced as an informational trend — a
+    partition-style win is visible even with no TPU datapoint."""
+    bh, rows = _history(tmp_path, [
+        _bench_round(1, 500.0, 2.0, backend="cpu-fallback"),
+        _bench_round(2, 1000.0, 1.0, backend="cpu-fallback"),
+    ])
+    trend = bh.canary_trend(rows)
+    assert [t["round"] for t in trend] == ["r01", "r02"]
+    assert trend[1]["per_iter_s_change_frac"] == pytest.approx(-0.5)
+    assert trend[1]["value_change_frac"] == pytest.approx(1.0)
+    # canaries still gate NOTHING
+    assert bh.find_regressions(rows, threshold=0.05) == []
+    text = bh.render(rows, [])
+    assert "canary trend" in text and "-50.0%" in text
+
+
 def test_bench_history_cli_exit_codes(tmp_path, monkeypatch, capsys):
     tool = os.path.join(TOOLS, "bench_history.py")
     for i, r in enumerate([_bench_round(1, 2000.0, 0.5),

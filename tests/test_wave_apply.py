@@ -1,0 +1,253 @@
+"""Batched one-pass wave split application — differential correctness.
+
+The wave grower's split phase now updates ``leaf_id`` for every committed
+split in ONE vectorized pass (``core/wave_grower.py build_split_apply_fn``,
+``tpu_batched_split_apply``); the sequential per-split walk
+(``_split_once``) is kept as the byte-exactness oracle.  These tests grow
+the same randomized problems through BOTH paths and require identical
+trees and row partitions across the semantics the apply must preserve:
+NaN/default-left routing, categorical bitsets, tie-gain commit order, and
+bagging masks — plus the sharded composition through ``parallel/mesh.py``.
+"""
+import json
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import obs
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.core.meta import SplitConfig, build_device_meta
+from lightgbm_tpu.core.wave_grower import build_wave_grow_fn
+
+
+def _assert_identical(res1, res2):
+    (t1, l1), (t2, l2) = res1, res2
+    assert int(t1.num_leaves) == int(t2.num_leaves)
+    for fld in t1._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(t1, fld)), np.asarray(getattr(t2, fld)),
+            err_msg=f"tree field {fld} diverged")
+    np.testing.assert_array_equal(np.asarray(l1), np.asarray(l2))
+
+
+def _grow_both(X, y, params, seed, capacity, mask=None, cat_features=None):
+    ds = lgb.Dataset(X, label=y, params=params,
+                     categorical_feature=cat_features or "auto")
+    ds.construct()
+    handle = ds._handle
+    cfg = Config.from_params(params)
+    meta, B = build_device_meta(handle, cfg)
+    scfg = SplitConfig.from_config(cfg)
+    n = handle.num_data
+    rng = np.random.default_rng(seed)
+    g = jnp.asarray(rng.normal(size=n).astype(np.float32))
+    h = jnp.asarray((0.1 + rng.random(n)).astype(np.float32))
+    m = (jnp.ones((n,), jnp.float32) if mask is None
+         else jnp.asarray(mask.astype(np.float32)))
+    fmask = jnp.ones((handle.num_features,), bool)
+    bins_fm = jnp.asarray(np.ascontiguousarray(handle.X_bin.T))
+    out = []
+    for batched in (False, True):
+        grow = jax.jit(build_wave_grow_fn(
+            meta, scfg, B, wave_capacity=capacity, highest=True,
+            interpret=True, gain_gate=0.5, batched_apply=batched))
+        out.append(grow(bins_fm, g, h, m, fmask))
+    return out
+
+
+def _case_problem(case, seed):
+    rng = np.random.default_rng(seed)
+    n, f = 600, 6
+    X = rng.normal(size=(n, f))
+    y = (X[:, 0] + X[:, 1] * X[:, 2] + 0.3 * rng.normal(size=n) > 0)
+    params = {"objective": "binary", "num_leaves": 15,
+              "min_data_in_leaf": 5, "verbose": -1}
+    mask = None
+    cats = None
+    if case == "nan_default_left":
+        # missing mass must follow default_left through BOTH partitions
+        X[rng.random((n, f)) < 0.15] = np.nan
+    elif case == "categorical_bitset":
+        # a high-cardinality categorical wins splits via its bin set
+        X[:, 3] = rng.integers(0, 40, size=n)
+        y = (((X[:, 3].astype(int) % 5) < 2) | (X[:, 0] > 0.7))
+        cats = [3]
+        params = dict(params, min_data_per_group=5, cat_smooth=1.0,
+                      cat_l2=1.0, max_cat_to_onehot=4)
+    elif case == "tie_gain":
+        # duplicated columns force exactly tied gains: the argmax commit
+        # ORDER (lower feature index first) must survive the batched scan
+        X[:, 4] = X[:, 0]
+        X[:, 5] = X[:, 1]
+    elif case == "bagging":
+        mask = rng.random(n) < 0.6
+    else:  # pragma: no cover
+        raise AssertionError(case)
+    return X, y.astype(np.float64), params, mask, cats
+
+
+def test_batched_apply_differential_smoke():
+    """Quick-tier smoke (the run_suite differential-apply gate): NaN +
+    default-left routing, one seed, batched == sequential byte-for-byte."""
+    X, y, params, mask, cats = _case_problem("nan_default_left", 0)
+    r1, r2 = _grow_both(X, y, params, 1, capacity=6, mask=mask,
+                        cat_features=cats)
+    _assert_identical(r1, r2)
+    # the tree must actually have grown for the diff to mean anything
+    assert int(r1[0].num_leaves) > 4
+
+
+@pytest.mark.parametrize("case,seed", [
+    ("categorical_bitset", 7), ("categorical_bitset", 23),
+    ("tie_gain", 7), ("tie_gain", 23),
+    ("bagging", 7), ("bagging", 23),
+])
+def test_batched_apply_differential(case, seed):
+    """Randomized differential: batched one-pass apply == sequential
+    oracle across categorical-bitset, tie-gain and bagging-mask cases."""
+    X, y, params, mask, cats = _case_problem(case, seed)
+    for capacity in (1, 6):
+        r1, r2 = _grow_both(X, y, params, seed + 1, capacity=capacity,
+                            mask=mask, cat_features=cats)
+        _assert_identical(r1, r2)
+        assert int(r1[0].num_leaves) > 4
+    if case == "categorical_bitset":
+        nn = int(r1[0].num_leaves) - 1
+        cb = np.asarray(r1[0].cat_bitset[:nn])
+        assert (cb != 0).any(), "no categorical split committed — case inert"
+
+
+def test_batched_apply_mesh_parallel():
+    """Sharded composition (parallel/mesh.py): on a 2-device mesh the
+    row-sharded wave grower's batched apply matches its sequential
+    oracle bit-for-bit, and the feature-parallel learner (which rides
+    the refactored shared split_decision helper) still reproduces the
+    serial grower."""
+    from jax.sharding import Mesh
+    from lightgbm_tpu.core.grower import make_grower
+    from lightgbm_tpu.parallel import make_feature_parallel_grower
+    from lightgbm_tpu.parallel.mesh import make_data_parallel_wave_grower
+
+    rng = np.random.default_rng(5)
+    n, f = 512, 6
+    X = rng.normal(size=(n, f))
+    X[rng.random((n, f)) < 0.1] = np.nan
+    y = (np.nan_to_num(X[:, 0]) + np.nan_to_num(X[:, 1]) > 0)
+    params = {"objective": "binary", "num_leaves": 15,
+              "min_data_in_leaf": 5, "verbose": -1}
+    ds = lgb.Dataset(X, label=y.astype(np.float64), params=params)
+    ds.construct()
+    handle = ds._handle
+    cfg = Config.from_params(params)
+    meta, B = build_device_meta(handle, cfg)
+    scfg = SplitConfig.from_config(cfg)
+    g = jnp.asarray(rng.normal(size=n).astype(np.float32))
+    h = jnp.asarray((0.1 + rng.random(n)).astype(np.float32))
+    mask = jnp.ones((n,), jnp.float32)
+    fmask = jnp.ones((f,), bool)
+    bins = jnp.asarray(handle.X_bin)
+    bins_fm = jnp.asarray(np.ascontiguousarray(handle.X_bin.T))
+
+    devs = np.array(jax.devices())
+    assert len(devs) >= 2
+    mesh = Mesh(devs[:2], ("data",))
+
+    res = []
+    for batched in (False, True):
+        dp = make_data_parallel_wave_grower(
+            meta, scfg, B, mesh, wave_capacity=6,
+            highest=True, interpret=True, gain_gate=0.5,
+            batched_apply=batched)
+        res.append(dp(bins_fm, g, h, mask, fmask))
+    _assert_identical(res[0], res[1])
+    assert int(res[0][0].num_leaves) > 4
+
+    t_serial, _ = make_grower(meta, scfg, B)(bins, g, h, mask, fmask)
+    fp = make_feature_parallel_grower(meta, scfg, B, mesh)
+    t_fp, _ = fp(bins, g, h, mask, fmask)
+    assert int(t_fp.num_leaves) == int(t_serial.num_leaves)
+    nn = int(t_serial.num_leaves) - 1
+    np.testing.assert_array_equal(np.asarray(t_fp.split_feature[:nn]),
+                                  np.asarray(t_serial.split_feature[:nn]))
+    np.testing.assert_array_equal(np.asarray(t_fp.threshold_bin[:nn]),
+                                  np.asarray(t_serial.threshold_bin[:nn]))
+
+
+def test_default_path_is_batched(monkeypatch):
+    """The batched apply is the DEFAULT: a TPU-gated Booster builds its
+    wave grower with the one-pass apply; tpu_batched_split_apply=false
+    selects the sequential oracle."""
+    assert Config().tpu_batched_split_apply is True
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(200, 3)).round(1)
+    y = (X[:, 0] > 0).astype(np.float64)
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    base = {"objective": "binary", "verbose": -1, "device_type": "tpu"}
+    ds = lgb.Dataset(X, label=y, params=base)
+    bst = lgb.Booster(params=base, train_set=ds)
+    assert bst._gbdt.uses_wave and bst._gbdt._wave_batched
+    ds2 = lgb.Dataset(X, label=y, params=base)
+    bst2 = lgb.Booster(
+        params={**base, "tpu_batched_split_apply": False}, train_set=ds2)
+    assert bst2._gbdt.uses_wave and not bst2._gbdt._wave_batched
+
+
+def test_partition_cost_model():
+    """partition_cost: sequential row traffic scales with splits, the
+    batched pass with waves; one wave of P splits must cost the batched
+    path less than the sequential one for P > ~2."""
+    from lightgbm_tpu.core.splitter import partition_cost
+    N = 100_000
+    fb, bb = partition_cost(N, splits=42, batched=True, waves=1)
+    fs, bs = partition_cost(N, splits=42, batched=False)
+    assert bs > 10 * bb and fs > 10 * fb
+    # single split: the sequential walk is the cheaper primitive
+    f1b, b1b = partition_cost(N, splits=1, batched=True, waves=1)
+    f1s, b1s = partition_cost(N, splits=1, batched=False)
+    assert b1s < b1b
+    # linear in rows
+    assert partition_cost(2 * N, splits=5, batched=False)[1] == 2 * bs / 42 * 5
+
+
+def test_partition_attribution_emitted(tmp_path):
+    """Profile mode separately attributes the partition unit: iteration
+    events carry partition_passes/partition_batched and a
+    ``lgbm/partition`` kernel_profile event lands in the stream (the
+    acceptance telemetry for the batched-apply PR, CPU-runnable)."""
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(400, 5))
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float64)
+    obs.reset()
+    obs.enable(str(tmp_path / "t"))
+    obs.enable_profile()
+    try:
+        params = {"objective": "binary", "num_leaves": 7,
+                  "min_data_in_leaf": 5, "verbose": -1}
+        ds = lgb.Dataset(X, label=y, params=params)
+        bst = lgb.Booster(params=params, train_set=ds)
+        for _ in range(3):
+            bst.update()
+        digest = obs.digest()
+    finally:
+        obs.enable_profile(False)
+        obs.disable()
+        obs.reset()
+    events = [json.loads(ln) for ln in
+              (tmp_path / "t" / "telemetry.0.jsonl").read_text().splitlines()]
+    iters = [e for e in events if e["event"] == "iteration"]
+    assert iters
+    for e in iters:
+        assert e["partition_passes"] >= 1
+        # CPU serial grower: one partition walk per split
+        assert e["partition_batched"] is False
+        assert e["partition_passes"] == sum(
+            max(nl - 1, 0) for nl in e["leaves"])
+    kp = [e for e in events if e["event"] == "kernel_profile"
+          and e["kernel"] == "lgbm/partition"]
+    assert kp, "lgbm/partition attribution missing from profile stream"
+    assert all(e["flops"] > 0 and e["bytes"] > 0 for e in kp)
+    assert "lgbm/partition" in (digest.get("kernels") or {})

@@ -21,10 +21,14 @@ against the best earlier comparable round — comparable meaning the same
 ran on a degraded backend (``backend: cpu-fallback`` / ``cpu-forced``)
 are wedge canaries: they are flagged in the table and excluded from the
 regression baseline on BOTH sides, so a canary is never quoted as a perf
-datapoint nor used as the bar a real round must clear.  Direction is
-per-metric (throughput up is good, per-iter seconds down is good); a
-move worse than ``--threshold`` (default 10%) is flagged.
-``--fail-on-regression`` turns flags into exit code 1 for CI use.
+datapoint nor used as the bar a real round must clear.  A separate
+INFORMATIONAL canary trend still surfaces ``per_iter_s`` alongside
+throughput across same-context canary rounds, so a real speedup (e.g.
+the batched split apply) is visible even when every recent round ran on
+the CPU fallback.  Direction is per-metric (throughput up is good,
+per-iter seconds down is good); a move worse than ``--threshold``
+(default 10%) is flagged.  ``--fail-on-regression`` turns flags into
+exit code 1 for CI use.
 """
 from __future__ import annotations
 
@@ -201,6 +205,35 @@ def find_regressions(rows: List[dict], threshold: float) -> List[dict]:
     return sorted(out, key=lambda r: -abs(r["change_frac"]))
 
 
+def canary_trend(rows: List[dict]) -> List[dict]:
+    """per_iter_s + throughput trajectory across CANARY rounds of the
+    same context.  Canaries never enter regression baselines
+    (``find_regressions`` drops them), which also meant a perf win was
+    INVISIBLE when consecutive rounds all ran on the CPU fallback — this
+    surfaces per-iteration seconds alongside throughput for those rounds
+    as an informational trend (never a gate): a partition-path speedup
+    shows up as a per_iter_s drop between canaries even without a TPU
+    datapoint."""
+    prev: dict = {}
+    out = []
+    for r in rows:
+        if not r.get("canary") or not r["metrics"]:
+            continue
+        ent = {"round": r["round"], "backend": r.get("canary"),
+               "per_iter_s": r["metrics"].get("per_iter_s"),
+               "value": r["metrics"].get("value")}
+        p = prev.get(r["context"])
+        if p:
+            for m in ("per_iter_s", "value"):
+                cur, base = ent.get(m), p.get(m)
+                if cur is not None and base:
+                    ch = (cur - base) / abs(base)
+                    ent[f"{m}_change_frac"] = round(ch, 4)
+        prev[r["context"]] = ent
+        out.append(ent)
+    return out
+
+
 def render(rows: List[dict], regressions: List[dict]) -> str:
     cols = [c for c in _TABLE_COLS
             if any(c in r["metrics"] for r in rows)]
@@ -232,6 +265,23 @@ def render(rows: List[dict], regressions: List[dict]) -> str:
     else:
         out.append("")
         out.append("no regressions against comparable prior rounds")
+    trend = [t for t in canary_trend(rows)
+             if "per_iter_s_change_frac" in t or "value_change_frac" in t]
+    if trend:
+        out.append("")
+        out.append("canary trend (informational — degraded-backend rounds, "
+                   "never a baseline):")
+        for t in trend:
+            bits = [f"  {t['round']} [{t['backend']}]"]
+            if t.get("per_iter_s") is not None:
+                bits.append(f"per_iter_s {t['per_iter_s']:g}")
+                if "per_iter_s_change_frac" in t:
+                    bits.append(f"({t['per_iter_s_change_frac']:+.1%})")
+            if t.get("value") is not None:
+                bits.append(f"value {t['value']:,.4g}")
+                if "value_change_frac" in t:
+                    bits.append(f"({t['value_change_frac']:+.1%})")
+            out.append(" ".join(bits))
     return "\n".join(out)
 
 
@@ -257,7 +307,8 @@ def main() -> int:
         return 1
     regressions = find_regressions(rows, args.threshold)
     if args.json:
-        print(json.dumps({"rounds": rows, "regressions": regressions}))
+        print(json.dumps({"rounds": rows, "regressions": regressions,
+                          "canary_trend": canary_trend(rows)}))
     else:
         print(render(rows, regressions))
     if regressions and args.fail_on_regression:

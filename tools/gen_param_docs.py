@@ -101,6 +101,16 @@ ENV_VARS = [
      "costs one boolean per check site.  `tools/tpu_window.py` runs "
      "every capture leg with `monitor` on so a TPU-window datapoint "
      "certifies itself."),
+    ("LGBM_TPU_COMPILE_CACHE",
+     "directory for JAX's persistent XLA compilation cache (equivalent "
+     "to the `tpu_compile_cache_dir` parameter; see "
+     "`lightgbm_tpu/utils/compile_cache.py`).  Compiled growers are "
+     "content-addressed and survive process restarts, so steady-state "
+     "reruns skip the multi-second cold compile (`bench.py` records "
+     "`compile_cache_dir`/`compile_cache_warm` in its JSON line so a "
+     "compile_s figure says which kind of compile it measured).  Must "
+     "be set before the first `jit` compilation it should capture; "
+     "enabling is best-effort (a cache failure never aborts training)."),
     ("LGBM_TPU_PEAK_FLOPS",
      "override the profile mode's device peak FLOP/s (used with "
      "`LGBM_TPU_PEAK_BW`) when the built-in per-chip table "
@@ -118,7 +128,8 @@ PROFILER_NOTE = (
     "compiled regions as XLA metadata scopes (`lgbm/hist_onehot`, "
     "`lgbm/hist_scatter`, `lgbm/hist_wave_xla`, `lgbm/pallas_hist`, "
     "`lgbm/pallas_hist_wave`, `lgbm/wave_hist`, `lgbm/wave_split_phase`, "
-    "`lgbm/split_scan`, `lgbm/tree_traverse`, `lgbm/forest_predict`).")
+    "`lgbm/wave_partition`, `lgbm/split_scan`, `lgbm/tree_traverse`, "
+    "`lgbm/forest_predict`).")
 
 
 def main() -> None:

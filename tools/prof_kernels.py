@@ -9,11 +9,16 @@ is filled from, and the first thing to run in a TPU window.
 
 Legs (``PROF_LEGS`` comma-list, default all):
   kernel    — bare ``hist_pallas_wave`` full passes vs the MXU roofline
-  full      — ``build_wave_grow_fn`` as shipped
+  full      — ``build_wave_grow_fn`` as shipped (batched split apply)
+  seqapply  — ``batched_apply=False`` (the per-split partition oracle —
+              full-vs-seqapply is the tentpole's win, measured)
   nokernel  — kernel stubbed to shaped noise (everything-but-kernel)
   nocompact — ``compact=False`` (no tier gathers, full-N kernel per wave)
   gathers   — compaction-primitive microbenches (index build + tier
               gathers, the nocompact-vs-full arbitration)
+  partition — wave-partition microbench: the batched one-pass split
+              apply AND the sequential per-split walk on the same slot
+              tables, each against ``splitter.partition_cost``
 
 Env knobs: ``PROF_ROWS`` (1_000_000), ``PROF_FEATURES`` (28),
 ``PROF_LEAVES`` (255), ``PROF_MAXBIN`` (255), ``PROF_CAPACITY`` (42),
@@ -142,8 +147,63 @@ def leg_kernel(p, results, n_rep: int):
     _report(results, "kernel full pass", dt, flops, nbytes, extra)
 
 
+def leg_partition(p, results, n_rep: int):
+    """Wave-partition leg: the batched one-pass split apply vs the
+    sequential per-split walk, on identical synthetic slot tables, each
+    against ``splitter.partition_cost`` — the measured arbitration of
+    docs/ROOFLINE.md's sequential-vs-one-pass table.  Pure XLA (no
+    Pallas), so it smokes on CPU regardless of PROF_INTERPRET."""
+    from lightgbm_tpu.core.grower import go_left_node
+    from lightgbm_tpu.core.splitter import bitset_words, partition_cost
+    from lightgbm_tpu.core.wave_grower import (WaveSplits,
+                                               build_split_apply_fn)
+    rows, F, B = p["rows"], p["F"], p["B"]
+    meta = p["meta"]
+    Pcap = max(1, min(p["capacity"], pallas_hist.C_MAX // 3))
+    L = 2 * Pcap + 2
+    rng = np.random.default_rng(4)
+    W = bitset_words(B)
+    feats = rng.integers(0, F, Pcap).astype(np.int32)
+    nb = np.asarray(meta.num_bins)
+    ws = WaveSplits(
+        ok=jnp.ones((Pcap,), bool),
+        leaf=jnp.arange(Pcap, dtype=jnp.int32),
+        new=jnp.arange(Pcap, 2 * Pcap, dtype=jnp.int32),
+        feature=jnp.asarray(feats),
+        threshold=jnp.asarray((nb[feats] // 2).astype(np.int32)),
+        default_left=jnp.asarray(rng.random(Pcap) < 0.5),
+        cat_bitset=jnp.zeros((Pcap, W), jnp.uint32))
+    leaf_id0 = jnp.asarray(rng.integers(0, Pcap, rows, dtype=np.int32))
+    bins_rm = jnp.asarray(np.asarray(p["binsT"]).T.copy())
+
+    apply_fn = jax.jit(build_split_apply_fn(meta, L))
+    dt, _ = timeit(apply_fn, leaf_id0, bins_rm, ws, n=n_rep)
+    flops, nbytes = partition_cost(rows, splits=Pcap, batched=True, waves=1)
+    _report(results, "partition one-pass", dt, flops, nbytes,
+            {"splits": Pcap})
+
+    binsT = p["binsT"]
+
+    def seq(leaf_id):
+        def body(i, lid):
+            f = ws.feature[i]
+            col = binsT[f].astype(jnp.int32)
+            go = go_left_node(col, ws.threshold[i], ws.default_left[i],
+                              meta.is_categorical[f], ws.cat_bitset[i],
+                              meta.missing_types[f], meta.num_bins[f],
+                              meta.default_bins[f])
+            return jnp.where((lid == ws.leaf[i]) & ~go, ws.new[i], lid)
+        return jax.lax.fori_loop(0, Pcap, body, leaf_id)
+
+    dt2, _ = timeit(jax.jit(seq), leaf_id0, n=n_rep)
+    flops2, nbytes2 = partition_cost(rows, splits=Pcap, batched=False)
+    _report(results, "partition sequential", dt2, flops2, nbytes2,
+            {"splits": Pcap,
+             "speedup_one_pass": round(dt2 / dt, 2) if dt else None})
+
+
 def leg_grow(p, results, name: str, n_rep: int, compact=True,
-             stub_kernel=False):
+             stub_kernel=False, batched_apply=True):
     """One grower variant, timed end to end per tree."""
     rows, F, B = p["rows"], p["F"], p["B"]
     real = pallas_hist.hist_pallas_wave
@@ -168,7 +228,8 @@ def leg_grow(p, results, name: str, n_rep: int, compact=True,
         grow = jax.jit(wave_grower.build_wave_grow_fn(
             p["meta"], p["scfg"], B, wave_capacity=p["capacity"],
             highest=MODE, gain_gate=0.5, block_rows=p["block_rows"],
-            compact=compact, interpret=INTERP, report_waves=True))
+            compact=compact, interpret=INTERP, report_waves=True,
+            batched_apply=batched_apply))
         t0 = time.time()
         tr, lid, stats = grow(p["binsT"], p["g"], p["h"], p["mask"],
                               p["fmask"])
@@ -226,8 +287,9 @@ def main() -> int:
     max_bin = _env_int("PROF_MAXBIN", 255)
     n_rep = _env_int("PROF_REPEAT", 3)
     legs = [s for s in os.environ.get(
-        "PROF_LEGS", "kernel,full,nokernel,nocompact,gathers").split(",")
-        if s]
+        "PROF_LEGS",
+        "kernel,full,seqapply,nokernel,nocompact,gathers,partition"
+    ).split(",") if s]
     pf, pb = device_peaks()
     print(f"backend: {jax.default_backend()}  interpret: {INTERP}  "
           f"peaks: {pf / 1e12:.1f} TFLOP/s, {pb / 1e9:.0f} GB/s",
@@ -238,12 +300,16 @@ def main() -> int:
         leg_kernel(p, results, n_rep)
     if "full" in legs:
         leg_grow(p, results, "grow full", n_rep)
+    if "seqapply" in legs:
+        leg_grow(p, results, "grow seqapply", n_rep, batched_apply=False)
     if "nokernel" in legs:
         leg_grow(p, results, "grow nokernel", n_rep, stub_kernel=True)
     if "nocompact" in legs:
         leg_grow(p, results, "grow nocompact", n_rep, compact=False)
     if "gathers" in legs:
         leg_gathers(p, results, n_rep)
+    if "partition" in legs:
+        leg_partition(p, results, n_rep)
 
     # the split-scan hypothesis (ROOFLINE.md step 3): expected non-kernel
     # floor from the analytical scan cost alone

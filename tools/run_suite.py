@@ -13,6 +13,11 @@ summary counts, and writes one ``SUITE_r{N}.json`` next to the
 ``--select`` narrows the collection target (a file or node id) — the
 smoke path CI exercises.  Exit code: 0 when every tier passed (an empty
 selection counts as passed and is noted), 1 otherwise.
+
+The quick tier carries the differential-apply smoke
+(``tests/test_wave_apply.py::test_batched_apply_differential_smoke``):
+every quick run re-proves the batched one-pass wave split apply byte-
+identical to the sequential oracle before any perf number is trusted.
 """
 from __future__ import annotations
 

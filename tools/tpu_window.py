@@ -15,7 +15,9 @@ capture checklist with health monitoring enabled:
    roofline fractions + the HBM census;
 3. ``python bench.py`` with ``BENCH_MAXBIN=63`` — the 4x-denser MXU
    packing variant the roofline model predicts wins;
-4. ``tools/prof_kernels.py`` (``PROF_JSON=1``) — the leg decomposition;
+4. ``tools/prof_kernels.py`` (``PROF_JSON=1``) — the leg decomposition,
+   including the wave-partition legs (batched one-pass split apply vs
+   the sequential per-split oracle, against ``partition_cost``);
 5. a ``jax.profiler`` trace capture of a short training run.
 
 Artifacts (``--out``, default repo root):
@@ -59,7 +61,7 @@ _DRY_PROF_ENV = {
     "JAX_PLATFORMS": "cpu",
     "PROF_INTERPRET": "1", "PROF_ROWS": "4096", "PROF_FEATURES": "6",
     "PROF_LEAVES": "7", "PROF_MAXBIN": "63", "PROF_REPEAT": "1",
-    "PROF_LEGS": "kernel,gathers",
+    "PROF_LEGS": "kernel,gathers,partition",
 }
 
 _TRACE_CODE = """
