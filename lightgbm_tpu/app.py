@@ -159,7 +159,9 @@ def run_train(cfg: Config, params: Dict) -> None:
 
 def run_predict(cfg: Config, params: Dict) -> None:
     if not cfg.input_model:
-        log.fatal("task=predict needs input_model")
+        log.fatal("task=predict needs input_model (alias: model_file)")
+    # self-contained: only input_model + data are needed — the model file
+    # carries objective/num_class, no training config required
     bst = Booster(model_file=cfg.input_model)
     # prediction-time knobs (pred_early_stop*) come from the CLI config,
     # not the minimal config parsed from the model
@@ -168,14 +170,57 @@ def run_predict(cfg: Config, params: Dict) -> None:
                          "pred_early_stop_margin": cfg.pred_early_stop_margin})
     X, _, _, _, _ = load_text(cfg.data, cfg)
     num_it = cfg.num_iteration_predict if cfg.num_iteration_predict > 0 else None
-    pred = bst.predict(X, num_iteration=num_it,
-                       raw_score=bool(cfg.predict_raw_score),
-                       pred_leaf=bool(cfg.predict_leaf_index),
-                       pred_contrib=bool(cfg.predict_contrib))
+    from .boosting.gbdt import PredictorBase
+    K = bst.num_model_per_iteration()
+    n_iters = bst.num_trees() // max(K, 1)
+    window = min(num_it, n_iters) if num_it else n_iters
+    # LGBM_TPU_PREDICT_MIN_WORK forces the routing either way (0 = every
+    # predict through the serving session; huge = always the host loop)
+    # — an ops escape hatch that also makes the session branch testable
+    try:
+        min_work = int(os.environ.get("LGBM_TPU_PREDICT_MIN_WORK", "")
+                       or PredictorBase._DEVICE_PREDICT_MIN_WORK)
+    except ValueError:
+        min_work = PredictorBase._DEVICE_PREDICT_MIN_WORK
+    work = X.shape[0] * window * K
+    if cfg.predict_leaf_index or cfg.predict_contrib:
+        pred = bst.predict(X, num_iteration=num_it,
+                           raw_score=bool(cfg.predict_raw_score),
+                           pred_leaf=bool(cfg.predict_leaf_index),
+                           pred_contrib=bool(cfg.predict_contrib))
+    elif work >= min_work:
+        # heavy value predictions route through the serving session: the
+        # model is packed once into the device-resident forest (bin
+        # space rebuilt from the model itself, no training data needed)
+        # and scored in bounded pow2 buckets — the same engine
+        # task=serve runs behind HTTP.  Small inputs keep the host loop
+        # (same dispatch-overhead heuristic Booster.predict applies).
+        from .serve import PredictorSession
+        with PredictorSession(bst, config=bst.config,
+                              num_iteration=num_it) as sess:
+            pred = sess.predict(X, raw_score=bool(cfg.predict_raw_score))
+    else:
+        pred = bst.predict(X, num_iteration=num_it,
+                           raw_score=bool(cfg.predict_raw_score))
     pred = np.atleast_1d(pred)
     fmt = "%d" if pred.dtype.kind in "iu" else "%.18g"
     np.savetxt(cfg.output_result, pred, fmt=fmt, delimiter="\t")
     log.info("Finished prediction; results saved to %s", cfg.output_result)
+
+
+def run_serve(cfg: Config, params: Dict) -> None:
+    """task=serve: pack input_model device-resident and serve it over
+    HTTP (serve/server.py: POST /predict, GET /health) until
+    interrupted."""
+    if not cfg.input_model:
+        log.fatal("task=serve needs input_model (alias: model_file)")
+    from .serve import PredictorSession, PredictServer
+    sess = PredictorSession(cfg.input_model, config=cfg)
+    n = sess.warmup()
+    log.info("serve: warmed %d bucket shapes (max_batch=%d)",
+             n, sess.max_batch)
+    PredictServer(sess, host=cfg.tpu_serve_host,
+                  port=cfg.tpu_serve_port).serve_forever()
 
 
 def run_convert_model(cfg: Config, params: Dict) -> None:
@@ -215,10 +260,12 @@ def main(argv=None) -> None:
         run_train(cfg, params)
     elif task in ("predict", "prediction", "test"):
         run_predict(cfg, params)
+    elif task == "serve":
+        run_serve(cfg, params)
     elif task == "refit":
         run_refit(cfg, params)
     elif task == "convert_model":
         run_convert_model(cfg, params)
     else:
         log.fatal(f"Unknown task {task!r} (supported: train, predict, "
-                  "convert_model, refit)")
+                  "serve, convert_model, refit)")

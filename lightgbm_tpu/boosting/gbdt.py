@@ -81,9 +81,12 @@ class _TreeList(list):
 class PredictorBase:
     """Prediction + forest-introspection surface shared by the trainer
     (``GBDT``) and file-loaded boosters (``io.model_io.LoadedGBDT``).
-    Subclasses provide ``models``/``num_tpi``/``objective``/``config``;
-    the device fast path only engages when ``train_ds`` is present
-    (reference split: GBDT vs Predictor, src/application/predictor.hpp)."""
+    Subclasses provide ``models``/``num_tpi``/``objective``/``config``
+    (reference split: GBDT vs Predictor, src/application/predictor.hpp).
+    The device fast path engages above the work threshold either way:
+    with a live ``train_ds`` it reuses the training bin space; without
+    one it rebuilds a serving bin space from the model's own thresholds
+    (serve/packing.py, shared with ``serve.PredictorSession``)."""
 
     def _iter_window(self, num_iteration: Optional[int],
                      start_iteration: int = 0) -> Tuple[int, int]:
@@ -104,8 +107,8 @@ class PredictorBase:
         K = self.num_tpi
         start, stop = self._iter_window(num_iteration, start_iteration)
         work = X.shape[0] * max(stop - start, 0) * K
-        if (self.train_ds is not None
-                and work >= self._DEVICE_PREDICT_MIN_WORK):
+        if (work >= self._DEVICE_PREDICT_MIN_WORK
+                and self._device_predict_ready(stop - start)):
             return self._predict_raw_device(X, start, stop, early_stop)
         out = np.zeros((X.shape[0], K))
         active = None
@@ -163,11 +166,159 @@ class PredictorBase:
         X = np.ascontiguousarray(X, dtype=np.float64)
         K = self.num_tpi
         start, stop = self._iter_window(num_iteration, start_iteration)
+        work = X.shape[0] * max(stop - start, 0) * K
+        if (work >= self._DEVICE_PREDICT_MIN_WORK
+                and self._device_predict_ready(stop - start)):
+            return self._predict_leaf_device(X, start, stop)
         cols = []
         for it in range(start, stop):
             for k in range(K):
                 cols.append(self.models[it * K + k].predict_leaf(X))
         return np.stack(cols, axis=1) if cols else np.zeros((X.shape[0], 0))
+
+    # ------------------------------------------------------------------
+    # Device prediction plumbing shared by predict_raw / predict_leaf.
+    # With a live train_ds the training bin space is reused; without one
+    # (file-loaded boosters) a serving bin space is rebuilt from the
+    # model's own thresholds (serve/packing.py — the same machinery
+    # serve.PredictorSession packs with).
+    # ------------------------------------------------------------------
+    def _device_predict_ready(self, n_iters: int) -> bool:
+        if n_iters <= 0:
+            return False
+        if self.train_ds is not None:
+            return True
+        return len(self.models) > 0 and self._model_num_features() > 0
+
+    def _model_num_features(self) -> int:
+        return int(getattr(self, "num_features", 0)
+                   or len(getattr(self, "feature_names", []) or []))
+
+    def _model_bin_space(self, start: int, stop: int):
+        """Model-derived serving bin space for the window (cached on the
+        forest version)."""
+        from ..serve.packing import ServeBinSpace
+        key = (start, stop, len(self.models),
+               getattr(self, "_model_version", 0))
+        if getattr(self, "_serve_space_key", None) != key:
+            K = self.num_tpi
+            trees = list(self.models)[start * K:stop * K]
+            self._serve_space = ServeBinSpace(trees,
+                                             self._model_num_features())
+            self._serve_space_key = key
+        return self._serve_space
+
+    def _forest_space(self, start: int, stop: int):
+        """(space_or_None, meta, min_words, sentinel) — the bin space
+        device traversal runs in."""
+        from ..core.splitter import bitset_words
+        if self.train_ds is not None:
+            # unseen/NaN categories bin to one word past the training
+            # bitsets, so every categorical node routes them right
+            return (None, self.meta, bitset_words(self.B) + 1,
+                    bitset_words(self.B) * 32)
+        space = self._model_bin_space(start, stop)
+        return space, space.meta, space.min_words, space.sentinel
+
+    def _forest_device(self, start: int, stop: int):
+        """Stacked device forest for the window (cached on the forest
+        version).  Returns (space_or_None, meta, sentinel)."""
+        space, meta, min_words, sentinel = self._forest_space(start, stop)
+        K = self.num_tpi
+        key = (start, stop, len(self.models),
+               getattr(self, "_model_version", 0))
+        if getattr(self, "_forest_cache_key", None) != key:
+            from ..core.forest import stack_forest
+            arrays_fn = (space.tree_arrays_np if space is not None
+                         else self._tree_arrays_np)
+            trees = [arrays_fn(self.models[it * K + k])
+                     for it in range(start, stop) for k in range(K)]
+            class_ids = np.asarray(
+                [k for _ in range(start, stop) for k in range(K)], np.int32)
+            self._forest_cache = stack_forest(trees, class_ids,
+                                              min_words=min_words)
+            self._forest_cache_key = key
+        return space, meta, sentinel
+
+    def _bin_device_input(self, X: np.ndarray, space, sentinel: int):
+        return (space.bin_matrix(X) if space is not None
+                else self._bin_for_predict(X, sentinel))
+
+    def _predict_raw_device(self, X: np.ndarray, start: int, stop: int,
+                            early_stop: Optional[dict] = None) -> np.ndarray:
+        """Batch the whole forest window onto the device and score every
+        row in one jitted scan (the TPU replacement for the reference's
+        per-row Predictor pipeline, src/application/predictor.hpp:28-271).
+        Works with or without a live train_ds — see _forest_space."""
+        import jax.numpy as jnp
+
+        from ..core.forest import forest_predict_fn
+        K = self.num_tpi
+        space, meta, sentinel = self._forest_device(start, stop)
+        es_key = (id(meta),
+                  None if early_stop is None
+                  else (early_stop["kind"], early_stop["round_period"],
+                        early_stop["margin_threshold"]))
+        if getattr(self, "_forest_fn_key", "unset") != es_key:
+            fn = forest_predict_fn(meta, K, early_stop)
+            if obs.profile_enabled():
+                fn = obs.profile_wrap("lgbm/forest_predict", fn)
+            self._forest_fn = fn
+            self._forest_fn_key = es_key
+            self._forest_fn_meta = meta  # pin: id(meta) key can't recycle
+        from ..utils.timetag import timetag
+        with timetag("predict (bin input)"):
+            vbins = self._bin_device_input(X, space, sentinel)
+        with timetag("predict (forest scan)"):
+            out = self._forest_fn(self._forest_cache, jnp.asarray(vbins))
+            res = np.asarray(out, dtype=np.float64)
+        if obs.profile_enabled():
+            obs.memory_snapshot("predict",
+                                buffers=getattr(self, "_census_buffers",
+                                                dict)())
+        return res
+
+    def _bin_for_predict(self, X: np.ndarray, sentinel: int) -> np.ndarray:
+        """Bin a raw matrix in the training bin space for device traversal.
+        Numerical features use the training mappers verbatim; categorical
+        features use the strict predict mapping (unseen/NaN -> sentinel)."""
+        from ..io.binning import BIN_CATEGORICAL
+        ds = self.train_ds
+        F = ds.num_features
+        out = np.zeros((X.shape[0], F), dtype=np.int32)
+        for inner in range(F):
+            j = int(ds.real_feature_idx[inner])
+            m = ds.bin_mappers[j]
+            col = X[:, j]
+            if m.bin_type == BIN_CATEGORICAL:
+                out[:, inner] = m.value_to_bin_predict(col, sentinel)
+            else:
+                out[:, inner] = m.value_to_bin(col)
+        return out
+
+    def _predict_leaf_device(self, X: np.ndarray, start: int,
+                             stop: int) -> np.ndarray:
+        """Leaf indices for the whole window in one jitted scan over the
+        stacked forest (core/forest.py forest_leaf_fn) — the device path
+        ``predict_leaf``'s per-tree host loop lacked."""
+        import jax.numpy as jnp
+
+        from ..core.forest import forest_leaf_fn
+        space, meta, sentinel = self._forest_device(start, stop)
+        if getattr(self, "_leaf_fn_key", None) != id(meta):
+            fn = forest_leaf_fn(meta)
+            if obs.profile_enabled():
+                fn = obs.profile_wrap("lgbm/forest_leaf", fn)
+            self._leaf_fn = fn
+            self._leaf_fn_key = id(meta)
+            self._leaf_fn_meta = meta   # pin: id(meta) key can't recycle
+        from ..utils.timetag import timetag
+        with timetag("predict (bin input)"):
+            vbins = self._bin_device_input(X, space, sentinel)
+        with timetag("predict (leaf scan)"):
+            out = self._leaf_fn(self._forest_cache, jnp.asarray(vbins))
+            res = np.asarray(out)
+        return np.ascontiguousarray(res.T).astype(np.int64)
 
     @property
     def num_trees(self) -> int:
@@ -1441,68 +1592,6 @@ class GBDT(PredictorBase):
     def _score_for_metrics(self, score):
         s = np.asarray(score, dtype=np.float64)
         return s[:, 0] if self.num_tpi == 1 else s
-
-    # ------------------------------------------------------------------
-    def _predict_raw_device(self, X: np.ndarray, start: int, stop: int,
-                            early_stop: Optional[dict] = None) -> np.ndarray:
-        """Batch the whole forest window onto the device and score every
-        row in one jitted scan (the TPU replacement for the reference's
-        per-row Predictor pipeline, src/application/predictor.hpp:28-271)."""
-        import jax.numpy as jnp
-        from ..core.forest import forest_predict_fn, stack_forest
-        from ..core.splitter import bitset_words
-        K = self.num_tpi
-        # unseen/NaN categories bin to one word past the training bitsets,
-        # so every categorical node routes them right (host parity)
-        sentinel = bitset_words(self.B) * 32
-        key = (start, stop, len(self.models), self._model_version)
-        if getattr(self, "_forest_cache_key", None) != key:
-            trees = [self._tree_arrays_np(self.models[it * K + k])
-                     for it in range(start, stop) for k in range(K)]
-            class_ids = np.asarray(
-                [k for _ in range(start, stop) for k in range(K)], np.int32)
-            self._forest_cache = stack_forest(
-                trees, class_ids, min_words=bitset_words(self.B) + 1)
-            self._forest_cache_key = key
-        es_key = (None if early_stop is None
-                  else (early_stop["kind"], early_stop["round_period"],
-                        early_stop["margin_threshold"]))
-        if getattr(self, "_forest_fn_key", "unset") != es_key:
-            fn = forest_predict_fn(self.meta, K, early_stop)
-            if obs.profile_enabled():
-                fn = obs.profile_wrap("lgbm/forest_predict", fn)
-            self._forest_fn = fn
-            self._forest_fn_key = es_key
-        from ..utils.timetag import timetag
-        with timetag("predict (bin input)"):
-            vbins = self._bin_for_predict(X, sentinel)
-        with timetag("predict (forest scan)"):
-            out = self._forest_fn(self._forest_cache, jnp.asarray(vbins))
-            res = np.asarray(out, dtype=np.float64)
-        if obs.profile_enabled():
-            obs.memory_snapshot("predict", buffers=self._census_buffers())
-        return res
-
-    def _bin_for_predict(self, X: np.ndarray, sentinel: int) -> np.ndarray:
-        """Bin a raw matrix in the training bin space for device traversal.
-        Numerical features use the training mappers verbatim; categorical
-        features use the strict predict mapping (unseen/NaN -> sentinel)."""
-        from ..io.binning import BIN_CATEGORICAL
-        ds = self.train_ds
-        F = ds.num_features
-        out = np.zeros((X.shape[0], F), dtype=np.int32)
-        for inner in range(F):
-            j = int(ds.real_feature_idx[inner])
-            m = ds.bin_mappers[j]
-            col = X[:, j]
-            if m.bin_type == BIN_CATEGORICAL:
-                out[:, inner] = m.value_to_bin_predict(col, sentinel)
-            else:
-                out[:, inner] = m.value_to_bin(col)
-        return out
-
-
-
 
 def _constant_tree(output: float) -> Tree:
     t = Tree(

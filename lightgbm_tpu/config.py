@@ -76,6 +76,7 @@ _ALIASES: Dict[str, str] = {
     "model_output": "output_model", "model_out": "output_model",
     "save_period": "snapshot_freq",
     "model_input": "input_model", "model_in": "input_model",
+    "model_file": "input_model",
     "predict_result": "output_result", "prediction_result": "output_result",
     "predict_name": "output_result", "prediction_name": "output_result",
     "pred_name": "output_result", "name_pred": "output_result",
@@ -347,6 +348,26 @@ class Config:
                                         # pipelining — attribution runs
                                         # only, never benchmarks
 
+    # ---- Serving (serve/ subsystem) ----
+    tpu_serve_max_batch: int = 1024     # row cap per coalesced device
+                                        # batch; requests pad to power-of-
+                                        # two buckets, so the jitted
+                                        # predictor compiles at most
+                                        # ceil(log2(max_batch))+1 shapes
+                                        # (LGBM_TPU_SERVE_MAX_BATCH env)
+    tpu_serve_max_wait_ms: float = 2.0  # longest the microbatcher holds
+                                        # the oldest queued request while
+                                        # coalescing — the latency knob
+                                        # (LGBM_TPU_SERVE_MAX_WAIT_MS env)
+    tpu_serve_queue_depth: int = 8192   # queued-ROW bound: a full queue
+                                        # rejects submits with an explicit
+                                        # overload error (backpressure,
+                                        # never OOM)
+                                        # (LGBM_TPU_SERVE_QUEUE_DEPTH env)
+    tpu_serve_host: str = "127.0.0.1"   # bind address for task=serve
+    tpu_serve_port: int = 0             # task=serve HTTP port (0 = pick
+                                        # an ephemeral port and log it)
+
     # ---- derived (not user-settable) ----
     is_parallel: bool = dataclasses.field(default=False, repr=False)
 
@@ -450,6 +471,15 @@ class Config:
         self.tpu_health = parse_mode(self.tpu_health, fatal=True)
         if self.tpu_fingerprint_freq < 0:
             log.fatal("tpu_fingerprint_freq should be >= 0")
+        if self.tpu_serve_max_batch < 1:
+            log.fatal("tpu_serve_max_batch should be >= 1")
+        if self.tpu_serve_max_wait_ms < 0:
+            log.fatal("tpu_serve_max_wait_ms should be >= 0")
+        if self.tpu_serve_queue_depth < self.tpu_serve_max_batch:
+            log.fatal("tpu_serve_queue_depth should be >= "
+                      "tpu_serve_max_batch")
+        if not (0 <= self.tpu_serve_port <= 65535):
+            log.fatal("tpu_serve_port should be in [0, 65535]")
 
     # ------------------------------------------------------------------
     def num_model_per_iteration(self) -> int:

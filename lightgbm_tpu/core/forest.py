@@ -88,10 +88,11 @@ def forest_predict_fn(meta: DeviceMeta, K: int, early_stop: Optional[dict] = Non
     def predict(forest: ForestArrays, bins):
         N = bins.shape[0]
         score0 = jnp.zeros((N, K), jnp.float32)
+        comp0 = jnp.zeros((N, K), jnp.float32)
         active0 = jnp.ones((N,), bool)
 
         def body(carry, tree):
-            score, active, t = carry
+            score, comp, active, t = carry
             (sf, tb, dl, lc, rc, lv, nl, cb, k) = tree
             arrs = TreeArrays(
                 split_feature=sf, threshold_bin=tb, default_left=dl,
@@ -102,7 +103,14 @@ def forest_predict_fn(meta: DeviceMeta, K: int, early_stop: Optional[dict] = Non
                 num_leaves=nl, cat_bitset=cb)
             leaf = predict_leaf_bins(arrs, bins, meta)
             add = jnp.where(active, lv[leaf], 0.0)
-            score = score.at[:, k].add(add)
+            # Kahan-compensated f32 accumulation: the host oracle sums in
+            # f64, and serving parity (serve/session.py, atol 1e-6) needs
+            # the sum error bounded by ~1 ulp of the result instead of
+            # growing with the tree count
+            y = add - comp[:, k]
+            t_sum = score[:, k] + y
+            comp = comp.at[:, k].set((t_sum - score[:, k]) - y)
+            score = score.at[:, k].set(t_sum)
             if early_stop is not None:
                 period = int(early_stop.get("round_period", 0)) or 1
                 thr = jnp.float32(early_stop["margin_threshold"])
@@ -113,10 +121,40 @@ def forest_predict_fn(meta: DeviceMeta, K: int, early_stop: Optional[dict] = Non
                     top2 = jax.lax.top_k(score, 2)[0]
                     margin = top2[:, 0] - top2[:, 1]
                 active = jnp.where(check, active & (margin < thr), active)
-            return (score, active, t + 1), None
+            return (score, comp, active, t + 1), None
 
-        (score, _, _), _ = jax.lax.scan(
-            body, (score0, active0, jnp.int32(0)), forest)
+        (score, _, _, _), _ = jax.lax.scan(
+            body, (score0, comp0, active0, jnp.int32(0)), forest)
         return score
 
     return jax.jit(predict)
+
+
+def forest_leaf_fn(meta: DeviceMeta):
+    """Build ``leaves(forest, bins) -> [T, N] i32`` — the device analog
+    of per-tree ``Tree.predict_leaf`` (reference: Predictor's leaf-index
+    mode, src/application/predictor.hpp:110-125).  One scan over the
+    stacked forest emits every tree's leaf index for every row; callers
+    transpose to the ``[N, T]`` layout ``predict_leaf`` returns."""
+    import jax
+    import jax.numpy as jnp
+
+    from .predict import predict_leaf_bins
+
+    @jax.named_scope("lgbm/forest_leaf")
+    def leaves(forest: ForestArrays, bins):
+        def body(carry, tree):
+            (sf, tb, dl, lc, rc, lv, nl, cb, _k) = tree
+            arrs = TreeArrays(
+                split_feature=sf, threshold_bin=tb, default_left=dl,
+                left_child=lc, right_child=rc,
+                split_gain=None, internal_value=None, internal_count=None,
+                internal_weight=None,
+                leaf_value=lv, leaf_count=None, leaf_weight=None,
+                num_leaves=nl, cat_bitset=cb)
+            return carry, predict_leaf_bins(arrs, bins, meta)
+
+        _, out = jax.lax.scan(body, jnp.int32(0), forest)
+        return out
+
+    return jax.jit(leaves)

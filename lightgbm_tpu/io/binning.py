@@ -463,6 +463,42 @@ class BinMapper:
         }
 
     @classmethod
+    def from_thresholds(cls, thresholds, missing_type: int = MISSING_NONE
+                        ) -> "BinMapper":
+        """Serving-side numerical mapper built from a forest's split
+        thresholds instead of a data sample (serve/packing.py).
+
+        Traversal only needs every node DECISION reproduced, not the
+        training quantization: with the sorted distinct thresholds as bin
+        upper bounds, ``value_to_bin(v) <= value_to_bin(thr)`` holds
+        exactly when ``v <= thr`` for every threshold in the set, so
+        bin-space compares equal the host's value-space compares.  Under
+        MISSING_ZERO the zero value gets its own bin (bounds at
+        +-kZeroThreshold, reference: meta.h:53) so only "zero" rows take
+        the default-left route; under MISSING_NAN the trailing NaN bin is
+        appended like ``find_bin``'s."""
+        m = cls()
+        vals = np.unique(np.asarray(thresholds, dtype=np.float64))
+        vals = vals[np.isfinite(vals)]
+        if missing_type == MISSING_ZERO:
+            vals = np.unique(np.concatenate(
+                [vals, [-K_ZERO_THRESHOLD, K_ZERO_THRESHOLD]]))
+        bounds = list(vals) + [math.inf]
+        if missing_type == MISSING_NAN:
+            bounds.append(math.nan)
+        m.bin_upper_bound = np.asarray(bounds, dtype=np.float64)
+        m.num_bin = len(bounds)
+        m.missing_type = int(missing_type)
+        m.bin_type = BIN_NUMERICAL
+        m.is_trivial = False
+        if len(vals):
+            m.min_val, m.max_val = float(vals[0]), float(vals[-1])
+        m.default_bin = int(m.value_to_bin(0.0))
+        m.most_freq_bin = m.default_bin
+        m.sparse_rate = 0.0
+        return m
+
+    @classmethod
     def from_dict(cls, d: dict) -> "BinMapper":
         m = cls()
         m.num_bin = int(d["num_bin"])

@@ -184,18 +184,24 @@ class LoadedGBDT(PredictorBase):
     """Prediction-only booster built from a model file (the reference
     reconstructs a full GBDT; prediction needs only the trees + objective).
     The whole prediction surface is inherited from ``PredictorBase`` —
-    with ``train_ds = None`` the device fast path is skipped and trees are
-    walked in value space on the host."""
+    with ``train_ds = None`` small inputs walk the trees in value space
+    on the host, and above the work threshold the device path rebuilds a
+    serving bin space from the model itself (serve/packing.py), so
+    ``Booster(model_file=...)`` predictions hit the TPU too."""
 
     def __init__(self, models: List[Tree], num_tpi: int, objective,
                  feature_names: List[str], feature_infos: List[str],
-                 average_output: bool):
+                 average_output: bool, max_feature_idx: int = -1):
         self.models = models
         self.num_tpi = num_tpi
         self.objective = objective
         self.feature_names = feature_names
         self.feature_infos = feature_infos
         self.average_output = average_output
+        # the declared feature-space width (model header); serving uses
+        # it to size the rebuilt bin space when names are absent
+        self.num_features = (max_feature_idx + 1 if max_feature_idx >= 0
+                             else len(feature_names))
         self.train_ds = None
         self.config = None
         self.metrics = []
@@ -274,11 +280,22 @@ def load_model_string(model_str: str):
     num_tpi = int(header.get("num_tree_per_iteration", "1"))
     feature_names = header.get("feature_names", "").split()
     feature_infos = header.get("feature_infos", "").split()
+    try:
+        max_feature_idx = int(header.get("max_feature_idx", "-1"))
+    except ValueError:
+        max_feature_idx = -1
     gbdt = LoadedGBDT(models, num_tpi, objective, feature_names,
-                      feature_infos, average_output)
+                      feature_infos, average_output,
+                      max_feature_idx=max_feature_idx)
     gbdt.pandas_categorical = pandas_categorical
-    config = Config.from_params({"objective": obj_str.split()[0]}
-                                if obj_str and obj_str != "custom" else {})
+    cfg_params: Dict[str, object] = {}
+    if obj_str and obj_str != "custom":
+        cfg_params["objective"] = obj_str.split()[0]
+        if num_class > 1:
+            # the minimal config must carry num_class or multiclass
+            # objectives fail Config's consistency check on load
+            cfg_params["num_class"] = num_class
+    config = Config.from_params(cfg_params)
     return gbdt, config
 
 

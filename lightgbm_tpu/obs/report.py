@@ -8,6 +8,7 @@ from __future__ import annotations
 
 import glob
 import json
+import math
 import os
 import re
 from collections import defaultdict
@@ -141,6 +142,9 @@ def summarize(events: List[dict]) -> dict:
     health = health_summary(events)
     if health:
         out["health"] = health
+    serve = serve_summary(events)
+    if serve:
+        out["serve"] = serve
     return out
 
 
@@ -256,6 +260,56 @@ def health_summary(events: List[dict]) -> dict:
     return out
 
 
+def percentile(sorted_vals: List[float], p: float):
+    """Nearest-rank percentile (rank ceil(p*n), 1-indexed) over a
+    pre-sorted list (stdlib only).  THE latency-percentile definition
+    for the serving stack: the digest here, the session's ``stats()``
+    /health endpoint, and the serve bench all share it so p50/p99 can
+    never silently diverge."""
+    if not sorted_vals:
+        return None
+    n = len(sorted_vals)
+    i = min(max(math.ceil(p * n) - 1, 0), n - 1)
+    return round(sorted_vals[i], 3)
+
+
+def serve_summary(events: List[dict]) -> dict:
+    """Fold ``serve_*`` events (serve/session.py) into the serving
+    digest: request latency percentiles, batch occupancy / pad waste,
+    overloads, deadline misses, and whether the session degraded to the
+    host predictor.  Empty when the run served nothing."""
+    reqs = [e for e in events if e.get("event") == "serve_request"]
+    batches = [e for e in events if e.get("event") == "serve_batch"]
+    overloads = sum(1 for e in events if e.get("event") == "serve_overload")
+    degraded = [e for e in events if e.get("event") == "serve_degraded"]
+    if not (reqs or batches):
+        return {}
+    lat = sorted(float(e.get("total_ms", 0.0) or 0.0)
+                 for e in reqs if e.get("ok", True))
+    rows = sum(int(e.get("rows", 0) or 0) for e in batches)
+    padded = sum(int(e.get("padded", 0) or 0) for e in batches)
+    out = {
+        "requests": len(reqs),
+        "ok": sum(1 for e in reqs if e.get("ok", True)),
+        "deadline_missed": sum(1 for e in reqs
+                               if e.get("reason") == "deadline"),
+        "overloads": overloads,
+        "batches": len(batches),
+        "rows": rows,
+        "padded_rows": padded,
+        "occupancy": round(rows / padded, 4) if padded else None,
+        "pad_waste_rows": max(padded - rows, 0),
+        "p50_ms": percentile(lat, 0.50),
+        "p99_ms": percentile(lat, 0.99),
+        "max_queue_rows": max((int(e.get("queue_rows", 0) or 0)
+                               for e in batches), default=0),
+        "degraded": bool(degraded),
+    }
+    if degraded:
+        out["degraded_error"] = degraded[0].get("error")
+    return out
+
+
 # ---------------------------------------------------------------------------
 # Event schemas — the CI smoke validates profile-mode streams against these
 # ---------------------------------------------------------------------------
@@ -306,6 +360,28 @@ EVENT_SCHEMAS = {
         "ranks": (int, True),
         "digests": (list, True),
         "spread": (list, False),
+    },
+    # serving engine (serve/session.py)
+    "serve_request": {
+        "rows": (int, True),
+        "total_ms": (_NUM, True),
+        "ok": (bool, True),
+        "reason": (str, False),
+    },
+    "serve_batch": {
+        "rows": (int, True),
+        "padded": (int, True),
+        "requests": (int, True),
+        "queue_rows": (int, True),
+        "exec_ms": (_NUM, True),
+        "degraded": (bool, True),
+    },
+    "serve_degraded": {
+        "error": (str, True),
+    },
+    "serve_overload": {
+        "rows": (int, True),
+        "queue_rows": (int, True),
     },
 }
 
@@ -419,6 +495,21 @@ def render(digest: dict) -> str:
             lf = h["last_fingerprint"]
             out.append(f"  last fingerprint: iteration "
                        f"{lf.get('iteration')} digest {lf.get('digest')}")
+    if digest.get("serve"):
+        s = digest["serve"]
+        out.append("")
+        verdict = "DEGRADED (host fallback)" if s.get("degraded") else "ok"
+        out.append(f"serving: {verdict} — {s['requests']} request(s), "
+                   f"{s['batches']} batch(es), "
+                   f"p50 {s.get('p50_ms')}ms p99 {s.get('p99_ms')}ms")
+        if s.get("padded_rows"):
+            out.append(f"  batch occupancy {s.get('occupancy'):.1%} "
+                       f"({s['rows']:,} rows / {s['padded_rows']:,} padded, "
+                       f"{s['pad_waste_rows']:,} pad-waste rows), "
+                       f"queue peak {s.get('max_queue_rows', 0)} rows")
+        if s.get("overloads") or s.get("deadline_missed"):
+            out.append(f"  overloads {s.get('overloads', 0)}, deadline "
+                       f"misses {s.get('deadline_missed', 0)}")
     if digest["counters"]:
         out.append("")
         out.append("counters:")

@@ -1,0 +1,22 @@
+"""TPU-resident serving subsystem.
+
+The reference ships inference as a dedicated ``Predictor`` pipeline
+decoupled from the trainer (reference: src/application/predictor.hpp,
+src/boosting/prediction_early_stop.cpp); this package is the TPU-native
+equivalent: a model (trained in-process or loaded from a file) is packed
+once into device-resident bin-space arrays and served through a dynamic
+microbatcher behind a threaded HTTP front end.
+
+- ``packing``  — model-derived bin space + stacked forest (no train_ds)
+- ``session``  — ``PredictorSession``: sync ``predict`` + async
+  ``submit``/``result`` over the microbatcher
+- ``batcher``  — request coalescing, power-of-two padding, backpressure
+- ``server``   — JSON-over-HTTP front end with deadlines + /health
+"""
+from .batcher import DeadlineExceeded, MicroBatcher, ServeOverloadError
+from .packing import ServeBinSpace
+from .server import PredictServer
+from .session import PredictorSession
+
+__all__ = ["DeadlineExceeded", "MicroBatcher", "PredictServer",
+           "PredictorSession", "ServeBinSpace", "ServeOverloadError"]

@@ -1,0 +1,147 @@
+"""Dynamic request microbatching for the serving engine.
+
+Requests queue as they arrive and are coalesced into one device batch
+when either the row cap (``tpu_serve_max_batch``) fills or the OLDEST
+queued request has waited ``tpu_serve_max_wait_ms`` — latency is bounded
+by the wait knob, throughput by the cap.  The queue itself is bounded in
+ROWS (``tpu_serve_queue_depth``): when full, ``submit`` raises
+``ServeOverloadError`` immediately — explicit backpressure the caller
+can act on (shed load, retry elsewhere) instead of unbounded memory
+growth and an eventual OOM.
+
+The batcher owns only the queueing policy; padding to power-of-two row
+buckets and the actual device dispatch live in the session's execute
+callback (serve/session.py), which also decides host-fallback
+degradation.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from typing import List, Optional
+
+
+class ServeOverloadError(RuntimeError):
+    """The bounded request queue is full — backpressure, not OOM."""
+
+
+class DeadlineExceeded(RuntimeError):
+    """The request outlived its deadline before results were ready."""
+
+
+class Request:
+    """One queued prediction request: binned rows for the device path,
+    the raw rows kept alongside for host-fallback degradation.
+    Request-level accounting lives in the session's ``result()`` (one
+    count per ticket); this carries only the batching state."""
+
+    __slots__ = ("bins", "raw", "n", "future", "deadline", "t_submit")
+
+    def __init__(self, bins, raw, deadline: Optional[float] = None):
+        self.bins = bins
+        self.raw = raw
+        self.n = int(bins.shape[0])
+        self.future: Future = Future()
+        self.deadline = deadline        # absolute time.monotonic() or None
+        self.t_submit = time.monotonic()
+
+
+class MicroBatcher:
+    """Coalesce queued requests into batches of <= ``max_batch`` rows on
+    a single worker thread; dispatch order is arrival order (whole
+    requests only — a request is never split across batches)."""
+
+    def __init__(self, execute, max_batch: int, max_wait_s: float,
+                 max_queue_rows: int, name: str = "lgbm-serve-batcher"):
+        self._execute = execute
+        self.max_batch = max(int(max_batch), 1)
+        self.max_wait_s = max(float(max_wait_s), 0.0)
+        self.max_queue_rows = max(int(max_queue_rows), self.max_batch)
+        self._q: deque = deque()
+        self._rows = 0
+        self._closed = False
+        self._cv = threading.Condition()
+        self._thread = threading.Thread(target=self._loop, name=name,
+                                        daemon=True)
+        self._thread.start()
+
+    @property
+    def queue_rows(self) -> int:
+        with self._cv:
+            return self._rows
+
+    def submit(self, req: Request) -> Future:
+        with self._cv:
+            if self._closed:
+                raise RuntimeError("batcher is closed")
+            if self._rows + req.n > self.max_queue_rows:
+                raise ServeOverloadError(
+                    f"serve queue full ({self._rows} rows queued, "
+                    f"cap {self.max_queue_rows})")
+            self._q.append(req)
+            self._rows += req.n
+            self._cv.notify_all()
+        return req.future
+
+    # ------------------------------------------------------------------
+    def _take_batch(self) -> Optional[List[Request]]:
+        """Block until a batch is ready; None once closed AND drained."""
+        with self._cv:
+            while not self._q and not self._closed:
+                self._cv.wait()
+            if not self._q:
+                return None
+            # linger until the cap fills or the oldest request's wait
+            # budget runs out; close drains immediately
+            deadline = self._q[0].t_submit + self.max_wait_s
+            while self._rows < self.max_batch and not self._closed:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._cv.wait(remaining)
+            batch: List[Request] = []
+            total = 0
+            while self._q and (not batch
+                               or total + self._q[0].n <= self.max_batch):
+                r = self._q.popleft()
+                batch.append(r)
+                total += r.n
+            self._rows -= total
+            return batch
+
+    def _loop(self) -> None:
+        while True:
+            batch = self._take_batch()
+            if batch is None:
+                return
+            try:
+                self._execute(batch)
+            except BaseException as exc:  # noqa: BLE001 — worker must live
+                for r in batch:
+                    if not r.future.done():
+                        try:
+                            r.future.set_exception(exc)
+                        except BaseException:  # noqa: BLE001 cancel race
+                            pass
+
+    # ------------------------------------------------------------------
+    def close(self, timeout: float = 10.0) -> None:
+        """Stop accepting work, drain the queue, join the worker.  Any
+        request the worker could not drain fails loudly rather than
+        hanging its caller."""
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        self._thread.join(timeout)
+        with self._cv:
+            leftovers = list(self._q)
+            self._q.clear()
+            self._rows = 0
+        for r in leftovers:
+            if not r.future.done():
+                try:
+                    r.future.set_exception(RuntimeError("batcher closed"))
+                except BaseException:  # noqa: BLE001 — cancel race
+                    pass

@@ -1,0 +1,164 @@
+"""Model-derived bin space: device prediction without training state.
+
+The fast device predictor (core/forest.py) traverses trees in BIN space,
+which training gets for free from the dataset's ``BinMapper``s.  A model
+loaded from disk carries no mappers — only value-space thresholds and
+category bitsets — so serving rebuilds a bin space from the forest
+itself:
+
+- **numerical** features: the sorted distinct thresholds the window's
+  trees split on become the bin upper bounds
+  (``io.binning.BinMapper.from_thresholds``).  A node with threshold
+  ``thr`` gets ``threshold_bin = value_to_bin(thr)`` and the bin-space
+  compare ``col <= threshold_bin`` is exactly the host's ``v <= thr`` —
+  the serving bins quantize the DECISIONS, not the data, so parity is
+  structural, not approximate.
+- **categorical** features: the category value itself is the bin, so the
+  model's value-space bitsets (``Tree.cat_threshold``) are already
+  bin-space bitsets.  NaN / negative / out-of-range categories map to a
+  sentinel bin whose bitset word is zero-padded, routing right exactly
+  like the reference's CategoricalDecision (tree.h:262-303).
+
+This is shared by ``serve.session.PredictorSession`` (the serving
+engine) and ``boosting.gbdt.PredictorBase`` (the device fast path for
+``Booster(model_file=...)``).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..core.meta import DeviceMeta
+from ..io.binning import MISSING_NONE, BinMapper
+
+
+class ServeBinSpace:
+    """Per-feature value->bin mapping + ``DeviceMeta`` rebuilt from the
+    forest's own split state (no dataset required)."""
+
+    def __init__(self, models, num_features: int):
+        F = max(int(num_features), 1)
+        self.num_features = F
+        thr_vals: List[List[float]] = [[] for _ in range(F)]
+        miss = np.zeros(F, np.int32)
+        is_cat = np.zeros(F, bool)
+        words = 0
+        for tree in models:
+            nn = max(tree.num_leaves - 1, 0)
+            for i in range(nn):
+                f = int(tree.split_feature[i])
+                if f < 0 or f >= F:
+                    raise ValueError(
+                        f"model splits on feature {f} outside the declared "
+                        f"feature space [0, {F})")
+                if tree.is_categorical(i):
+                    is_cat[f] = True
+                    ci = int(tree.threshold[i])
+                    words = max(words, int(tree.cat_boundaries[ci + 1])
+                                - int(tree.cat_boundaries[ci]))
+                else:
+                    thr_vals[f].append(float(tree.threshold[i]))
+                    miss[f] = max(miss[f], tree.missing_type(i))
+
+        # one zero word past the widest node bitset: the sentinel bin's
+        # word gathers 0, so unseen/NaN categories route right everywhere
+        self.cat_words = max(words, 1)
+        self.min_words = self.cat_words + 1
+        self.sentinel = self.cat_words * 32
+
+        self.mappers: List[Optional[BinMapper]] = [None] * F
+        num_bins = np.ones(F, np.int32)
+        default_bins = np.zeros(F, np.int32)
+        for f in range(F):
+            if is_cat[f]:
+                num_bins[f] = self.sentinel + 1
+            elif thr_vals[f]:
+                m = BinMapper.from_thresholds(thr_vals[f], int(miss[f]))
+                self.mappers[f] = m
+                num_bins[f] = m.num_bin
+                default_bins[f] = m.default_bin
+        self._num_bins = num_bins
+        self._default_bins = default_bins
+        self._missing = miss
+        self._is_cat = is_cat
+
+        import jax.numpy as jnp
+        self.meta = DeviceMeta(
+            num_bins=jnp.asarray(num_bins),
+            default_bins=jnp.asarray(default_bins),
+            missing_types=jnp.asarray(miss),
+            monotone=jnp.asarray(np.zeros(F, np.int32)),
+            penalties=jnp.asarray(np.ones(F, np.float32)),
+            is_categorical=jnp.asarray(is_cat),
+            feat2phys=jnp.asarray(np.arange(F, dtype=np.int32)),
+            feat_offset=jnp.asarray(np.zeros(F, np.int32)),
+            needs_fix=jnp.asarray(np.zeros(F, bool)),
+        )
+
+    # ------------------------------------------------------------------
+    def bin_matrix(self, X: np.ndarray) -> np.ndarray:
+        """Bin raw float rows into this serving space: [N, F] i32.
+
+        Features no tree splits on are never read by the traversal, so
+        their columns stay zero — binning cost scales with the USED
+        feature set, not the input width."""
+        X = np.ascontiguousarray(X, dtype=np.float64)
+        if X.ndim != 2 or X.shape[1] < self.num_features:
+            raise ValueError(
+                f"serve input has {X.shape[-1] if X.ndim else 0} features, "
+                f"model needs {self.num_features}")
+        out = np.zeros((X.shape[0], self.num_features), dtype=np.int32)
+        for f in range(self.num_features):
+            if self._is_cat[f]:
+                col = X[:, f]
+                # the reference casts to int and sends NaN/negatives right
+                # before missing handling (tree.h:262-265); out-of-range
+                # categories can't be in any node bitset either, so both
+                # collapse to the zero-word sentinel
+                v = np.where(np.isnan(col) | (col < 0), -1.0, col)
+                iv = v.astype(np.int64)
+                out[:, f] = np.where((iv < 0) | (iv >= self.sentinel),
+                                     self.sentinel, iv).astype(np.int32)
+            elif self.mappers[f] is not None:
+                out[:, f] = self.mappers[f].value_to_bin(X[:, f])
+        return out
+
+    # ------------------------------------------------------------------
+    def tree_arrays_np(self, tree) -> dict:
+        """Bin-space numpy arrays for one value-space host ``Tree`` — the
+        unit ``core.forest.stack_forest`` batches (the serving analog of
+        ``GBDT._tree_arrays_np``, which needs a live train_ds)."""
+        nl = tree.num_leaves
+        nn = max(nl - 1, 0)
+        sf = np.asarray(tree.split_feature[:nn], np.int32)
+        thr_bin = np.zeros(nn, np.int32)
+        dl = np.zeros(nn, bool)
+        cat_bits = np.zeros((max(nn, 1), self.cat_words), np.uint32)
+        for i in range(nn):
+            if tree.is_categorical(i):
+                ci = int(tree.threshold[i])
+                lo = int(tree.cat_boundaries[ci])
+                hi = int(tree.cat_boundaries[ci + 1])
+                cat_bits[i, :hi - lo] = tree.cat_threshold[lo:hi]
+            else:
+                m = self.mappers[int(sf[i])]
+                thr_bin[i] = int(m.value_to_bin(float(tree.threshold[i])))
+                dl[i] = tree.default_left(i)
+        return dict(
+            split_feature=sf,
+            threshold_bin=thr_bin,
+            default_left=dl,
+            left_child=np.asarray(tree.left_child[:nn], np.int32),
+            right_child=np.asarray(tree.right_child[:nn], np.int32),
+            leaf_value=np.asarray(tree.leaf_value[:nl], np.float32),
+            num_leaves=np.int32(nl),
+            cat_bitset=cat_bits[:nn] if nn else cat_bits[:0],
+        )
+
+    def pack(self, trees, class_ids: np.ndarray):
+        """Stack a tree window into one device-ready ``ForestArrays``."""
+        from ..core.forest import stack_forest
+        return stack_forest([self.tree_arrays_np(t) for t in trees],
+                            np.asarray(class_ids, np.int32),
+                            min_words=self.min_words)

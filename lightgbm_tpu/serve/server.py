@@ -1,0 +1,163 @@
+"""Threaded JSON-over-HTTP front end for a ``PredictorSession``.
+
+Stdlib ``http.server`` only — no new dependencies.  Protocol:
+
+    POST /predict   body {"rows": [[...], ...], "raw_score": false,
+                          "deadline_ms": 250}
+                 -> 200 {"predictions": [...], "rows": N,
+                         "latency_ms": ...}
+    GET  /health -> 200 {"status": "ok"|"degraded", ...session stats...}
+
+Error mapping (all JSON bodies with an ``error`` field):
+
+- 400 malformed body / wrong feature count
+- 503 queue full (``ServeOverloadError`` — explicit backpressure; shed
+  or retry elsewhere, the server never buffers unboundedly)
+- 504 deadline exceeded in queue, or the reply wait timed out
+- 500 anything else
+
+When the device backend dies mid-flight the SESSION degrades to the
+host numpy predictor (serve/session.py) — requests keep succeeding and
+``/health`` flips to ``"degraded"`` so a load balancer can drain the
+replica gracefully instead of seeing a wall of 500s.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from concurrent.futures import TimeoutError as _FutureTimeout
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from .. import obs
+from ..utils import log
+from .batcher import DeadlineExceeded, ServeOverloadError
+
+# grace added to a request's own deadline before the HTTP thread gives
+# up waiting on the batcher (the batch may be mid-flight on the device)
+_REPLY_GRACE_S = 30.0
+_DEFAULT_REPLY_TIMEOUT_S = 120.0
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    # stay quiet on stderr; the obs serve_* event stream is the record
+    def log_message(self, fmt, *args):  # noqa: A002
+        pass
+
+    def _reply(self, code: int, payload: dict) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802 — http.server API
+        if self.path.split("?")[0].rstrip("/") in ("", "/health"):
+            sess = self.server.session
+            st = sess.stats()
+            st["status"] = "degraded" if st.get("degraded") else "ok"
+            st["health_mode"] = obs.health_mode() or "off"
+            self._reply(200, st)
+        else:
+            self._reply(404, {"error": "not_found", "path": self.path})
+
+    def do_POST(self):  # noqa: N802 — http.server API
+        if self.path.split("?")[0].rstrip("/") != "/predict":
+            self._reply(404, {"error": "not_found", "path": self.path})
+            return
+        sess = self.server.session
+        t0 = time.perf_counter()
+        try:
+            length = int(self.headers.get("Content-Length", 0) or 0)
+            payload = json.loads(self.rfile.read(length) or b"{}")
+            rows = payload.get("rows")
+            if rows is None:
+                raise ValueError("body needs a 'rows' matrix")
+            X = np.asarray(rows, dtype=np.float64)
+            deadline_ms = payload.get("deadline_ms")
+            ticket = sess.submit(X, deadline_ms=deadline_ms,
+                                 raw_score=bool(payload.get("raw_score")))
+            wait_s = (float(deadline_ms) / 1e3 + _REPLY_GRACE_S
+                      if deadline_ms is not None
+                      else _DEFAULT_REPLY_TIMEOUT_S)
+            pred = sess.result(ticket, timeout=wait_s)
+            self._reply(200, {
+                "predictions": np.asarray(pred).tolist(),
+                "rows": int(ticket.rows),
+                "latency_ms": round((time.perf_counter() - t0) * 1e3, 3),
+            })
+        except ServeOverloadError as exc:
+            self._reply(503, {"error": "overloaded", "detail": str(exc)})
+        except (DeadlineExceeded, _FutureTimeout) as exc:
+            self._reply(504, {"error": "deadline_exceeded",
+                              "detail": str(exc)})
+        except (ValueError, TypeError, KeyError) as exc:
+            self._reply(400, {"error": "bad_request", "detail": str(exc)})
+        except Exception as exc:  # noqa: BLE001 — HTTP thread must reply
+            self._reply(500, {"error": type(exc).__name__,
+                              "detail": str(exc)})
+
+
+class PredictServer:
+    """Threaded HTTP server wrapping one session; ``port=0`` binds an
+    ephemeral port (read it back from ``.port`` after construction)."""
+
+    def __init__(self, session, host: str = "127.0.0.1", port: int = 0):
+        self.session = session
+        self._httpd = ThreadingHTTPServer((host, int(port)), _Handler)
+        self._httpd.daemon_threads = True
+        self._httpd.session = session
+        self._thread = None
+
+    @property
+    def host(self) -> str:
+        return self._httpd.server_address[0]
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def start(self) -> "PredictServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="lgbm-serve-http",
+            daemon=True)
+        self._thread.start()
+        log.info("serving %d trees on %s (POST /predict, GET /health)",
+                 self.session.num_trees, self.url)
+        return self
+
+    def stop(self, close_session: bool = False) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(5.0)
+            self._thread = None
+        if close_session:
+            self.session.close()
+
+    def serve_forever(self) -> None:
+        """Blocking CLI entry: run until interrupted, then drain the
+        session's queue before exiting (graceful shutdown)."""
+        self.start()
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            log.info("serve: interrupt — draining and shutting down")
+        finally:
+            self.stop(close_session=True)
+
+    def __enter__(self) -> "PredictServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop(close_session=True)

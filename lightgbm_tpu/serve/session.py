@@ -1,0 +1,454 @@
+"""``PredictorSession``: pack a model once, serve it device-resident.
+
+The trainer's device predict path (boosting/gbdt.py) is tied to live
+training state; the session is the serving-side replacement — the
+reference's dedicated ``Predictor`` (src/application/predictor.hpp)
+rebuilt for TPU batch traversal:
+
+- the model (a ``Booster``, a bare ``GBDT``/``LoadedGBDT``, or a model
+  file path) is packed ONCE into a stacked bin-space ``ForestArrays``
+  plus a model-derived ``DeviceMeta`` (serve/packing.py — no train_ds);
+- ``predict(X)`` is the synchronous path (chunks internally to the
+  batch cap); ``submit(X) -> ticket`` / ``result(ticket)`` the async
+  one, coalesced by the dynamic microbatcher (serve/batcher.py);
+- every device call pads its rows to the next power-of-two bucket, so
+  the jitted forest scan compiles at most ``ceil(log2(max_batch)) + 1``
+  shapes — the obs recompile counter (obs/trace.py) verifies the bound;
+- if the device backend dies mid-flight the session degrades to the
+  host numpy predictor (per-tree value-space traversal) instead of
+  failing requests; ``stats()['degraded']`` and the ``serve_degraded``
+  event record it, and the HTTP /health endpoint reports it.
+
+Telemetry (when a sink is configured): ``serve_request`` per request
+(rows, total_ms, ok), ``serve_batch`` per device batch (rows, padded,
+bucket, queue_rows, exec_ms), ``serve_overload`` / ``serve_degraded``
+on the respective transitions.  ``obs/report.py serve_summary`` folds
+them into the serving digest (p50/p99, occupancy, pad waste).
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from concurrent.futures import InvalidStateError
+from typing import Optional
+
+import numpy as np
+
+from .. import obs
+from ..config import Config
+from ..utils import log
+from .batcher import (DeadlineExceeded, MicroBatcher, Request,
+                      ServeOverloadError)
+from .packing import ServeBinSpace
+
+_LAT_RESERVOIR = 8192  # latency samples kept for the p50/p99 estimate
+
+
+def _safe_resolve(future, result=None, error=None) -> None:
+    """Resolve a request future, tolerating the overload-cancellation
+    race: a submit that overloaded cancels its already-queued chunks,
+    and cancel() can land between any done() check and the set_* call —
+    an InvalidStateError here must not poison the rest of the batch."""
+    try:
+        if error is not None:
+            future.set_exception(error)
+        else:
+            future.set_result(result)
+    except InvalidStateError:
+        pass
+
+
+def _env_num(name: str, cast, fallback):
+    v = os.environ.get(name, "")
+    if v:
+        try:
+            return cast(v)
+        except ValueError:
+            log.warning("ignoring non-numeric %s=%r", name, v)
+    return fallback
+
+
+class Ticket:
+    """Handle for an async submission (one or more batcher requests —
+    oversize submissions are chunked to the batch cap)."""
+
+    __slots__ = ("parts", "rows", "raw_score", "t0", "counted")
+
+    def __init__(self, parts, rows: int, raw_score: bool):
+        self.parts = parts          # [(future, n_rows), ...]
+        self.rows = rows
+        self.raw_score = raw_score
+        self.t0 = time.perf_counter()
+        self.counted = False        # request-level stats recorded once
+
+
+class PredictorSession:
+    """Device-resident inference over one packed model window."""
+
+    def __init__(self, model, config=None, num_iteration: Optional[int] = None,
+                 start_iteration: int = 0, max_batch: Optional[int] = None,
+                 max_wait_ms: Optional[float] = None,
+                 queue_depth: Optional[int] = None):
+        gbdt = model
+        if isinstance(model, str):
+            from ..io.model_io import load_model_file
+            gbdt, loaded_cfg = load_model_file(model)
+            if config is None:
+                config = loaded_cfg
+        elif hasattr(model, "_gbdt"):  # a basic.Booster
+            gbdt = model._gbdt
+            if config is None:
+                config = getattr(model, "config", None)
+        if config is None:
+            config = getattr(gbdt, "config", None) or Config()
+        elif isinstance(config, dict):
+            config = Config.from_params(config)
+        self.config = config
+        self.gbdt = gbdt
+        self.objective = getattr(gbdt, "objective", None)
+        K = self.num_tpi = int(gbdt.num_tpi)
+
+        start, stop = gbdt._iter_window(num_iteration, start_iteration)
+        trees = list(gbdt.models)[start * K:stop * K]
+        if not trees:
+            raise ValueError("cannot serve an empty model")
+        self._trees = trees
+        self.num_trees = len(trees)
+        # rf-style averaged forests divide the summed raw score by the
+        # iteration window (io/model_io.py LoadedGBDT.predict_raw)
+        self.average_factor = (float(max(stop - start, 1))
+                               if getattr(gbdt, "average_output", False)
+                               else 0.0)
+        if gbdt.train_ds is not None:
+            F = int(gbdt.train_ds.num_total_features)
+        else:
+            F = int(getattr(gbdt, "num_features", 0)
+                    or len(getattr(gbdt, "feature_names", []) or []))
+        if F <= 0:
+            raise ValueError("model declares no feature space to bin into")
+        self.num_features = F
+
+        self.max_batch = int(max_batch if max_batch is not None else _env_num(
+            "LGBM_TPU_SERVE_MAX_BATCH", int,
+            getattr(config, "tpu_serve_max_batch", 1024)))
+        if self.max_batch < 1:
+            raise ValueError("max_batch must be >= 1")
+        self.max_wait_ms = float(
+            max_wait_ms if max_wait_ms is not None else _env_num(
+                "LGBM_TPU_SERVE_MAX_WAIT_MS", float,
+                getattr(config, "tpu_serve_max_wait_ms", 2.0)))
+        self.queue_depth = int(
+            queue_depth if queue_depth is not None else _env_num(
+                "LGBM_TPU_SERVE_QUEUE_DEPTH", int,
+                getattr(config, "tpu_serve_queue_depth", 8192)))
+
+        # ---- pack once: bin space + stacked forest + jitted scan ------
+        self.space = ServeBinSpace(trees, F)
+        class_ids = np.asarray([i % K for i in range(len(trees))], np.int32)
+        self.forest = self.space.pack(trees, class_ids)
+        from ..core.forest import forest_predict_fn
+        early_stop = (gbdt._early_stop_spec()
+                      if hasattr(gbdt, "_early_stop_spec") else None)
+        fn = forest_predict_fn(self.space.meta, K, early_stop)
+        if obs.profile_enabled():
+            fn = obs.profile_wrap("lgbm/forest_predict", fn)
+        self._device_fn = fn
+
+        # ---- serving state -------------------------------------------
+        self._degraded = False
+        self._closed = False
+        self._lock = threading.Lock()
+        self._lat_ms: list = []
+        self._n_req = 0
+        self._n_ok = 0
+        self._n_deadline = 0
+        self._n_overload = 0
+        self._batches = 0
+        self._real_rows = 0
+        self._padded_rows = 0
+        self._buckets: set = set()
+        self._batcher = MicroBatcher(
+            self._execute_batch, max_batch=self.max_batch,
+            max_wait_s=self.max_wait_ms / 1e3,
+            max_queue_rows=self.queue_depth)
+        if obs.enabled():
+            obs.event("serve_start", trees=self.num_trees, num_class=K,
+                      num_features=F, max_batch=self.max_batch,
+                      max_wait_ms=self.max_wait_ms,
+                      queue_depth=self.queue_depth)
+
+    # ------------------------------------------------------------------
+    def warmup(self) -> int:
+        """Pre-compile every bucket shape up to the batch cap so the
+        first real request never pays a compile.  The probe is clamped
+        to the cap — a non-power-of-two ``max_batch`` IS the top bucket
+        (``_bucket`` clamps the same way), so warmup compiles exactly
+        the shapes real traffic can produce.  Returns the bucket count."""
+        b, n = 1, 0
+        while True:
+            size = min(b, self.max_batch)
+            self._run_device(np.zeros((size, self.num_features), np.int32))
+            n += 1
+            if size >= self.max_batch:
+                return n
+            b *= 2
+
+    def _bucket(self, n: int) -> int:
+        b = 1
+        while b < n:
+            b *= 2
+        return min(b, self.max_batch)
+
+    def _run_device(self, bins: np.ndarray):
+        """Pad to the pow2 bucket, run the jitted scan, slice the pad
+        off.  Returns ([n, K] f64 raw scores, bucket)."""
+        import jax.numpy as jnp
+        n = bins.shape[0]
+        b = self._bucket(n)
+        if b > n:
+            bins = np.concatenate(
+                [bins, np.zeros((b - n, bins.shape[1]), bins.dtype)])
+        with self._lock:
+            self._buckets.add(b)
+        out = self._device_fn(self.forest, jnp.asarray(bins))
+        raw = np.asarray(out, dtype=np.float64)[:n]
+        if self.average_factor:
+            raw /= self.average_factor
+        return raw, b
+
+    def _run_host(self, X: np.ndarray) -> np.ndarray:
+        """Degraded path: per-tree value-space traversal on the host."""
+        K = self.num_tpi
+        out = np.zeros((X.shape[0], K))
+        for i, tree in enumerate(self._trees):
+            out[:, i % K] += tree.predict(X)
+        if self.average_factor:
+            out /= self.average_factor
+        return out
+
+    def _note_degraded(self, exc: BaseException) -> None:
+        if not self._degraded:
+            self._degraded = True
+            log.warning("serve: device predictor failed (%s: %s); "
+                        "degrading to the host predictor",
+                        type(exc).__name__, exc)
+            obs.event("serve_degraded",
+                      error=f"{type(exc).__name__}: {exc}")
+
+    def _convert(self, raw: np.ndarray, raw_score: bool) -> np.ndarray:
+        squeezed = raw if self.num_tpi > 1 else raw[:, 0]
+        if raw_score or self.objective is None:
+            return squeezed
+        return np.asarray(self.objective.convert_output(squeezed))
+
+    # ------------------------------------------------------------------
+    def predict(self, X, raw_score: bool = False) -> np.ndarray:
+        """Synchronous prediction, bypassing the queue (still bucketed,
+        so it shares the bounded compile set with the async path)."""
+        X = self._check_input(X)
+        t0 = time.perf_counter()
+        raw = np.zeros((X.shape[0], self.num_tpi))
+        for lo in range(0, X.shape[0], self.max_batch):
+            chunk = X[lo:lo + self.max_batch]
+            raw[lo:lo + chunk.shape[0]] = self._predict_chunk(chunk)
+        self._note_request(X.shape[0], (time.perf_counter() - t0) * 1e3)
+        return self._convert(raw, raw_score)
+
+    def _predict_chunk(self, X: np.ndarray) -> np.ndarray:
+        if not self._degraded:
+            try:
+                return self._run_device(self.space.bin_matrix(X))[0]
+            except Exception as exc:  # noqa: BLE001 — degrade, don't fail
+                self._note_degraded(exc)
+        return self._run_host(X)
+
+    # ------------------------------------------------------------------
+    def submit(self, X, deadline_ms: Optional[float] = None,
+               raw_score: bool = False) -> Ticket:
+        """Queue rows for the next coalesced batch.  Raises
+        ``ServeOverloadError`` when the bounded queue is full (explicit
+        backpressure).  Oversize submissions are chunked to the batch
+        cap; a chunk is never split across device batches."""
+        X = self._check_input(X)
+        if self._closed:
+            raise RuntimeError("session is closed")
+        deadline = (time.monotonic() + float(deadline_ms) / 1e3
+                    if deadline_ms is not None else None)
+        parts = []
+        try:
+            for lo in range(0, max(X.shape[0], 1), self.max_batch):
+                chunk = X[lo:lo + self.max_batch]
+                req = Request(self.space.bin_matrix(chunk), chunk,
+                              deadline=deadline)
+                parts.append((self._batcher.submit(req), chunk.shape[0]))
+        except ServeOverloadError:
+            with self._lock:
+                self._n_overload += 1
+            obs.event("serve_overload", rows=int(X.shape[0]),
+                      queue_rows=self._batcher.queue_rows)
+            for fut, _ in parts:  # a partially queued ticket must not leak
+                fut.cancel()
+            raise
+        return Ticket(parts, int(X.shape[0]), raw_score)
+
+    def result(self, ticket: Ticket, timeout: Optional[float] = None
+               ) -> np.ndarray:
+        """Block for a ticket's predictions (converted like
+        ``predict``).  Raises what the batch raised — including
+        ``DeadlineExceeded`` for requests that outlived their deadline.
+        Request-level accounting (stats + ``serve_request`` events)
+        happens HERE, once per ticket, so every outcome the caller sees
+        — success, deadline, worker failure, wait timeout — is counted
+        the same way."""
+        end = None if timeout is None else time.monotonic() + timeout
+        chunks = []
+        try:
+            for fut, _ in ticket.parts:
+                left = (None if end is None
+                        else max(end - time.monotonic(), 0.0))
+                chunks.append(fut.result(left))
+        except BaseException as exc:
+            self._note_failure(ticket, exc)
+            raise
+        raw = chunks[0] if len(chunks) == 1 else np.concatenate(chunks)
+        if not ticket.counted:
+            ticket.counted = True
+            self._note_request(ticket.rows,
+                               (time.perf_counter() - ticket.t0) * 1e3)
+        return self._convert(raw, ticket.raw_score)
+
+    def _note_failure(self, ticket: Ticket, exc: BaseException) -> None:
+        if ticket.counted:
+            return
+        ticket.counted = True
+        reason = ("deadline" if isinstance(exc, DeadlineExceeded)
+                  else type(exc).__name__)
+        with self._lock:
+            self._n_req += 1
+            if reason == "deadline":
+                self._n_deadline += 1
+        obs.event("serve_request", rows=int(ticket.rows),
+                  total_ms=round((time.perf_counter() - ticket.t0) * 1e3,
+                                 3),
+                  ok=False, reason=reason)
+
+    # ------------------------------------------------------------------
+    def _execute_batch(self, reqs) -> None:
+        """Batcher callback: expire, coalesce, pad, dispatch, split."""
+        now = time.monotonic()
+        live = []
+        for r in reqs:
+            if r.future.cancelled():
+                # an overloaded submit cancelled its partial ticket; the
+                # already-queued chunks must not be scored (resolution
+                # races are still possible later — _safe_resolve absorbs
+                # them)
+                continue
+            if r.deadline is not None and now > r.deadline:
+                # stats/events for the miss are recorded by result() —
+                # the one accounting point every outcome flows through
+                waited = (now - r.t_submit) * 1e3
+                _safe_resolve(r.future, error=DeadlineExceeded(
+                    f"request expired after {waited:.1f}ms in queue"))
+            else:
+                live.append(r)
+        if not live:
+            return
+        rows = sum(r.n for r in live)
+        t0 = time.perf_counter()
+        degraded = self._degraded
+        raw, bucket = None, rows
+        if not degraded:
+            try:
+                bins = (live[0].bins if len(live) == 1
+                        else np.concatenate([r.bins for r in live]))
+                raw, bucket = self._run_device(bins)
+            except Exception as exc:  # noqa: BLE001 — degrade, don't fail
+                self._note_degraded(exc)
+                degraded = True
+        if degraded:
+            raw = np.concatenate([self._run_host(r.raw) for r in live]) \
+                if len(live) > 1 else self._run_host(live[0].raw)
+        exec_ms = (time.perf_counter() - t0) * 1e3
+        off = 0
+        for r in live:
+            _safe_resolve(r.future, result=raw[off:off + r.n])
+            off += r.n
+        with self._lock:
+            self._batches += 1
+            self._real_rows += rows
+            self._padded_rows += bucket
+        obs.event("serve_batch", rows=rows, padded=int(bucket),
+                  requests=len(live), queue_rows=self._batcher.queue_rows,
+                  exec_ms=round(exec_ms, 3), degraded=degraded)
+
+    def _check_input(self, X) -> np.ndarray:
+        X = np.ascontiguousarray(np.asarray(X), dtype=np.float64)
+        if X.ndim == 1:
+            X = X[None, :]
+        if X.ndim != 2 or X.shape[1] != self.num_features:
+            raise ValueError(
+                f"The number of features in data "
+                f"({X.shape[1] if X.ndim == 2 else '?'}) is not the same "
+                f"as it was in training data ({self.num_features})")
+        return X
+
+    def _note_request(self, rows: int, total_ms: float) -> None:
+        with self._lock:
+            self._n_req += 1
+            self._n_ok += 1
+            self._lat_ms.append(total_ms)
+            if len(self._lat_ms) > _LAT_RESERVOIR:
+                del self._lat_ms[:_LAT_RESERVOIR // 2]
+        obs.event("serve_request", rows=int(rows),
+                  total_ms=round(total_ms, 3), ok=True)
+
+    # ------------------------------------------------------------------
+    def stats(self) -> dict:
+        """Serving counters + latency percentiles (for /health and the
+        serve bench)."""
+        from ..obs.report import percentile
+        with self._lock:
+            lat = sorted(self._lat_ms)
+
+            def pct(p):
+                return percentile(lat, p)
+
+            padded = self._padded_rows
+            return {
+                "requests": self._n_req,
+                "ok": self._n_ok,
+                "deadline_missed": self._n_deadline,
+                "overloads": self._n_overload,
+                "batches": self._batches,
+                "rows": self._real_rows,
+                "padded_rows": padded,
+                "occupancy": (round(self._real_rows / padded, 4)
+                              if padded else None),
+                "p50_ms": pct(0.50),
+                "p99_ms": pct(0.99),
+                "buckets": sorted(self._buckets),
+                "queue_rows": (0 if self._closed
+                               else self._batcher.queue_rows),
+                "degraded": self._degraded,
+                "trees": self.num_trees,
+                "num_class": self.num_tpi,
+                "num_features": self.num_features,
+                "max_batch": self.max_batch,
+            }
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            self._batcher.close()
+            if obs.enabled():
+                obs.event("serve_stop", **{k: v for k, v in
+                                           self.stats().items()
+                                           if not isinstance(v, list)})
+
+    def __enter__(self) -> "PredictorSession":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -64,6 +64,7 @@ _SLOW = {
     "test_continued.py::test_init_model_with_now_trivial_feature",
     "test_wave.py::test_wave_gated_boosting_matches_serial_loss",
     "test_cli.py::test_cli_task_refit",
+    "test_cli.py::test_cli_predict_from_model_file_only",
     "test_categorical.py::test_high_cardinality_categorical_uint16_path",
     "test_continued.py::test_refit_moves_leaf_values_toward_new_data",
     "test_bundling.py::test_reference_cli_efb_auc_parity",
@@ -75,6 +76,9 @@ _SLOW = {
     "test_sampling.py::test_feature_fraction_bynode_deterministic",
     "test_continued.py::test_init_model_booster_equals_uninterrupted",
     "test_predict_device.py::test_prediction_early_stop_converges_to_same_argmax",
+    "test_predict_device.py::test_pred_early_stop_device_matches_host_multiclass",
+    "test_predict_device.py::test_pred_early_stop_multiclass_differential",
+    "test_predict_device.py::test_loaded_model_device_predict_matches_host",
     "test_dump_model.py::test_dump_model_walk_matches_predict",
     "test_parallel.py::test_data_parallel_matches_single_device",
     "test_train.py::test_jit_cache_reuses_compiled_growers",

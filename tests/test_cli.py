@@ -12,9 +12,11 @@ import lightgbm_tpu as lgb
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _run_cli(args, cwd):
+def _run_cli(args, cwd, extra_env=None):
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    if extra_env:
+        env.update(extra_env)
     r = subprocess.run([sys.executable, "-m", "lightgbm_tpu"] + args,
                        cwd=cwd, env=env, capture_output=True, text=True,
                        timeout=420)
@@ -51,6 +53,46 @@ def test_cli_train_predict_matches_python_api(workdir):
     bst = lgb.Booster(model_file=str(workdir / "model.txt"))
     data = np.loadtxt(workdir / "data.test", delimiter="\t")
     np.testing.assert_allclose(bst.predict(data[:, 1:]), pred_cli, atol=1e-10)
+
+
+def test_cli_predict_from_model_file_only(workdir, tmp_path):
+    """Satellite round-trip: train -> save -> predict from the model file
+    ALONE (fresh directory, no training config present, `model_file`
+    alias) -> outputs match the python API.  task=serve is rejected
+    without a model the same way predict is."""
+    _run_cli(["config=train.conf", "output_model=mrt.txt"], workdir)
+    data = np.loadtxt(workdir / "data.test", delimiter="\t")
+
+    # a bare predict conf in a DIFFERENT directory: only the model file,
+    # the data to score, and the output path
+    (tmp_path / "predict.conf").write_text(
+        f"task = predict\ndata = {workdir / 'data.test'}\n"
+        f"model_file = {workdir / 'mrt.txt'}\n"
+        f"output_result = {tmp_path / 'pred.txt'}\nverbosity = -1\n")
+    _run_cli(["config=predict.conf"], tmp_path)
+    pred_cli = np.loadtxt(tmp_path / "pred.txt")
+
+    bst = lgb.Booster(model_file=str(workdir / "mrt.txt"))
+    np.testing.assert_allclose(bst.predict(data[:, 1:]), pred_cli,
+                               atol=1e-10)
+
+    # raw-score route too (stays self-contained)
+    _run_cli(["task=predict", f"data={workdir / 'data.test'}",
+              f"model_file={workdir / 'mrt.txt'}", "predict_raw_score=true",
+              f"output_result={tmp_path / 'raw.txt'}"], tmp_path)
+    raw_cli = np.loadtxt(tmp_path / "raw.txt")
+    np.testing.assert_allclose(bst.predict(data[:, 1:], raw_score=True),
+                               raw_cli, atol=1e-10)
+
+    # the SESSION branch (heavy-input routing): force it with the
+    # work-threshold override and require device-path parity
+    _run_cli(["task=predict", f"data={workdir / 'data.test'}",
+              f"model_file={workdir / 'mrt.txt'}",
+              f"output_result={tmp_path / 'sess.txt'}"], tmp_path,
+             extra_env={"LGBM_TPU_PREDICT_MIN_WORK": "0"})
+    sess_cli = np.loadtxt(tmp_path / "sess.txt")
+    np.testing.assert_allclose(bst.predict(data[:, 1:]), sess_cli,
+                               atol=1e-6)
 
 
 def test_cli_snapshots_and_continue(workdir):

@@ -103,6 +103,158 @@ def test_booster_predict_uses_device_on_large_work(monkeypatch):
     np.testing.assert_allclose(p_dev, p_host, rtol=0, atol=1e-5)
 
 
+def test_loaded_model_device_predict_matches_host(tmp_path):
+    """Satellite: Booster(model_file=...).predict hits the device path
+    (model-derived bin space, serve/packing.py) once the work threshold
+    is met — no train_ds required — and matches the host loop."""
+    rng = np.random.default_rng(4)
+    n = 1200
+    X = np.hstack([rng.normal(size=(n, 4)),
+                   rng.integers(0, 10, size=(n, 2)).astype(np.float64)])
+    X[:, :4][rng.random((n, 4)) < 0.06] = np.nan
+    y = (np.nan_to_num(X[:, 0]) + (X[:, 4] > 4) > 0.5).astype(np.float64)
+    bst = _train({"objective": "binary", "num_leaves": 15, "verbose": -1,
+                  "min_data_in_leaf": 5}, X, y, rounds=15, cat=[4, 5])
+    path = str(tmp_path / "m.txt")
+    bst.save_model(path)
+
+    import lightgbm_tpu as lgb
+    lb = lgb.Booster(model_file=path)
+    g = lb._gbdt
+    Xt = np.hstack([rng.normal(size=(300, 4)),
+                    rng.integers(-1, 13, size=(300, 2)).astype(np.float64)])
+    Xt[:, :4][rng.random((300, 4)) < 0.06] = np.nan
+    host = lb.predict(Xt)  # work below threshold -> host loop
+
+    cls = type(g)
+    old = cls._DEVICE_PREDICT_MIN_WORK
+    try:
+        cls._DEVICE_PREDICT_MIN_WORK = 1
+        called = {}
+        orig = cls._predict_raw_device
+
+        def spy(self, *a, **kw):
+            called["yes"] = True
+            return orig(self, *a, **kw)
+
+        cls._predict_raw_device = spy
+        dev = lb.predict(Xt)
+    finally:
+        cls._DEVICE_PREDICT_MIN_WORK = old
+        cls._predict_raw_device = orig
+    assert called.get("yes"), "device path not taken for loaded model"
+    np.testing.assert_allclose(dev, host, rtol=0, atol=1e-6)
+
+
+def test_predict_leaf_device_matches_host(tmp_path):
+    """Satellite: predict_leaf now has a device path (forest_leaf_fn);
+    leaf indices must equal the host per-tree walk EXACTLY, for both a
+    live trainer and a file-loaded booster."""
+    rng = np.random.default_rng(5)
+    X = rng.normal(size=(900, 5))
+    X[rng.random(X.shape) < 0.05] = np.nan
+    y = (np.nan_to_num(X[:, 0]) > 0).astype(np.float64)
+    bst = _train({"objective": "binary", "num_leaves": 15, "verbose": -1,
+                  "min_data_in_leaf": 5}, X, y, rounds=10)
+    path = str(tmp_path / "m.txt")
+    bst.save_model(path)
+    Xt = rng.normal(size=(250, 5))
+    Xt[rng.random(Xt.shape) < 0.05] = np.nan
+
+    import lightgbm_tpu as lgb
+    for booster in (bst, lgb.Booster(model_file=path)):
+        g = booster._gbdt
+        cls = type(g)
+        host = booster.predict(Xt, pred_leaf=True)
+        old = cls._DEVICE_PREDICT_MIN_WORK
+        try:
+            cls._DEVICE_PREDICT_MIN_WORK = 1
+            dev = booster.predict(Xt, pred_leaf=True)
+        finally:
+            cls._DEVICE_PREDICT_MIN_WORK = old
+        assert dev.shape == host.shape == (250, 10)
+        np.testing.assert_array_equal(dev, host)
+
+
+def _margin_settles_all(kind):
+    """An early-stop spec whose margin threshold 0 settles EVERY row at
+    the first check — the sharpest differential oracle available."""
+    return {"kind": kind, "round_period": 3, "margin_threshold": 0.0}
+
+
+def test_pred_early_stop_binary_differential():
+    """Satellite coverage for the host early-stop loop: threshold 0
+    freezes every row at the first round_period check (all-rows-settled
+    early exit), so the result EQUALS the plain sum over the first
+    round_period iterations; a huge threshold never settles and EQUALS
+    the full sum."""
+    rng = np.random.default_rng(6)
+    X = rng.normal(size=(400, 6))
+    y = (X[:, 0] + X[:, 1] > 0).astype(np.float64)
+    bst = _train({"objective": "binary", "num_leaves": 15, "verbose": -1,
+                  "min_data_in_leaf": 5}, X, y, rounds=12)
+    g = bst._gbdt
+    Xt = rng.normal(size=(150, 6))
+
+    full = g.predict_raw(Xt)
+    never = g.predict_raw(Xt, early_stop={"kind": "binary",
+                                          "round_period": 3,
+                                          "margin_threshold": 1e9})
+    np.testing.assert_array_equal(never, full)
+
+    settled = g.predict_raw(Xt, early_stop=_margin_settles_all("binary"))
+    first3 = g.predict_raw(Xt, num_iteration=3)
+    np.testing.assert_array_equal(settled, first3)
+
+
+def test_pred_early_stop_multiclass_differential():
+    """The multiclass margin path (top-2 gap) of the host loop, same
+    differential contract as the binary test."""
+    rng = np.random.default_rng(7)
+    X = rng.normal(size=(400, 5))
+    y = (rng.integers(0, 3, 400)).astype(np.float64)
+    bst = _train({"objective": "multiclass", "num_class": 3,
+                  "num_leaves": 7, "verbose": -1, "min_data_in_leaf": 5},
+                 X, y, rounds=9)
+    g = bst._gbdt
+    Xt = rng.normal(size=(120, 5))
+
+    full = g.predict_raw(Xt)
+    never = g.predict_raw(Xt, early_stop={"kind": "multiclass",
+                                          "round_period": 2,
+                                          "margin_threshold": 1e9})
+    np.testing.assert_array_equal(never, full)
+
+    settled = g.predict_raw(Xt,
+                            early_stop=_margin_settles_all("multiclass"))
+    first3 = g.predict_raw(Xt, num_iteration=3)
+    np.testing.assert_array_equal(settled, first3)
+    # settled margins keep the argmax of the full sum for decisive rows
+    assert settled.shape == (120, 3)
+
+
+def test_pred_early_stop_device_matches_host_multiclass():
+    """Device early-stop (folded into the forest scan) follows the host
+    loop's stop schedule: same spec, same outputs."""
+    rng = np.random.default_rng(8)
+    X = rng.normal(size=(500, 5))
+    y = ((X[:, 0] > 0).astype(int) + (X[:, 1] > 0.5).astype(int)
+         ).astype(np.float64)
+    bst = _train({"objective": "multiclass", "num_class": 3,
+                  "num_leaves": 7, "verbose": -1, "min_data_in_leaf": 5},
+                 X, y, rounds=8)
+    g = bst._gbdt
+    Xt = rng.normal(size=(200, 5))
+    for es in (None,
+               {"kind": "multiclass", "round_period": 2,
+                "margin_threshold": 1.5},
+               _margin_settles_all("multiclass")):
+        host = g.predict_raw(Xt, early_stop=es)
+        dev = g._predict_raw_device(Xt, *g._iter_window(None, 0),
+                                    early_stop=es)
+        np.testing.assert_allclose(dev, host, rtol=0, atol=1e-6)
+
+
 def test_reference_cli_pred_early_stop_parity(tmp_path):
     """Reference-CLI oracle: predictions with pred_early_stop=true,
     freq=5, margin=1.5 over the reference-trained 20-tree model

@@ -1,0 +1,370 @@
+"""serve/ — TPU-resident inference engine with dynamic microbatching.
+
+Pins the serving engine to the host per-tree predictor (the reference's
+Predictor pipeline, src/application/predictor.hpp): a file-loaded model
+served through ``PredictorSession`` must match host-loop ``predict`` to
+1e-6 on dense, NaN-heavy and categorical inputs, under concurrent
+mixed-size submissions, with the jitted predictor compiling at most
+ceil(log2(max_batch)) + 1 shapes (the pow2 bucket set).
+"""
+import json
+import math
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import obs
+from lightgbm_tpu.serve import (DeadlineExceeded, PredictorSession,
+                                PredictServer, ServeOverloadError)
+
+
+def _nan_matrix(rng, n, f_num, f_cat=0, cat_lo=-1, cat_hi=15):
+    X = rng.normal(size=(n, f_num))
+    X[rng.random((n, f_num)) < 0.08] = np.nan
+    if f_cat:
+        X = np.hstack([X, rng.integers(cat_lo, cat_hi, size=(n, f_cat)
+                                       ).astype(np.float64)])
+    return X
+
+
+@pytest.fixture(scope="module")
+def binary_model(tmp_path_factory):
+    """Binary model over NaN-heavy numericals, saved + file-loaded."""
+    rng = np.random.default_rng(0)
+    X = _nan_matrix(rng, 1200, 6)
+    y = (np.nan_to_num(X[:, 0]) + np.nan_to_num(X[:, 1]) > 0
+         ).astype(np.float64)
+    params = {"objective": "binary", "num_leaves": 15, "verbose": -1,
+              "min_data_in_leaf": 5}
+    bst = lgb.train(params, lgb.Dataset(X, label=y, params=params),
+                    num_boost_round=25)
+    path = str(tmp_path_factory.mktemp("serve") / "binary.txt")
+    bst.save_model(path)
+    return path
+
+
+@pytest.fixture(scope="module")
+def multiclass_model(tmp_path_factory):
+    """Multiclass model with categorical features, saved + file-loaded."""
+    rng = np.random.default_rng(1)
+    X = _nan_matrix(rng, 1200, 4, f_cat=2, cat_lo=0, cat_hi=12)
+    y = ((np.nan_to_num(X[:, 0]) > 0).astype(int)
+         + (X[:, 4] > 5).astype(int)).astype(np.float64)
+    params = {"objective": "multiclass", "num_class": 3, "num_leaves": 15,
+              "verbose": -1, "min_data_in_leaf": 5}
+    ds = lgb.Dataset(X, label=y, categorical_feature=[4, 5], params=params)
+    bst = lgb.train(params, ds, num_boost_round=12)
+    path = str(tmp_path_factory.mktemp("serve") / "multi.txt")
+    bst.save_model(path)
+    return path
+
+
+def _host_predict(model_path, X, raw_score=False):
+    return lgb.Booster(model_file=model_path).predict(X,
+                                                      raw_score=raw_score)
+
+
+# ---------------------------------------------------------------------------
+# parity: session == host loop on the acceptance fixtures
+# ---------------------------------------------------------------------------
+
+def test_session_matches_host_binary_nan(binary_model):
+    rng = np.random.default_rng(2)
+    Xt = _nan_matrix(rng, 500, 6)
+    with PredictorSession(binary_model, max_batch=128) as sess:
+        got = sess.predict(Xt)
+        raw = sess.predict(Xt, raw_score=True)
+        st = sess.stats()
+    want = _host_predict(binary_model, Xt)
+    want_raw = _host_predict(binary_model, Xt, raw_score=True)
+    np.testing.assert_allclose(got, want, rtol=0, atol=1e-6)
+    np.testing.assert_allclose(raw, want_raw, rtol=0, atol=1e-6)
+    assert st["degraded"] is False
+    # every device batch padded to a pow2 bucket
+    assert all(b & (b - 1) == 0 for b in st["buckets"])
+
+
+def test_session_matches_host_multiclass_categorical(multiclass_model):
+    rng = np.random.default_rng(3)
+    # unseen + negative categories exercise the sentinel routing
+    Xt = _nan_matrix(rng, 400, 4, f_cat=2, cat_lo=-2, cat_hi=20)
+    with PredictorSession(multiclass_model, max_batch=128) as sess:
+        got = sess.predict(Xt)
+        st = sess.stats()
+    want = _host_predict(multiclass_model, Xt)
+    assert got.shape == (400, 3)
+    np.testing.assert_allclose(got, want, rtol=0, atol=1e-6)
+    assert st["degraded"] is False
+
+
+def test_session_from_booster_and_trained(binary_model):
+    """A live Booster (trained in-process, train_ds present) packs into
+    the same serving space as its file-loaded twin."""
+    rng = np.random.default_rng(4)
+    X = _nan_matrix(rng, 800, 6)
+    y = (np.nan_to_num(X[:, 0]) > 0).astype(np.float64)
+    params = {"objective": "binary", "num_leaves": 7, "verbose": -1}
+    bst = lgb.train(params, lgb.Dataset(X, label=y, params=params),
+                    num_boost_round=8)
+    Xt = _nan_matrix(rng, 300, 6)
+    with PredictorSession(bst) as sess:
+        got = sess.predict(Xt)
+    np.testing.assert_allclose(got, bst.predict(Xt), rtol=0, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# acceptance: concurrent mixed sizes + bounded predictor compiles
+# ---------------------------------------------------------------------------
+
+def test_concurrent_mixed_sizes_bounded_compiles(multiclass_model,
+                                                 tmp_path):
+    obs.enable(str(tmp_path / "telem"))
+    try:
+        max_batch = 64
+        compiles0 = obs.counter_value("jax/compiles")
+        sess = PredictorSession(multiclass_model, max_batch=max_batch,
+                                max_wait_ms=1.0)
+        host = lgb.Booster(model_file=multiclass_model)
+        errs = []
+
+        def client(seed):
+            rng = np.random.default_rng(seed)
+            for _ in range(6):
+                n = int(rng.integers(1, max_batch + 30))  # some chunk
+                Xi = _nan_matrix(rng, n, 4, f_cat=2, cat_lo=-1, cat_hi=16)
+                ticket = sess.submit(Xi)
+                got = sess.result(ticket, timeout=120)
+                diff = float(np.abs(got - host.predict(Xi)).max())
+                if diff > 1e-6:
+                    errs.append(diff)
+
+        threads = [threading.Thread(target=client, args=(s,))
+                   for s in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        st = sess.stats()
+        sess.close()
+        compiles = obs.counter_value("jax/compiles") - compiles0
+        bound = math.ceil(math.log2(max_batch)) + 1
+        assert not errs, f"parity failures under concurrency: {errs}"
+        assert st["degraded"] is False
+        assert compiles <= bound, (compiles, bound, st["buckets"])
+        assert len(st["buckets"]) <= bound
+        # coalescing happened: batches cannot exceed requests' chunks,
+        # and occupancy is accounted
+        assert st["batches"] >= 1 and st["occupancy"] is not None
+        # the telemetry stream carries a well-formed serving digest
+        from lightgbm_tpu.obs.report import (load_events, serve_summary,
+                                             validate_events)
+        events = load_events(str(tmp_path / "telem"))
+        assert not validate_events(events)
+        digest = serve_summary(events)
+        assert digest["requests"] >= 36
+        assert digest["p99_ms"] is not None
+        assert digest["degraded"] is False
+    finally:
+        obs.disable()
+
+
+# ---------------------------------------------------------------------------
+# batching behavior: coalescing, backpressure, deadlines, degradation
+# ---------------------------------------------------------------------------
+
+def test_batcher_coalesces_small_requests(binary_model):
+    rng = np.random.default_rng(5)
+    with PredictorSession(binary_model, max_batch=64,
+                          max_wait_ms=60.0) as sess:
+        tickets = [sess.submit(_nan_matrix(rng, 3, 6)) for _ in range(8)]
+        outs = [sess.result(t, timeout=60) for t in tickets]
+        st = sess.stats()
+    assert all(o.shape == (3,) for o in outs)
+    # 8 x 3 rows inside one 60ms window coalesce into far fewer batches
+    assert st["batches"] < 8
+    assert st["rows"] == 24
+
+
+def test_overload_raises_and_counts(binary_model, monkeypatch):
+    rng = np.random.default_rng(6)
+    sess = PredictorSession(binary_model, max_batch=8, max_wait_ms=0.0,
+                            queue_depth=8)
+    orig = sess._run_device
+
+    def slow(bins):
+        time.sleep(0.4)
+        return orig(bins)
+
+    monkeypatch.setattr(sess, "_run_device", slow)
+    t1 = sess.submit(_nan_matrix(rng, 8, 6))   # in flight (worker busy)
+    time.sleep(0.05)
+    t2 = sess.submit(_nan_matrix(rng, 8, 6))   # fills the queue
+    with pytest.raises(ServeOverloadError):
+        sess.submit(_nan_matrix(rng, 8, 6))    # bounced, not buffered
+    sess.result(t1, timeout=30)
+    sess.result(t2, timeout=30)
+    st = sess.stats()
+    sess.close()
+    assert st["overloads"] == 1
+    assert st["deadline_missed"] == 0
+
+
+def test_deadline_exceeded_in_queue(binary_model, monkeypatch):
+    rng = np.random.default_rng(7)
+    sess = PredictorSession(binary_model, max_batch=8, max_wait_ms=0.0)
+    orig = sess._run_device
+
+    def slow(bins):
+        time.sleep(0.3)
+        return orig(bins)
+
+    monkeypatch.setattr(sess, "_run_device", slow)
+    t1 = sess.submit(_nan_matrix(rng, 8, 6))
+    time.sleep(0.05)
+    t2 = sess.submit(_nan_matrix(rng, 4, 6), deadline_ms=1.0)
+    sess.result(t1, timeout=30)
+    with pytest.raises(DeadlineExceeded):
+        sess.result(t2, timeout=30)
+    st = sess.stats()
+    sess.close()
+    assert st["deadline_missed"] == 1
+
+
+def test_degrades_to_host_predictor(binary_model, monkeypatch):
+    rng = np.random.default_rng(8)
+    Xt = _nan_matrix(rng, 50, 6)
+    want = _host_predict(binary_model, Xt)
+    sess = PredictorSession(binary_model, max_batch=32)
+
+    def boom(forest, bins):
+        raise RuntimeError("device backend died mid-flight")
+
+    monkeypatch.setattr(sess, "_device_fn", boom)
+    got = sess.predict(Xt)                       # sync path degrades
+    np.testing.assert_allclose(got, want, rtol=0, atol=1e-10)
+    ticket = sess.submit(Xt)                     # async path follows
+    got2 = sess.result(ticket, timeout=30)
+    np.testing.assert_allclose(got2, want, rtol=0, atol=1e-10)
+    st = sess.stats()
+    sess.close()
+    assert st["degraded"] is True
+
+
+def test_input_width_checked(binary_model):
+    with PredictorSession(binary_model) as sess:
+        with pytest.raises(ValueError, match="number of features"):
+            sess.predict(np.zeros((3, 4)))
+
+
+def test_close_is_graceful_and_idempotent(binary_model):
+    rng = np.random.default_rng(9)
+    sess = PredictorSession(binary_model, max_batch=32, max_wait_ms=50.0)
+    ticket = sess.submit(_nan_matrix(rng, 5, 6))
+    sess.close()   # drains the queue before the worker exits
+    out = sess.result(ticket, timeout=10)
+    assert out.shape == (5,)
+    sess.close()
+    assert not sess._batcher._thread.is_alive()
+
+
+# ---------------------------------------------------------------------------
+# HTTP front end
+# ---------------------------------------------------------------------------
+
+def _post(url, payload, timeout=60):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as exc:
+        return exc.code, json.loads(exc.read())
+
+
+def test_http_server_roundtrip(multiclass_model):
+    rng = np.random.default_rng(10)
+    Xt = _nan_matrix(rng, 40, 4, f_cat=2, cat_lo=-1, cat_hi=16)
+    want = _host_predict(multiclass_model, Xt)
+    sess = PredictorSession(multiclass_model, max_batch=64)
+    with PredictServer(sess) as server:
+        code, body = _post(server.url + "/predict",
+                           {"rows": Xt.tolist()})
+        assert code == 200
+        got = np.asarray(body["predictions"])
+        assert body["rows"] == 40
+        np.testing.assert_allclose(got, want, rtol=0, atol=1e-6)
+
+        # health reflects the live session
+        with urllib.request.urlopen(server.url + "/health",
+                                    timeout=30) as resp:
+            health = json.loads(resp.read())
+        assert health["status"] == "ok"
+        assert health["requests"] >= 1
+        assert health["num_class"] == 3
+
+        # protocol errors are typed, not 500s
+        code, body = _post(server.url + "/predict", {"rows": "nope"})
+        assert code == 400 and body["error"] == "bad_request"
+        code, body = _post(server.url + "/predict", {})
+        assert code == 400
+        code, body = _post(server.url + "/nothing", {})
+        assert code == 404
+    assert not sess._batcher._thread.is_alive()  # clean shutdown
+
+
+# ---------------------------------------------------------------------------
+# serving digest (obs/report.py)
+# ---------------------------------------------------------------------------
+
+def test_serve_summary_and_render():
+    from lightgbm_tpu.obs.report import render, serve_summary, summarize
+    events = []
+    for ms in (1.0, 2.0, 3.0, 50.0):
+        events.append({"event": "serve_request", "rows": 4,
+                       "total_ms": ms, "ok": True, "_proc": 0})
+    events.append({"event": "serve_request", "rows": 2, "total_ms": 9.0,
+                   "ok": False, "reason": "deadline", "_proc": 0})
+    events.append({"event": "serve_batch", "rows": 18, "padded": 32,
+                   "requests": 5, "queue_rows": 7, "exec_ms": 1.5,
+                   "degraded": False, "_proc": 0})
+    events.append({"event": "serve_overload", "rows": 9, "queue_rows": 64,
+                   "_proc": 0})
+    s = serve_summary(events)
+    assert s["requests"] == 5 and s["ok"] == 4
+    assert s["deadline_missed"] == 1 and s["overloads"] == 1
+    assert s["occupancy"] == round(18 / 32, 4)
+    assert s["pad_waste_rows"] == 14
+    # nearest-rank: p50 of [1,2,3,50] is rank ceil(0.5*4)=2 -> 2.0;
+    # p99 is rank ceil(0.99*4)=4 -> 50.0
+    assert s["p50_ms"] == 2.0 and s["p99_ms"] == 50.0
+    assert s["degraded"] is False
+    digest = summarize(events)
+    assert digest["serve"]["requests"] == 5
+    text = render(digest)
+    assert "serving: ok" in text
+    assert "p99 50.0ms" in text
+
+    events.append({"event": "serve_degraded", "error": "RuntimeError: x",
+                   "_proc": 0})
+    s = serve_summary(events)
+    assert s["degraded"] is True and "RuntimeError" in s["degraded_error"]
+    assert "DEGRADED" in render(summarize(events))
+
+
+def test_serve_event_schemas():
+    from lightgbm_tpu.obs.report import validate_events
+    good = [{"event": "serve_request", "rows": 3, "total_ms": 1.2,
+             "ok": True},
+            {"event": "serve_batch", "rows": 3, "padded": 4,
+             "requests": 1, "queue_rows": 0, "exec_ms": 0.9,
+             "degraded": False}]
+    assert validate_events(good) == []
+    bad = [{"event": "serve_request", "rows": "three", "ok": True}]
+    problems = validate_events(bad)
+    assert any("rows" in p for p in problems)
+    assert any("total_ms" in p for p in problems)

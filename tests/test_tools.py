@@ -422,6 +422,37 @@ def test_run_suite_reports_failure(tmp_path):
     assert rec["failed"] == 1
 
 
+def test_run_suite_serve_leg_stubbed():
+    """The serve tier wraps bench_serve.py --smoke: its check map becomes
+    the tier's pass/fail counts and a failing check fails the tier."""
+    rs = _import_tool("run_suite")
+
+    def fake_ok(argv, **kw):
+        import types
+        line = json.dumps({"kind": "serve", "ok": True,
+                           "checks": {"p99_recorded": True,
+                                      "compiles_bounded": True,
+                                      "clean_shutdown": True}})
+        return types.SimpleNamespace(returncode=0, stdout=line + "\n",
+                                     stderr="")
+
+    res = rs.run_serve_smoke(60, runner=fake_ok)
+    assert res["ok"] is True
+    assert res["counts"] == {"passed": 3, "failed": 0}
+
+    def fake_bad(argv, **kw):
+        import types
+        line = json.dumps({"kind": "serve", "ok": False,
+                           "checks": {"p99_recorded": True,
+                                      "compiles_bounded": False}})
+        return types.SimpleNamespace(returncode=1, stdout=line + "\n",
+                                     stderr="")
+
+    res = rs.run_serve_smoke(60, runner=fake_bad)
+    assert res["ok"] is False
+    assert res["counts"]["failed"] == 1
+
+
 # ---------------------------------------------------------------------------
 # tpu_window: self-arming measurement watcher
 # ---------------------------------------------------------------------------
@@ -473,7 +504,15 @@ def test_tpu_window_checklist_stubbed(tmp_path):
                              "rows": 100, "iters": 3, "num_leaves": 31,
                              "max_bin": 255, "backend": "cpu-forced",
                              "health_checks": 9, "health_failures": 0})
+    serve_line = json.dumps({"kind": "serve", "backend": "cpu",
+                             "trees": 20, "max_batch": 128,
+                             "closed": {"rows_per_s": 9000.0,
+                                        "p99_ms": 12.0},
+                             "open": {"p99_ms": 15.0},
+                             "occupancy": 0.7, "compiles": 8,
+                             "degraded": False})
     fake = _FakeRun({
+        "bench_serve.py": (0, serve_line + "\n"),
         "bench.py": (0, "noise\n" + bench_line + "\n"),
         "prof_kernels.py": (0, json.dumps({"tool": "prof_kernels",
                                            "legs": {}}) + "\n"),
@@ -486,7 +525,8 @@ def test_tpu_window_checklist_stubbed(tmp_path):
     assert rec["parsed"]["value"] == 123.0
     assert rec["parsed"]["health_failures"] == 0
     assert set(rec["legs"]) == {"bench", "bench_profile",
-                                "bench_maxbin63", "prof_kernels", "trace"}
+                                "bench_maxbin63", "prof_kernels",
+                                "bench_serve", "trace"}
     assert all(leg["rc"] == 0 for leg in rec["legs"].values())
     # bench legs ran three times (clean, profile, maxbin63)
     bench_calls = [c for c in fake.calls if any("bench.py" in a
@@ -498,6 +538,13 @@ def test_tpu_window_checklist_stubbed(tmp_path):
     rows = bh.collect([str(tmp_path / "BENCH_manual_r07.json")])
     assert rows[0]["metrics"]["value"] == 123.0
     assert rows[0]["canary"] == "cpu-forced"
+    # the serve leg's parsed line landed as SERVE_manual_rN.json and
+    # folds into the trajectory under the serve context
+    assert (tmp_path / "SERVE_manual_r07.json").exists()
+    srows = bh.collect([str(tmp_path / "SERVE_manual_r07.json")])
+    assert srows[0]["context"][0] == "serve"
+    assert srows[0]["metrics"]["serve_rows_per_s"] == 9000.0
+    assert srows[0]["metrics"]["serve_p99_ms"] == 12.0
 
 
 def test_tpu_window_dry_run_end_to_end(tmp_path):

@@ -9,11 +9,15 @@ the wrong way".  This tool does:
     python tools/bench_history.py [path ...] [--json] [--threshold 0.1]
                                   [--fail-on-regression]
 
-``path`` entries are bench-round JSON files, telemetry digest JSON files
-(``telemetry_report.py --json`` output), or directories to glob for
-``BENCH_r*.json`` (default: the repo root).  Rounds whose bench produced
-no parseable line (``"parsed": null`` — e.g. round 1's empty tail) are
-listed but carry no metrics.
+``path`` entries are bench-round JSON files, serving-round files
+(``SERVE_r*.json`` from ``tools/bench_serve.py``), telemetry digest JSON
+files (``telemetry_report.py --json`` output), or directories to glob
+for ``BENCH_r*.json`` + ``SERVE_r*.json`` (default: the repo root).
+Rounds whose bench produced no parseable line (``"parsed": null`` —
+e.g. round 1's empty tail) are listed but carry no metrics.  Serving
+rounds trend rows/s + p50/p99 + batch occupancy under their own
+context, and a round that degraded to the host predictor is excluded
+from baselines like a CPU-fallback canary.
 
 Regression flagging compares each metric of the LATEST comparable round
 against the best earlier comparable round — comparable meaning the same
@@ -59,11 +63,18 @@ _DIRECTIONS = [
     ("implied_higgs_500iter_s", False),
     ("implied_mslr_500iter_s", False),
     ("peak_hbm_bytes", False),
+    # serving rounds (SERVE_r*.json, tools/bench_serve.py)
+    ("serve_rows_per_s", True),
+    ("serve_p50_ms", False),
+    ("serve_p99_ms", False),
+    ("serve_open_p99_ms", False),
+    ("serve_occupancy", True),
 ]
 
 # the headline columns of the human table, in order
 _TABLE_COLS = ["value", "vs_baseline", "per_iter_s", "compile_s",
-               "train_auc", "rank_row_iters_per_s", "peak_hbm_bytes"]
+               "train_auc", "rank_row_iters_per_s", "peak_hbm_bytes",
+               "serve_p99_ms", "serve_occupancy"]
 
 _CONTEXT_KEYS = ("backend", "rows", "iters", "num_leaves", "max_bin")
 
@@ -100,6 +111,25 @@ def load_round(path: str) -> dict:
     if parsed is None:
         row["note"] = "no parsed bench line"
         row["context"] = None
+        return row
+    if parsed.get("kind") == "serve":  # a bench_serve.py round
+        row["context"] = ("serve", parsed.get("backend"),
+                          parsed.get("trees"), parsed.get("max_batch"))
+        closed = parsed.get("closed") or {}
+        opened = parsed.get("open") or {}
+        for name, v in (("serve_rows_per_s", closed.get("rows_per_s")),
+                        ("value", closed.get("rows_per_s")),
+                        ("serve_p50_ms", closed.get("p50_ms")),
+                        ("serve_p99_ms", closed.get("p99_ms")),
+                        ("serve_open_p99_ms", opened.get("p99_ms")),
+                        ("serve_occupancy", parsed.get("occupancy")),
+                        ("jax_compiles", parsed.get("compiles"))):
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                row["metrics"][name] = float(v)
+        if parsed.get("degraded"):
+            row["canary"] = "serve-degraded"
+            row["note"] = "degraded to host predictor — excluded from " \
+                          "baselines"
         return row
     if "per_iteration" in parsed:  # a telemetry_report.py --json digest
         row["context"] = ("telemetry",)
@@ -153,6 +183,7 @@ def collect(paths: List[str]) -> List[dict]:
     for p in paths:
         if os.path.isdir(p):
             files.extend(sorted(glob.glob(os.path.join(p, "BENCH_r*.json"))))
+            files.extend(sorted(glob.glob(os.path.join(p, "SERVE_r*.json"))))
         else:
             files.append(p)
     rows = []

@@ -1,0 +1,359 @@
+"""Serving bench: closed-loop + open-loop (Poisson) latency/throughput.
+
+The training benches (bench.py) answer "how fast does it learn"; this
+answers "how does it serve" — the serve/ subsystem's round artifact:
+
+1. **closed-loop**: N client threads fire mixed-size requests
+   back-to-back through ``PredictorSession.submit``/``result`` for a
+   fixed duration — the saturation number (rows/s, request p50/p99).
+2. **open-loop**: requests arrive on a Poisson clock at a fixed rate
+   with mixed sizes, so latency includes real queueing delay instead of
+   the closed-loop's self-throttling — the SLO number.
+3. **HTTP smoke** (``--smoke``): starts ``PredictServer`` in-process,
+   fires concurrent mixed-size POST /predict + GET /health, then
+   asserts p99 recorded, the compile count bounded by the pow2 bucket
+   set (<= ceil(log2(max_batch)) + 1), and a clean shutdown.  This is
+   the ``serve`` leg ``tools/run_suite.py`` runs in CI.
+
+Writes ``SERVE_r{N}.json`` (``--out``/``--round``; ``--json`` prints the
+record instead) which ``tools/bench_history.py`` folds into the
+trajectory table.  CPU-runnable end to end; on a TPU window
+``tools/tpu_window.py`` captures the same record as
+``SERVE_manual_r{N}.json``.
+
+Env knobs (smoke sizes in parens): SERVE_ROWS train rows (2000),
+SERVE_TREES boosting rounds (20), SERVE_FEATURES (8), SERVE_MAX_BATCH
+(256), SERVE_CLIENTS closed-loop threads (4), SERVE_DURATION_S per-loop
+seconds (2), SERVE_RATE open-loop req/s (50), SERVE_MODEL serve an
+existing model file instead of training one.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import math
+import os
+import re
+import sys
+import tempfile
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+_DEFAULTS = dict(rows=20000, trees=60, features=12, max_batch=1024,
+                 clients=8, duration_s=5.0, rate=200.0)
+_SMOKE = dict(rows=2000, trees=20, features=8, max_batch=256,
+              clients=4, duration_s=2.0, rate=50.0)
+
+
+def _env(name, cast, fallback):
+    v = os.environ.get(name, "")
+    if v:
+        try:
+            return cast(v)
+        except ValueError:
+            pass
+    return fallback
+
+
+def knobs(smoke: bool) -> dict:
+    base = dict(_SMOKE if smoke else _DEFAULTS)
+    return dict(
+        rows=_env("SERVE_ROWS", int, base["rows"]),
+        trees=_env("SERVE_TREES", int, base["trees"]),
+        features=_env("SERVE_FEATURES", int, base["features"]),
+        max_batch=_env("SERVE_MAX_BATCH", int, base["max_batch"]),
+        clients=_env("SERVE_CLIENTS", int, base["clients"]),
+        duration_s=_env("SERVE_DURATION_S", float, base["duration_s"]),
+        rate=_env("SERVE_RATE", float, base["rate"]),
+        model=os.environ.get("SERVE_MODEL", ""),
+    )
+
+
+def build_model(k: dict, workdir: str) -> str:
+    """Train a small binary model (NaN-heavy + categorical, so the bench
+    exercises the full binning surface) and save it; or reuse
+    SERVE_MODEL."""
+    if k["model"]:
+        return k["model"]
+    import numpy as np
+
+    import lightgbm_tpu as lgb
+    rng = np.random.default_rng(7)
+    F = k["features"]
+    Xnum = rng.normal(size=(k["rows"], F - 1))
+    Xnum[rng.random(Xnum.shape) < 0.05] = np.nan
+    Xcat = rng.integers(0, 16, size=(k["rows"], 1)).astype(np.float64)
+    X = np.hstack([Xnum, Xcat])
+    y = ((np.nan_to_num(Xnum[:, 0]) + 0.25 * (Xcat[:, 0] % 3)) > 0
+         ).astype(np.float64)
+    params = {"objective": "binary", "num_leaves": 31, "verbose": -1,
+              "min_data_in_leaf": 5}
+    ds = lgb.Dataset(X, label=y, categorical_feature=[F - 1], params=params)
+    bst = lgb.train(params, ds, num_boost_round=k["trees"])
+    path = os.path.join(workdir, "serve_bench_model.txt")
+    bst.save_model(path)
+    return path
+
+
+def _percentiles(lat):
+    # the one shared nearest-rank definition (obs/report.py) so the
+    # bench record can't diverge from the digest / health endpoint
+    from lightgbm_tpu.obs.report import percentile
+    lat = sorted(lat)
+    return percentile(lat, 0.50), percentile(lat, 0.99)
+
+
+def _request_sizes(rng, max_batch: int):
+    """Mixed request sizes: mostly small single-user lookups, a tail of
+    bulk scoring calls — the traffic shape the microbatcher exists for."""
+    import numpy as np
+    if rng.random() < 0.8:
+        return int(rng.integers(1, 17))
+    return int(rng.integers(17, max(max_batch // 2, 18)))
+
+
+def closed_loop(sess, Xpool, k: dict) -> dict:
+    import numpy as np
+    stop_at = time.perf_counter() + k["duration_s"]
+    lat, rows_done, errors = [], [0], []
+    lock = threading.Lock()
+
+    def client(seed):
+        rng = np.random.default_rng(seed)
+        while time.perf_counter() < stop_at:
+            n = _request_sizes(rng, k["max_batch"])
+            lo = int(rng.integers(0, max(Xpool.shape[0] - n, 1)))
+            t0 = time.perf_counter()
+            try:
+                ticket = sess.submit(Xpool[lo:lo + n])
+                sess.result(ticket, timeout=60.0)
+            except Exception as exc:  # noqa: BLE001
+                errors.append(f"{type(exc).__name__}: {exc}")
+                continue
+            with lock:
+                lat.append((time.perf_counter() - t0) * 1e3)
+                rows_done[0] += n
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=client, args=(s,))
+               for s in range(k["clients"])]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    p50, p99 = _percentiles(lat)
+    return {"clients": k["clients"], "duration_s": round(wall, 2),
+            "requests": len(lat), "rows": rows_done[0],
+            "req_per_s": round(len(lat) / wall, 1),
+            "rows_per_s": round(rows_done[0] / wall, 1),
+            "p50_ms": p50, "p99_ms": p99, "errors": len(errors),
+            "error_sample": errors[:3]}
+
+
+def open_loop(sess, Xpool, k: dict) -> dict:
+    """Poisson arrivals at SERVE_RATE req/s; latency measured from the
+    scheduled submit to future completion, so queueing delay counts."""
+    import numpy as np
+    rng = np.random.default_rng(11)
+    lat, overloads, failures = [], [0], [0]
+    lock = threading.Lock()
+    pending = []
+    stop_at = time.perf_counter() + k["duration_s"]
+    from lightgbm_tpu.serve import ServeOverloadError
+
+    def on_done(t0):
+        def cb(fut):
+            with lock:
+                if fut.exception() is None:
+                    lat.append((time.perf_counter() - t0) * 1e3)
+                else:
+                    failures[0] += 1
+        return cb
+
+    n_sent = 0
+    while time.perf_counter() < stop_at:
+        gap = rng.exponential(1.0 / max(k["rate"], 1e-6))
+        time.sleep(gap)
+        n = _request_sizes(rng, k["max_batch"])
+        lo = int(rng.integers(0, max(Xpool.shape[0] - n, 1)))
+        t0 = time.perf_counter()
+        try:
+            ticket = sess.submit(Xpool[lo:lo + n])
+        except ServeOverloadError:
+            overloads[0] += 1
+            continue
+        n_sent += 1
+        for fut, _ in ticket.parts:
+            fut.add_done_callback(on_done(t0))
+            pending.append(fut)
+    deadline = time.time() + 30
+    for fut in pending:
+        try:
+            fut.result(max(deadline - time.time(), 0.1))
+        except Exception:  # noqa: BLE001 — on_done already counted it;
+            pass           # a failed request must not kill the bench
+    p50, p99 = _percentiles(lat)
+    return {"rate_rps": k["rate"], "requests": n_sent,
+            "completed": len(lat), "overloads": overloads[0],
+            "failures": failures[0], "p50_ms": p50, "p99_ms": p99}
+
+
+def http_smoke(server, Xpool, k: dict) -> dict:
+    """Concurrent mixed-size POST /predict + GET /health over real HTTP."""
+    import urllib.request
+
+    import numpy as np
+    url = server.url
+    lat, errors = [], []
+    lock = threading.Lock()
+
+    def post(seed):
+        rng = np.random.default_rng(seed)
+        for _ in range(4):
+            n = _request_sizes(rng, k["max_batch"])
+            lo = int(rng.integers(0, max(Xpool.shape[0] - n, 1)))
+            body = json.dumps(
+                {"rows": Xpool[lo:lo + n].tolist()}).encode()
+            req = urllib.request.Request(
+                url + "/predict", data=body,
+                headers={"Content-Type": "application/json"})
+            t0 = time.perf_counter()
+            try:
+                with urllib.request.urlopen(req, timeout=60) as resp:
+                    payload = json.loads(resp.read())
+                if len(payload["predictions"]) != n:
+                    raise ValueError("row count mismatch")
+                with lock:
+                    lat.append((time.perf_counter() - t0) * 1e3)
+            except Exception as exc:  # noqa: BLE001
+                with lock:
+                    errors.append(f"{type(exc).__name__}: {exc}")
+
+    threads = [threading.Thread(target=post, args=(s,))
+               for s in range(k["clients"])]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    with urllib.request.urlopen(url + "/health", timeout=10) as resp:
+        health = json.loads(resp.read())
+    p50, p99 = _percentiles(lat)
+    return {"requests": len(lat), "errors": errors[:5],
+            "p50_ms": p50, "p99_ms": p99, "health": health}
+
+
+def next_round(out_dir: str) -> int:
+    n = 0
+    for f in glob.glob(os.path.join(out_dir, "SERVE_r*.json")):
+        m = re.search(r"SERVE_r(\d+)\.json$", os.path.basename(f))
+        if m:
+            n = max(n, int(m.group(1)))
+    return n + 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description="Serving bench (serve/)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny sizes + HTTP leg + assertions; prints one "
+                         "JSON line, writes no artifact (CI leg)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the record as one JSON line, no file")
+    ap.add_argument("--out", default=REPO,
+                    help="artifact directory (default: repo root)")
+    ap.add_argument("--round", type=int, default=0,
+                    help="round number (default: next free SERVE_rN)")
+    args = ap.parse_args(argv)
+    k = knobs(args.smoke)
+
+    import numpy as np
+
+    import jax
+    from lightgbm_tpu import obs
+    from lightgbm_tpu.serve import PredictServer, PredictorSession
+
+    with tempfile.TemporaryDirectory(prefix="serve_bench_") as workdir:
+        if not obs.enabled():
+            # a sink arms the recompile counter; the serve_* events feed
+            # the digest embedded below
+            obs.enable(os.path.join(workdir, "telem"))
+        model_path = build_model(k, workdir)
+        rng = np.random.default_rng(3)
+        F = k["features"]
+        Xpool = np.hstack([rng.normal(size=(4096, F - 1)),
+                           rng.integers(-1, 20, size=(4096, 1)
+                                        ).astype(np.float64)])
+        Xpool[:, :F - 1][rng.random((4096, F - 1)) < 0.05] = np.nan
+
+        compiles0 = obs.counter_value("jax/compiles")
+        sess = PredictorSession(model_path, max_batch=k["max_batch"],
+                                max_wait_ms=2.0)
+        sess.warmup()
+        record = {
+            "kind": "serve", "t": round(time.time(), 1),
+            "backend": jax.default_backend(),
+            "rows": k["rows"], "trees": sess.num_trees,
+            "num_class": sess.num_tpi, "max_batch": sess.max_batch,
+            "warm_compiles": int(obs.counter_value("jax/compiles")
+                                 - compiles0),
+        }
+        record["closed"] = closed_loop(sess, Xpool, k)
+        record["open"] = open_loop(sess, Xpool, k)
+        if args.smoke:
+            server = PredictServer(sess).start()
+            record["http"] = http_smoke(server, Xpool, k)
+            server.stop()
+        st = sess.stats()
+        sess.close()
+        record["compiles"] = int(obs.counter_value("jax/compiles")
+                                 - compiles0)
+        record["compile_bound"] = int(
+            math.ceil(math.log2(max(sess.max_batch, 2)))) + 1
+        record["occupancy"] = st["occupancy"]
+        record["buckets"] = st["buckets"]
+        record["degraded"] = st["degraded"]
+        record["batcher_alive"] = sess._batcher._thread.is_alive()
+
+    if args.smoke:
+        checks = {
+            "p99_recorded": record["closed"]["p99_ms"] is not None,
+            "http_ok": bool(record["http"]["requests"])
+            and not record["http"]["errors"],
+            "health_ok": record["http"]["health"].get("status")
+            in ("ok", "degraded"),
+            "compiles_bounded":
+                record["compiles"] <= record["compile_bound"],
+            "no_errors": record["closed"]["errors"] == 0
+            and record["open"]["failures"] == 0,
+            "not_degraded": not record["degraded"],
+            "clean_shutdown": not record["batcher_alive"],
+        }
+        record["checks"] = checks
+        record["ok"] = all(checks.values())
+        print(json.dumps(record))
+        return 0 if record["ok"] else 1
+
+    n = args.round or next_round(args.out)
+    record["n"] = n
+    if args.json:
+        print(json.dumps(record))
+        return 0
+    path = os.path.join(args.out, f"SERVE_r{n:02d}.json")
+    with open(path, "w") as fh:
+        json.dump(record, fh, indent=1)
+    print(f"# wrote {path}")
+    print(json.dumps({"n": n,
+                      "closed_rows_per_s": record["closed"]["rows_per_s"],
+                      "closed_p99_ms": record["closed"]["p99_ms"],
+                      "open_p99_ms": record["open"]["p99_ms"],
+                      "occupancy": record["occupancy"],
+                      "compiles": record["compiles"]}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -111,6 +111,28 @@ ENV_VARS = [
      "compile_s figure says which kind of compile it measured).  Must "
      "be set before the first `jit` compilation it should capture; "
      "enabling is best-effort (a cache failure never aborts training)."),
+    ("LGBM_TPU_SERVE_MAX_BATCH",
+     "serving-engine override for `tpu_serve_max_batch` (the per-batch "
+     "row cap of `serve.PredictorSession`); lets an operator retune a "
+     "running deployment's batching without editing model/config files. "
+     "`LGBM_TPU_SERVE_MAX_WAIT_MS` and `LGBM_TPU_SERVE_QUEUE_DEPTH` "
+     "override the matching `tpu_serve_*` parameters the same way; an "
+     "explicit constructor argument still wins over the env var."),
+    ("LGBM_TPU_SERVE_MAX_WAIT_MS",
+     "serving-engine override for `tpu_serve_max_wait_ms` — the longest "
+     "the microbatcher holds the oldest queued request while coalescing "
+     "(the latency knob of the latency/throughput trade)."),
+    ("LGBM_TPU_SERVE_QUEUE_DEPTH",
+     "serving-engine override for `tpu_serve_queue_depth` — the queued-"
+     "row bound after which `submit` fails fast with an overload error "
+     "(explicit backpressure instead of unbounded buffering)."),
+    ("LGBM_TPU_PREDICT_MIN_WORK",
+     "CLI `task=predict` routing override: the rows x trees work "
+     "threshold above which value predictions go through the serving "
+     "session (device-resident forest, pow2 buckets) instead of the "
+     "host loop.  `0` forces every predict through the session; a huge "
+     "value forces the host loop.  Unset uses the booster's built-in "
+     "dispatch-overhead heuristic."),
     ("LGBM_TPU_PEAK_FLOPS",
      "override the profile mode's device peak FLOP/s (used with "
      "`LGBM_TPU_PEAK_BW`) when the built-in per-chip table "
@@ -129,7 +151,7 @@ PROFILER_NOTE = (
     "`lgbm/hist_scatter`, `lgbm/hist_wave_xla`, `lgbm/pallas_hist`, "
     "`lgbm/pallas_hist_wave`, `lgbm/wave_hist`, `lgbm/wave_split_phase`, "
     "`lgbm/wave_partition`, `lgbm/split_scan`, `lgbm/tree_traverse`, "
-    "`lgbm/forest_predict`).")
+    "`lgbm/forest_predict`, `lgbm/forest_leaf`).")
 
 
 def main() -> None:

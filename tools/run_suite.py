@@ -18,6 +18,12 @@ The quick tier carries the differential-apply smoke
 (``tests/test_wave_apply.py::test_batched_apply_differential_smoke``):
 every quick run re-proves the batched one-pass wave split apply byte-
 identical to the sequential oracle before any perf number is trusted.
+
+The ``serve`` tier is not a pytest marker: it runs
+``tools/bench_serve.py --smoke`` — start the HTTP server in-process,
+fire concurrent mixed-size requests, assert p99 recorded + the compile
+count bounded by the pow2 bucket set + clean shutdown — so every suite
+round re-proves the serving engine end to end on CPU.
 """
 from __future__ import annotations
 
@@ -95,11 +101,52 @@ def run_tier(tier: str, select: str, timeout: int,
     }
 
 
+def run_serve_smoke(timeout: int, runner=subprocess.run,
+                    py: str = sys.executable) -> dict:
+    """The serve leg: one ``bench_serve.py --smoke`` subprocess; its
+    per-check verdict map becomes this tier's counts."""
+    argv = [py, os.path.join(REPO, "tools", "bench_serve.py"), "--smoke"]
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = env.get("JAX_PLATFORMS", "cpu")
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    t0 = time.time()
+    try:
+        r = runner(argv, env=env, cwd=REPO, timeout=timeout,
+                   capture_output=True, text=True)
+        rc, out, err = r.returncode, r.stdout or "", r.stderr or ""
+    except subprocess.TimeoutExpired:
+        rc, out, err = -1, "", f"timed out after {timeout}s"
+    parsed = None
+    for line in reversed(out.splitlines()):
+        if line.strip().startswith("{"):
+            try:
+                parsed = json.loads(line)
+                break
+            except ValueError:
+                continue
+    checks = (parsed or {}).get("checks") or {}
+    counts = {"passed": sum(1 for v in checks.values() if v),
+              "failed": sum(1 for v in checks.values() if not v)}
+    return {
+        "tier": "serve",
+        "cmd": "tools/bench_serve.py --smoke",
+        "rc": rc,
+        "ok": rc == 0 and bool((parsed or {}).get("ok")),
+        "empty": False,
+        "wall_s": round(time.time() - t0, 1),
+        "counts": counts,
+        "checks": checks,
+        "tail": (out + ("\n" + err if err else "")).splitlines()[-5:],
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="Run the quick/slow test tiers and write SUITE_rN.json")
-    ap.add_argument("--tiers", default="quick,slow",
-                    help="comma list of tier markers (default quick,slow)")
+    ap.add_argument("--tiers", default="quick,slow,serve",
+                    help="comma list of tiers: pytest markers plus the "
+                         "built-in 'serve' smoke leg "
+                         "(default quick,slow,serve)")
     ap.add_argument("--select", default="",
                     help="pytest collection target (file or node id) "
                          "instead of the whole tests/ dir")
@@ -114,9 +161,23 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     tiers = [t.strip() for t in args.tiers.split(",") if t.strip()]
+    if args.select and len(tiers) > 1:
+        # --select narrows pytest collection; the serve smoke is not a
+        # pytest tier, so a narrowed run drops it — unless serve is the
+        # ONLY tier asked for (then it runs, ignoring the selection)
+        tiers = [t for t in tiers if t != "serve"]
     record = {"kind": "suite", "t": round(time.time(), 1), "tiers": {}}
     total = 0.0
     for tier in tiers:
+        if tier == "serve":
+            print("# tier serve: tools/bench_serve.py --smoke ...",
+                  flush=True)
+            res = run_serve_smoke(args.timeout)
+            record["tiers"]["serve"] = res
+            total += res["wall_s"]
+            print(f"# tier serve: rc={res['rc']} {res['counts']} "
+                  f"({res['wall_s']}s)", flush=True)
+            continue
         print(f"# tier {tier}: pytest -m {tier} "
               f"{args.select or 'tests/'} ...", flush=True)
         res = run_tier(tier, args.select, args.timeout)

@@ -18,7 +18,11 @@ capture checklist with health monitoring enabled:
 4. ``tools/prof_kernels.py`` (``PROF_JSON=1``) — the leg decomposition,
    including the wave-partition legs (batched one-pass split apply vs
    the sequential per-split oracle, against ``partition_cost``);
-5. a ``jax.profiler`` trace capture of a short training run.
+5. a ``jax.profiler`` trace capture of a short training run;
+6. ``tools/bench_serve.py --json`` — the serving engine's closed-loop +
+   Poisson open-loop numbers on the live backend, written as
+   ``SERVE_manual_r{N}.json`` (bench_history.py trends it alongside
+   the ``SERVE_r*.json`` CI rounds).
 
 Artifacts (``--out``, default repo root):
 
@@ -62,6 +66,12 @@ _DRY_PROF_ENV = {
     "PROF_INTERPRET": "1", "PROF_ROWS": "4096", "PROF_FEATURES": "6",
     "PROF_LEAVES": "7", "PROF_MAXBIN": "63", "PROF_REPEAT": "1",
     "PROF_LEGS": "kernel,gathers,partition",
+}
+_DRY_SERVE_ENV = {
+    "JAX_PLATFORMS": "cpu",
+    "SERVE_ROWS": "2000", "SERVE_TREES": "20", "SERVE_FEATURES": "8",
+    "SERVE_MAX_BATCH": "128", "SERVE_CLIENTS": "2",
+    "SERVE_DURATION_S": "1.5", "SERVE_RATE": "40",
 }
 
 _TRACE_CODE = """
@@ -119,13 +129,14 @@ def checklist_legs(art_dir: str, dry_run: bool, py: str = sys.executable):
     so the capture certifies itself."""
     bench = os.path.join(REPO, "bench.py")
     prof = os.path.join(REPO, "tools", "prof_kernels.py")
+    serve = os.path.join(REPO, "tools", "bench_serve.py")
     trace_dir = os.path.join(art_dir, "trace")
 
-    def env_for(tag, extra=None, prof_leg=False):
+    def env_for(tag, extra=None, dry_env=None):
         env = {"LGBM_TPU_HEALTH": "monitor",
                "LGBM_TPU_TELEMETRY": os.path.join(art_dir, f"telem_{tag}")}
         if dry_run:
-            env.update(_DRY_PROF_ENV if prof_leg else _DRY_BENCH_ENV)
+            env.update(dry_env if dry_env is not None else _DRY_BENCH_ENV)
         if extra:
             env.update(extra)
         return env
@@ -144,7 +155,11 @@ def checklist_legs(art_dir: str, dry_run: bool, py: str = sys.executable):
          "env": env_for("bench_maxbin63", {"BENCH_MAXBIN": "63"}),
          "parse_json": True},
         {"name": "prof_kernels", "argv": [py, prof],
-         "env": env_for("prof_kernels", {"PROF_JSON": "1"}, prof_leg=True),
+         "env": env_for("prof_kernels", {"PROF_JSON": "1"},
+                        dry_env=_DRY_PROF_ENV),
+         "parse_json": True},
+        {"name": "bench_serve", "argv": [py, serve, "--json"],
+         "env": env_for("bench_serve", dry_env=_DRY_SERVE_ENV),
          "parse_json": True},
         {"name": "trace",
          "argv": [py, "-c", _TRACE_CODE, trace_rows, trace_dir],
@@ -264,6 +279,14 @@ def run_checklist(out_dir: str, n: int, dry_run: bool,
         json.dump(health, fh, indent=1)
     print(f"# wrote {bench_path}")
     print(f"# wrote {health_path}")
+    serve_parsed = (results.get("bench_serve") or {}).get("parsed")
+    if serve_parsed:
+        serve_parsed = dict(serve_parsed, n=n, dry_run=dry_run)
+        serve_path = os.path.join(out_dir, f"SERVE_manual_r{n:02d}.json")
+        with open(serve_path, "w") as fh:
+            json.dump(serve_parsed, fh, indent=1)
+        record["serve_path"] = serve_path
+        print(f"# wrote {serve_path}")
     if bench_parsed:
         print(f"# headline: {bench_parsed.get('value')} "
               f"{bench_parsed.get('unit')} "
