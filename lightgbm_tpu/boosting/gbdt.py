@@ -13,6 +13,7 @@ Correspondence to the reference:
 """
 from __future__ import annotations
 
+import os
 import time
 from typing import Dict, List, Optional, Tuple
 
@@ -400,6 +401,21 @@ class GBDT(PredictorBase):
             obs.enable_health(config.tpu_health)
         self._fp_freq = max(int(getattr(config, "tpu_fingerprint_freq", 1)),
                             0)
+        # trace plane: span emission for iteration phases (same schema
+        # the serving engine uses, so one Perfetto timeline shows both);
+        # the flight ring arms alongside trace/health so a
+        # TrainingHealthError abort leaves a FLIGHT_rN.json post-mortem
+        if getattr(config, "tpu_trace", False):
+            obs.enable_trace()
+        if ((obs.trace_enabled() or obs.health_enabled())
+                and not obs.flight_enabled()):
+            # env override wins, exactly as in serve/session.py — an
+            # explicit LGBM_TPU_FLIGHT=0/false must disable the ring
+            # here too (one shared parser so the synonyms can't drift)
+            obs.enable_flight(obs.flight_len_from_env(
+                getattr(config, "tpu_flight_len", 256)))
+        self._train_trace_id = (obs.new_trace_id(f"train-{os.getpid()}")
+                                if obs.trace_enabled() else None)
 
         self.config = config
         self.train_ds = train_ds
@@ -1114,6 +1130,26 @@ class GBDT(PredictorBase):
     def train_one_iter(self, gradients=None, hessians=None) -> bool:
         """Returns True when training should stop (no splittable leaf)
         (reference: GBDT::TrainOneIter, gbdt.cpp:368-449)."""
+        # trace mode: one iteration span per boosting iteration; the
+        # phase timers inside (timetag) become its children automatically
+        # (obs/spans.py promotes every phase exit to a span), so the
+        # training loop renders as iteration->phases in Perfetto next to
+        # the serving request trees — same schema, one timeline.  The
+        # finally (end_span is idempotent — stop paths close with attrs
+        # first) guarantees an exception unwinding mid-iteration (strict
+        # health abort) can neither lose the aborting iteration's span
+        # nor leak its context onto the thread-local span stack.
+        it_span = (obs.begin_span("train/iteration",
+                                  trace_id=getattr(self, "_train_trace_id",
+                                                   None),
+                                  iteration=self.iter_)
+                   if obs.trace_enabled() else None)
+        try:
+            return self._train_one_iter_inner(gradients, hessians, it_span)
+        finally:
+            obs.end_span(it_span)
+
+    def _train_one_iter_inner(self, gradients, hessians, it_span) -> bool:
         import jax.numpy as jnp
         K = self.num_tpi
         N = self.train_ds.num_data
@@ -1291,6 +1327,7 @@ class GBDT(PredictorBase):
                 if telem:
                     obs.event("train_stop", iteration=self.iter_,
                               reason="no_splits")
+                obs.end_span(it_span, stopped=True)
                 return True
             self._pending_nl = pend_nl
 
@@ -1302,6 +1339,7 @@ class GBDT(PredictorBase):
             if telem:
                 obs.event("train_stop", iteration=self.iter_,
                           reason="no_splits")
+            obs.end_span(it_span, stopped=True)
             return True
         if health_on and self._fp_freq and self.iter_ % self._fp_freq == 0:
             self._health_fingerprint()

@@ -347,6 +347,24 @@ class Config:
                                         # tpu_telemetry); breaks async
                                         # pipelining — attribution runs
                                         # only, never benchmarks
+    tpu_trace: bool = False             # trace mode (obs/spans.py): emit
+                                        # span events (trace_id/span_id/
+                                        # parent_id, one schema for serve
+                                        # requests AND training iteration
+                                        # phases; export to Perfetto with
+                                        # tools/trace_export.py).
+                                        # PROCESS-WIDE once on; like
+                                        # profile mode it sync-brackets
+                                        # phases — attribution, never
+                                        # benchmarks (LGBM_TPU_TRACE env)
+    tpu_flight_len: int = 256           # flight-recorder ring length:
+                                        # the last N spans + operational
+                                        # events kept in memory and
+                                        # dumped as FLIGHT_rN.json on a
+                                        # serve degradation, an overload
+                                        # storm, a TrainingHealthError,
+                                        # or GET /debug/flight; 0
+                                        # disables (LGBM_TPU_FLIGHT env)
 
     # ---- Serving (serve/ subsystem) ----
     tpu_serve_max_batch: int = 1024     # row cap per coalesced device
@@ -367,6 +385,15 @@ class Config:
     tpu_serve_host: str = "127.0.0.1"   # bind address for task=serve
     tpu_serve_port: int = 0             # task=serve HTTP port (0 = pick
                                         # an ephemeral port and log it)
+    tpu_serve_slo_p99_ms: float = 250.0  # serving p99 latency objective:
+                                        # /metrics + /health report the
+                                        # SLO-burn rate against it (the
+                                        # fraction of recent requests
+                                        # over the target divided by the
+                                        # 1% budget a p99 allows; 1.0 =
+                                        # burning at exactly the allowed
+                                        # rate); 0 disables the gauge
+                                        # (LGBM_TPU_SERVE_SLO_P99_MS env)
 
     # ---- derived (not user-settable) ----
     is_parallel: bool = dataclasses.field(default=False, repr=False)
@@ -480,6 +507,10 @@ class Config:
                       "tpu_serve_max_batch")
         if not (0 <= self.tpu_serve_port <= 65535):
             log.fatal("tpu_serve_port should be in [0, 65535]")
+        if self.tpu_serve_slo_p99_ms < 0:
+            log.fatal("tpu_serve_slo_p99_ms should be >= 0")
+        if self.tpu_flight_len < 0:
+            log.fatal("tpu_flight_len should be >= 0")
 
     # ------------------------------------------------------------------
     def num_model_per_iteration(self) -> int:

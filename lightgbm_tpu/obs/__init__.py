@@ -16,6 +16,11 @@ FLOPs/bytes and roofline fractions, plus ``memory_census`` snapshots.
 ``LGBM_TPU_HEALTH=monitor|strict`` (or ``tpu_health``) arms the
 training-health sentinels (``health``): per-iteration numerics guards,
 model-state fingerprints, and the cross-rank divergence audit.
+``LGBM_TPU_TRACE=1`` (or ``tpu_trace``) turns on the span layer
+(``spans``): request/iteration trace events one schema wide, exported to
+Perfetto by ``tools/trace_export.py``; ``LGBM_TPU_FLIGHT=<n>`` (or
+``tpu_flight_len``) sizes the flight recorder ring dumped as
+``FLIGHT_rN.json`` on degradations and health aborts.
 """
 from .core import (TIMETAG_ENABLED, add, count, counter_value,
                    counters_snapshot, current_phase, digest, disable,
@@ -32,6 +37,11 @@ from .memory import snapshot as memory_snapshot
 from .profile import (device_peaks, enable_profile, profile_digest,
                       profile_enabled, record_kernel, roofline_seconds)
 from .profile import wrap as profile_wrap
+from .spans import (Span, begin_span, current_context, emit_span,
+                    enable_flight, enable_trace, end_span, flight_dump,
+                    flight_enabled, flight_len, flight_len_from_env,
+                    flight_snapshot, new_span_id, new_trace_id, span,
+                    span_record_enabled, trace_enabled)
 from .trace import compile_count, compile_seconds, install_recompile_hook
 
 __all__ = [
@@ -48,4 +58,8 @@ __all__ = [
     "TrainingHealthError", "check_gradients", "check_score", "check_tree",
     "divergence_audit", "enable_health", "health_enabled", "health_mode",
     "model_fingerprint",
+    "Span", "begin_span", "current_context", "emit_span", "enable_flight",
+    "enable_trace", "end_span", "flight_dump", "flight_enabled",
+    "flight_len", "flight_len_from_env", "flight_snapshot", "new_span_id",
+    "new_trace_id", "span", "span_record_enabled", "trace_enabled",
 ]

@@ -52,8 +52,29 @@ _cur_phase = ""                 # innermost active phase (collective attr.)
 _atexit_on = False
 _write_warned = False
 _profile_active = False         # set by obs.profile (avoids import cycle)
+_spans_active = False           # set by obs.spans (trace mode)
+_span_phase_hook = None         # obs.spans phase->span promotion hook
+_flight_hook = None             # obs.spans flight-recorder event forward
 _mem_probe = None               # obs.memory per-phase-exit hook
 _reset_hooks = []               # submodule state cleared by reset()
+
+
+def _set_spans_active(on: bool, phase_hook=None) -> None:
+    """Trace mode flips this so phase timers run (and become spans) even
+    without a telemetry sink (obs/spans.py owns the gate; core can't
+    import it — spans imports core)."""
+    global _spans_active, _span_phase_hook
+    _spans_active = bool(on)
+    _span_phase_hook = phase_hook
+    if on:
+        _ensure_atexit()
+
+
+def _set_flight_hook(hook) -> None:
+    """obs/spans.py installs this so operational events reach the flight
+    ring even with no sink configured (one None check when disarmed)."""
+    global _flight_hook
+    _flight_hook = hook
 
 
 def _set_profile_active(on: bool) -> None:
@@ -81,7 +102,8 @@ def enabled() -> bool:
 
 def tracing_enabled() -> bool:
     """True when phase timers accumulate and :func:`sync` blocks."""
-    return TIMETAG_ENABLED or _path is not None or _profile_active
+    return (TIMETAG_ENABLED or _path is not None or _profile_active
+            or _spans_active)
 
 
 def enable(path: str) -> None:
@@ -226,11 +248,22 @@ def event(name: str, **fields) -> None:
     """Append one structured record to the JSONL sink (no-op when
     disabled).  Keep field values JSON-representable; numpy scalars are
     unwrapped automatically."""
-    global _write_warned
+    if _flight_hook is not None:
+        _flight_hook(name, fields)
     if _path is None:
         return
     rec = {"event": name, "t": round(time.time(), 6)}
     rec.update(fields)
+    write_record(rec)
+
+
+def write_record(rec: dict) -> None:
+    """Low-level sink append for a pre-built record (obs/spans.py's span
+    records carry their own ``name``/``t`` fields, which the keyword
+    surface of :func:`event` cannot express).  No-op when disabled."""
+    global _write_warned
+    if _path is None:
+        return
     try:
         _open_sink().write(
             json.dumps(rec, separators=(",", ":"), default=_json_default)
@@ -291,7 +324,7 @@ class phase:
     """Context manager accumulating wall time under ``name`` when tracing
     is enabled (exported as ``utils.timetag.timetag``)."""
 
-    __slots__ = ("name", "t0", "_on", "_prev", "_ta")
+    __slots__ = ("name", "t0", "_t0w", "_on", "_prev", "_ta")
 
     def __init__(self, name: str):
         self.name = name
@@ -306,17 +339,23 @@ class phase:
             self._ta = _trace_annotation(self.name)
             if self._ta is not None:
                 self._ta.__enter__()
+            # trace mode promotes this timer to a span (obs/spans.py);
+            # the span schema wants a wall-clock start
+            self._t0w = time.time() if _span_phase_hook is not None else None
             self.t0 = time.perf_counter()
         return self
 
     def __exit__(self, exc_type=None, exc_value=None, tb=None):
         if self._on:
             global _cur_phase
-            _acc[self.name] += time.perf_counter() - self.t0
+            dur = time.perf_counter() - self.t0
+            _acc[self.name] += dur
             _cnt[self.name] += 1
             _cur_phase = self._prev
             if self._ta is not None:
                 self._ta.__exit__(exc_type, exc_value, tb)
+            if _span_phase_hook is not None and self._t0w is not None:
+                _span_phase_hook(self.name, self._t0w, dur)
             if _mem_probe is not None:
                 # profile mode: per-phase live-byte peak (obs/memory.py)
                 _mem_probe(self.name)

@@ -121,9 +121,18 @@ def _fail(check: str, msg: str, *, phase: str, iteration: int,
     core.event("health", check=check, phase=phase, iteration=iteration,
                ok=False, mode=_mode, detail=detail)
     if _mode == MODE_STRICT:
+        _dump_flight("training_health")
         raise TrainingHealthError(msg)
     log.warning("HEALTH: %s", msg)
     return False
+
+
+def _dump_flight(reason: str) -> None:
+    """Before a health abort, persist the flight ring — the last N
+    iteration/health events ARE the post-mortem for the abort."""
+    from .spans import flight_dump, flight_enabled
+    if flight_enabled():
+        flight_dump(reason)
 
 
 # ---------------------------------------------------------------------------
@@ -364,6 +373,7 @@ def divergence_audit(stats: np.ndarray, *, iteration: int) -> bool:
            f"rank(s) {bad} disagree with the majority fingerprint "
            f"(digests {digests}); worst stat index {worst} spreads "
            f"{spread[worst]:g} across ranks")
+    _dump_flight("divergence")
     raise TrainingHealthError(msg)
 
 

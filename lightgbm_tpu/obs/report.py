@@ -145,6 +145,9 @@ def summarize(events: List[dict]) -> dict:
     serve = serve_summary(events)
     if serve:
         out["serve"] = serve
+    trace = trace_summary(events)
+    if trace:
+        out["trace"] = trace
     return out
 
 
@@ -310,6 +313,28 @@ def serve_summary(events: List[dict]) -> dict:
     return out
 
 
+def trace_summary(events: List[dict]) -> dict:
+    """Fold ``span`` events (obs/spans.py) into the trace digest:
+    span/trace counts and per-name call/duration aggregates.  Empty when
+    the run traced nothing.  ``tools/trace_export.py`` turns the same
+    events into a Perfetto-loadable timeline."""
+    spans = [e for e in events if e.get("event") == "span"]
+    if not spans:
+        return {}
+    by_name = {}
+    traces = set()
+    for e in spans:
+        traces.add(e.get("trace_id"))
+        a = by_name.setdefault(e.get("name", "?"),
+                               {"calls": 0, "total_ms": 0.0})
+        a["calls"] += 1
+        a["total_ms"] += float(e.get("dur_ms", 0.0) or 0.0)
+    for a in by_name.values():
+        a["total_ms"] = round(a["total_ms"], 3)
+    return {"spans": len(spans), "traces": len(traces),
+            "by_name": dict(sorted(by_name.items()))}
+
+
 # ---------------------------------------------------------------------------
 # Event schemas — the CI smoke validates profile-mode streams against these
 # ---------------------------------------------------------------------------
@@ -382,6 +407,22 @@ EVENT_SCHEMAS = {
     "serve_overload": {
         "rows": (int, True),
         "queue_rows": (int, True),
+    },
+    # trace plane (obs/spans.py) + the HTTP access log (serve/server.py)
+    "span": {
+        "name": (str, True),
+        "trace_id": (str, True),
+        "span_id": (str, True),
+        "parent_id": (str, False),
+        "dur_ms": (_NUM, True),
+        "attrs": (dict, False),
+    },
+    "serve_access": {
+        "method": (str, True),
+        "path": (str, True),
+        "status": (int, True),
+        "latency_ms": (_NUM, True),
+        "trace_id": (str, True),
     },
 }
 
@@ -510,6 +551,16 @@ def render(digest: dict) -> str:
         if s.get("overloads") or s.get("deadline_missed"):
             out.append(f"  overloads {s.get('overloads', 0)}, deadline "
                        f"misses {s.get('deadline_missed', 0)}")
+    if digest.get("trace"):
+        t = digest["trace"]
+        out.append("")
+        out.append(f"trace plane: {t['spans']} span(s) across "
+                   f"{t['traces']} trace(s) — export with "
+                   f"tools/trace_export.py")
+        for name, a in sorted(t["by_name"].items(),
+                              key=lambda kv: -kv[1]["total_ms"])[:8]:
+            out.append(f"  {name:<28} {a['calls']:>6} calls "
+                       f"{a['total_ms']:>10.1f} ms")
     if digest["counters"]:
         out.append("")
         out.append("counters:")

@@ -11,12 +11,17 @@ microbatcher behind a threaded HTTP front end.
 - ``session``  — ``PredictorSession``: sync ``predict`` + async
   ``submit``/``result`` over the microbatcher
 - ``batcher``  — request coalescing, power-of-two padding, backpressure
-- ``server``   — JSON-over-HTTP front end with deadlines + /health
+- ``server``   — JSON-over-HTTP front end with deadlines + /health,
+  /metrics (Prometheus), /stats, /debug/flight
+- ``metrics``  — lock-cheap counters/histogram + SLO-burn behind
+  /metrics, with the minimal text-format parser for reading it back
 """
 from .batcher import DeadlineExceeded, MicroBatcher, ServeOverloadError
+from .metrics import ServeMetrics, parse_prometheus
 from .packing import ServeBinSpace
 from .server import PredictServer
 from .session import PredictorSession
 
 __all__ = ["DeadlineExceeded", "MicroBatcher", "PredictServer",
-           "PredictorSession", "ServeBinSpace", "ServeOverloadError"]
+           "PredictorSession", "ServeBinSpace", "ServeMetrics",
+           "ServeOverloadError", "parse_prometheus"]

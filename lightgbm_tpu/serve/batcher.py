@@ -35,17 +35,25 @@ class Request:
     """One queued prediction request: binned rows for the device path,
     the raw rows kept alongside for host-fallback degradation.
     Request-level accounting lives in the session's ``result()`` (one
-    count per ticket); this carries only the batching state."""
+    count per ticket); this carries only the batching state plus the
+    trace context (trace_id minted at the HTTP edge, parent_id = the
+    request's root span) the session's span emission attributes to."""
 
-    __slots__ = ("bins", "raw", "n", "future", "deadline", "t_submit")
+    __slots__ = ("bins", "raw", "n", "future", "deadline", "t_submit",
+                 "t_submit_wall", "trace_id", "parent_id")
 
-    def __init__(self, bins, raw, deadline: Optional[float] = None):
+    def __init__(self, bins, raw, deadline: Optional[float] = None,
+                 trace_id: Optional[str] = None,
+                 parent_id: Optional[str] = None):
         self.bins = bins
         self.raw = raw
         self.n = int(bins.shape[0])
         self.future: Future = Future()
         self.deadline = deadline        # absolute time.monotonic() or None
         self.t_submit = time.monotonic()
+        self.t_submit_wall = time.time()  # span timestamps are wall clock
+        self.trace_id = trace_id
+        self.parent_id = parent_id
 
 
 class MicroBatcher:

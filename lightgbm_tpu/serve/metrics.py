@@ -1,0 +1,198 @@
+"""Live serving metrics: counters + a fixed-bucket latency histogram,
+exposed as Prometheus text (``GET /metrics``) and JSON (``GET /stats``).
+
+Lock-cheap by construction: every observation is a handful of integer
+bumps under one small lock plus a bounded ring append — no JSONL
+readback, no sort on the hot path (percentile-ish questions are answered
+from the fixed histogram buckets and the recent-window ring at SCRAPE
+time).  The SLO-burn gauge follows the standard error-budget framing:
+with a p99 objective of ``slo_p99_ms``, 1% of requests are allowed over
+the target; ``slo_burn`` is (observed over-target fraction in the recent
+window) / 1%, so 1.0 means burning budget exactly at the allowed rate
+and >1 means the SLO is being violated.
+
+``parse_prometheus`` is the minimal text-format parser the tests and
+``tools/bench_serve.py`` share to read the exposition back.
+"""
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Dict, Optional
+
+# Prometheus-convention cumulative buckets, in milliseconds.  Fixed at
+# import so every replica's histograms aggregate; +Inf is implicit.
+LATENCY_BUCKETS_MS = (1.0, 2.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
+                      500.0, 1000.0, 2500.0, 5000.0)
+
+_SLO_WINDOW = 1024     # recent requests the burn gauge is computed over
+_ERROR_BUDGET = 0.01   # a p99 objective tolerates 1% over-target
+
+
+class ServeMetrics:
+    """Request-level counters for one serving session."""
+
+    def __init__(self, slo_p99_ms: float = 0.0):
+        self.slo_p99_ms = max(float(slo_p99_ms or 0.0), 0.0)
+        self._lock = threading.Lock()
+        self._buckets = [0] * (len(LATENCY_BUCKETS_MS) + 1)  # last = +Inf
+        self._lat_sum = 0.0
+        self._lat_count = 0
+        self._ok = 0
+        self._failed = 0
+        self._status: Dict[int, int] = {}
+        self._recent = deque(maxlen=_SLO_WINDOW)
+
+    # ---- hot path ----------------------------------------------------
+    def observe(self, latency_ms: float, ok: bool = True) -> None:
+        """Account one finished request (any outcome)."""
+        ms = float(latency_ms)
+        i = 0
+        for b in LATENCY_BUCKETS_MS:
+            if ms <= b:
+                break
+            i += 1
+        with self._lock:
+            self._buckets[i] += 1
+            self._lat_sum += ms
+            self._lat_count += 1
+            if ok:
+                self._ok += 1
+            else:
+                self._failed += 1
+            self._recent.append(ms)
+
+    def count_status(self, code: int) -> None:
+        """Bump the HTTP-status counter (server front end only)."""
+        code = int(code)
+        with self._lock:
+            self._status[code] = self._status.get(code, 0) + 1
+
+    # ---- scrape time -------------------------------------------------
+    def slo_burn(self) -> Optional[float]:
+        """Error-budget burn rate over the recent window (None when no
+        SLO is configured, 0.0 when nothing was served yet)."""
+        if not self.slo_p99_ms:
+            return None
+        with self._lock:
+            recent = list(self._recent)
+        if not recent:
+            return 0.0
+        over = sum(1 for v in recent if v > self.slo_p99_ms)
+        return round((over / len(recent)) / _ERROR_BUDGET, 3)
+
+    def snapshot(self) -> dict:
+        burn = self.slo_burn()
+        with self._lock:
+            cum, total = [], 0
+            for c in self._buckets:
+                total += c
+                cum.append(total)
+            return {
+                "latency_buckets_ms": list(LATENCY_BUCKETS_MS),
+                "latency_cumulative": cum,
+                "latency_sum_ms": round(self._lat_sum, 3),
+                "latency_count": self._lat_count,
+                "ok": self._ok,
+                "failed": self._failed,
+                "status": dict(sorted(self._status.items())),
+                "slo_p99_ms": self.slo_p99_ms or None,
+                "slo_burn": burn,
+            }
+
+
+def _fmt(v) -> str:
+    if v is None:
+        return "0"
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, float) and v.is_integer():
+        return str(int(v))
+    return repr(v) if isinstance(v, float) else str(v)
+
+
+def render_prometheus(session) -> str:
+    """Prometheus text exposition for one session (its ``ServeMetrics``
+    plus the live gauges out of ``session.stats()``)."""
+    m: ServeMetrics = session.metrics
+    snap = m.snapshot()
+    st = session.stats()
+    out = []
+
+    def head(name, kind, help_):
+        out.append(f"# HELP {name} {help_}")
+        out.append(f"# TYPE {name} {kind}")
+
+    head("tpu_serve_requests_total", "counter",
+         "Requests by HTTP status (front end).")
+    for code, n in (snap["status"] or {200: 0}).items():
+        out.append('tpu_serve_requests_total{status="%s"} %d' % (code, n))
+    head("tpu_serve_session_requests_total", "counter",
+         "Session-level requests by outcome.")
+    out.append('tpu_serve_session_requests_total{outcome="ok"} %d'
+               % snap["ok"])
+    out.append('tpu_serve_session_requests_total{outcome="failed"} %d'
+               % snap["failed"])
+    head("tpu_serve_request_latency_ms", "histogram",
+         "Request latency (submit to result), milliseconds.")
+    for b, c in zip(LATENCY_BUCKETS_MS, snap["latency_cumulative"]):
+        out.append('tpu_serve_request_latency_ms_bucket{le="%g"} %d'
+                   % (b, c))
+    out.append('tpu_serve_request_latency_ms_bucket{le="+Inf"} %d'
+               % snap["latency_count"])
+    out.append("tpu_serve_request_latency_ms_sum %s"
+               % _fmt(snap["latency_sum_ms"]))
+    out.append("tpu_serve_request_latency_ms_count %d"
+               % snap["latency_count"])
+
+    gauges = (
+        ("tpu_serve_queue_rows", "gauge", "Rows waiting in the batcher "
+         "queue.", st.get("queue_rows")),
+        ("tpu_serve_batch_occupancy", "gauge", "Real rows / padded rows "
+         "over the session lifetime.", st.get("occupancy")),
+        ("tpu_serve_pad_waste_rows_total", "counter", "Padded minus real "
+         "rows dispatched to the device.",
+         max(int(st.get("padded_rows") or 0) - int(st.get("rows") or 0), 0)),
+        ("tpu_serve_batches_total", "counter", "Device/host batches "
+         "executed.", st.get("batches")),
+        ("tpu_serve_rows_total", "counter", "Real rows scored.",
+         st.get("rows")),
+        ("tpu_serve_overloads_total", "counter", "Submits rejected by "
+         "backpressure.", st.get("overloads")),
+        ("tpu_serve_deadline_missed_total", "counter", "Requests expired "
+         "in queue.", st.get("deadline_missed")),
+        ("tpu_serve_recompiles_total", "counter", "XLA compiles since "
+         "the session started.", st.get("compile_count")),
+        ("tpu_serve_degraded", "gauge", "1 when the session fell back to "
+         "the host predictor.", bool(st.get("degraded"))),
+        ("tpu_serve_uptime_seconds", "gauge", "Seconds since the session "
+         "packed its model.", st.get("uptime_s")),
+        ("tpu_serve_slo_p99_ms", "gauge", "Configured p99 latency "
+         "objective (tpu_serve_slo_p99_ms).", m.slo_p99_ms or 0.0),
+        ("tpu_serve_slo_burn", "gauge", "Error-budget burn rate vs the "
+         "p99 objective (1.0 = at budget).", snap["slo_burn"]),
+    )
+    for name, kind, help_, v in gauges:
+        head(name, kind, help_)
+        out.append(f"{name} {_fmt(v)}")
+    return "\n".join(out) + "\n"
+
+
+def parse_prometheus(text: str) -> Dict[str, float]:
+    """Minimal Prometheus text parser: ``{'name{labels}': value}`` (and
+    bare ``name`` for label-less samples).  Enough to assert on an
+    exposition in tests and to embed a scrape in a bench artifact."""
+    out: Dict[str, float] = {}
+    for line in (text or "").splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        try:
+            key, val = line.rsplit(None, 1)
+        except ValueError:
+            continue
+        try:
+            out[key] = float(val)
+        except ValueError:
+            continue
+    return out

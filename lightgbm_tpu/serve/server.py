@@ -5,8 +5,23 @@ Stdlib ``http.server`` only — no new dependencies.  Protocol:
     POST /predict   body {"rows": [[...], ...], "raw_score": false,
                           "deadline_ms": 250}
                  -> 200 {"predictions": [...], "rows": N,
-                         "latency_ms": ...}
-    GET  /health -> 200 {"status": "ok"|"degraded", ...session stats...}
+                         "latency_ms": ..., "trace_id": ...}
+    GET  /health       -> 200 {"status": "ok"|"degraded", queue_rows,
+                               uptime_s, compile_count, slo_burn,
+                               ...session stats...}
+    GET  /metrics      -> 200 Prometheus text (request counts by status,
+                               latency histogram, queue depth, occupancy,
+                               pad waste, recompiles, degraded gauge,
+                               SLO-burn) — scrape-cheap, no JSONL readback
+    GET  /stats        -> 200 the same numbers as JSON
+    GET  /debug/flight -> 200 the flight-recorder ring (last N spans +
+                               operational events), the live post-mortem
+
+Every request gets a trace id at this edge — an incoming
+``X-Request-Id`` header is honored (sanitized) and echoed back — and the
+id rides through the batcher so the whole
+queue->coalesce->pad->execute span chain carries it (obs/spans.py).
+Replies that served a prediction carry the id in the JSON body too.
 
 Error mapping (all JSON bodies with an ``error`` field):
 
@@ -19,7 +34,8 @@ Error mapping (all JSON bodies with an ``error`` field):
 When the device backend dies mid-flight the SESSION degrades to the
 host numpy predictor (serve/session.py) — requests keep succeeding and
 ``/health`` flips to ``"degraded"`` so a load balancer can drain the
-replica gracefully instead of seeing a wall of 500s.
+replica gracefully instead of seeing a wall of 500s (and the flight
+recorder dumps ``FLIGHT_rN.json`` with the moments before the flip).
 """
 from __future__ import annotations
 
@@ -34,6 +50,7 @@ import numpy as np
 from .. import obs
 from ..utils import log
 from .batcher import DeadlineExceeded, ServeOverloadError
+from .metrics import render_prometheus
 
 # grace added to a request's own deadline before the HTTP thread gives
 # up waiting on the batcher (the batch may be mid-flight on the device)
@@ -41,37 +58,114 @@ _REPLY_GRACE_S = 30.0
 _DEFAULT_REPLY_TIMEOUT_S = 120.0
 
 
+def _json_safe(o):
+    try:
+        return o.item()  # numpy / jax scalars
+    except Exception:  # noqa: BLE001
+        return repr(o)
+
+
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
 
-    # stay quiet on stderr; the obs serve_* event stream is the record
+    # stderr stays silent; the structured ``serve_access`` telemetry
+    # event (log_request below) is the access log when a sink is on
     def log_message(self, fmt, *args):  # noqa: A002
         pass
 
+    def log_request(self, code="-", size="-"):
+        """http.server's per-response hook (send_response calls it):
+        one ``serve_access`` event per reply — status, latency, trace id
+        — instead of the stderr line.  A no-telemetry run stays silent
+        (obs.event gates itself)."""
+        try:
+            status = int(getattr(code, "value", code))
+        except (TypeError, ValueError):
+            status = 0
+        t0 = getattr(self, "_t0", None)
+        # malformed/over-long request lines error out before the base
+        # handler ever assigns self.path/command — getattr everything.
+        # Normalized exactly like the route dispatch (query stripped,
+        # trailing slash dropped) so the flight ring's scrape-path
+        # filter sees the same string the router matched.
+        path = str(getattr(self, "path", "") or "?").split("?")[0]
+        obs.event("serve_access",
+                  method=str(getattr(self, "command", "") or "?"),
+                  path=path.rstrip("/") or path[:1] or "?",
+                  status=status,
+                  latency_ms=(round((time.perf_counter() - t0) * 1e3, 3)
+                              if t0 is not None else 0.0),
+                  trace_id=getattr(self, "_trace_id", None) or "-")
+
+    def _begin(self) -> None:
+        """Per-request edge state: wall/perf start + the trace id (an
+        incoming ``X-Request-Id`` is honored, else minted here)."""
+        self._t0 = time.perf_counter()
+        self._t0_wall = time.time()
+        self._trace_id = obs.new_trace_id(self.headers.get("X-Request-Id"))
+        self._status = None
+
+    def _end(self) -> None:
+        """Clear the per-request edge state.  On a keep-alive connection
+        the handler instance persists across requests, and a malformed
+        follow-up request errors out BEFORE do_GET/do_POST (and _begin)
+        run — without this, its access-log line would reuse the previous
+        request's trace id and measure latency from its start."""
+        self._t0 = None
+        self._trace_id = None
+
     def _reply(self, code: int, payload: dict) -> None:
-        body = json.dumps(payload).encode()
+        body = json.dumps(payload, default=_json_safe).encode()
+        self._reply_bytes(code, body, "application/json")
+
+    def _reply_bytes(self, code: int, body: bytes, ctype: str) -> None:
+        self._status = code
+        self.server.session.metrics.count_status(code)
         self.send_response(code)
-        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
+        if getattr(self, "_trace_id", None):
+            self.send_header("X-Request-Id", self._trace_id)
         self.end_headers()
         self.wfile.write(body)
 
     def do_GET(self):  # noqa: N802 — http.server API
-        if self.path.split("?")[0].rstrip("/") in ("", "/health"):
+        self._begin()
+        try:
             sess = self.server.session
-            st = sess.stats()
-            st["status"] = "degraded" if st.get("degraded") else "ok"
-            st["health_mode"] = obs.health_mode() or "off"
-            self._reply(200, st)
-        else:
-            self._reply(404, {"error": "not_found", "path": self.path})
+            path = self.path.split("?")[0].rstrip("/")
+            if path in ("", "/health"):
+                st = sess.stats()
+                st["status"] = "degraded" if st.get("degraded") else "ok"
+                st["health_mode"] = obs.health_mode() or "off"
+                self._reply(200, st)
+            elif path == "/metrics":
+                self._reply_bytes(200, render_prometheus(sess).encode(),
+                                  "text/plain; version=0.0.4")
+            elif path == "/stats":
+                self._reply(200, {"stats": sess.stats(),
+                                  "metrics": sess.metrics.snapshot()})
+            elif path == "/debug/flight":
+                self._reply(200, {"enabled": obs.flight_enabled(),
+                                  "ring_len": obs.flight_len(),
+                                  "events": obs.flight_snapshot()})
+            else:
+                self._reply(404, {"error": "not_found", "path": self.path})
+        finally:
+            self._end()
 
     def do_POST(self):  # noqa: N802 — http.server API
+        self._begin()
         if self.path.split("?")[0].rstrip("/") != "/predict":
-            self._reply(404, {"error": "not_found", "path": self.path})
+            try:
+                self._reply(404, {"error": "not_found", "path": self.path})
+            finally:
+                self._end()
             return
         sess = self.server.session
-        t0 = time.perf_counter()
+        t0 = self._t0
+        root_id = (obs.new_span_id() if obs.span_record_enabled()
+                   else None)
         try:
             length = int(self.headers.get("Content-Length", 0) or 0)
             payload = json.loads(self.rfile.read(length) or b"{}")
@@ -81,7 +175,9 @@ class _Handler(BaseHTTPRequestHandler):
             X = np.asarray(rows, dtype=np.float64)
             deadline_ms = payload.get("deadline_ms")
             ticket = sess.submit(X, deadline_ms=deadline_ms,
-                                 raw_score=bool(payload.get("raw_score")))
+                                 raw_score=bool(payload.get("raw_score")),
+                                 trace_id=self._trace_id,
+                                 parent_id=root_id)
             wait_s = (float(deadline_ms) / 1e3 + _REPLY_GRACE_S
                       if deadline_ms is not None
                       else _DEFAULT_REPLY_TIMEOUT_S)
@@ -90,6 +186,7 @@ class _Handler(BaseHTTPRequestHandler):
                 "predictions": np.asarray(pred).tolist(),
                 "rows": int(ticket.rows),
                 "latency_ms": round((time.perf_counter() - t0) * 1e3, 3),
+                "trace_id": self._trace_id,
             })
         except ServeOverloadError as exc:
             self._reply(503, {"error": "overloaded", "detail": str(exc)})
@@ -101,6 +198,16 @@ class _Handler(BaseHTTPRequestHandler):
         except Exception as exc:  # noqa: BLE001 — HTTP thread must reply
             self._reply(500, {"error": type(exc).__name__,
                               "detail": str(exc)})
+        finally:
+            if root_id is not None:
+                # the request's root span: the whole HTTP handling wall
+                # time, parent of the queue/coalesce/pad/execute chain
+                obs.emit_span(
+                    "serve/request", self._t0_wall,
+                    (time.perf_counter() - t0) * 1e3, self._trace_id,
+                    span_id=root_id,
+                    attrs={"status": self._status, "path": "/predict"})
+            self._end()
 
 
 class PredictServer:
@@ -131,7 +238,8 @@ class PredictServer:
             target=self._httpd.serve_forever, name="lgbm-serve-http",
             daemon=True)
         self._thread.start()
-        log.info("serving %d trees on %s (POST /predict, GET /health)",
+        log.info("serving %d trees on %s (POST /predict, GET /health "
+                 "/metrics /stats /debug/flight)",
                  self.session.num_trees, self.url)
         return self
 

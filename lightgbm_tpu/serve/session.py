@@ -30,6 +30,7 @@ from __future__ import annotations
 import os
 import threading
 import time
+from collections import deque
 from concurrent.futures import InvalidStateError
 from typing import Optional
 
@@ -40,9 +41,18 @@ from ..config import Config
 from ..utils import log
 from .batcher import (DeadlineExceeded, MicroBatcher, Request,
                       ServeOverloadError)
+from .metrics import ServeMetrics
 from .packing import ServeBinSpace
 
 _LAT_RESERVOIR = 8192  # latency samples kept for the p50/p99 estimate
+
+# an overload STORM (>= _STORM_N rejects inside _STORM_WINDOW_S) dumps
+# the flight ring once per _STORM_COOLDOWN_S — the post-mortem for "why
+# did the queue blow up", rate-limited so a sustained storm writes one
+# artifact, not thousands
+_STORM_N = 16
+_STORM_WINDOW_S = 5.0
+_STORM_COOLDOWN_S = 60.0
 
 
 def _safe_resolve(future, result=None, error=None) -> None:
@@ -168,6 +178,21 @@ class PredictorSession:
         self._real_rows = 0
         self._padded_rows = 0
         self._buckets: set = set()
+        # ---- observability: live metrics + trace plane ---------------
+        self._t_start = time.time()
+        obs.install_recompile_hook()
+        self._compiles0 = obs.compile_count()
+        self.slo_p99_ms = float(_env_num(
+            "LGBM_TPU_SERVE_SLO_P99_MS", float,
+            getattr(config, "tpu_serve_slo_p99_ms", 250.0)))
+        self.metrics = ServeMetrics(slo_p99_ms=self.slo_p99_ms)
+        if getattr(config, "tpu_trace", False):
+            obs.enable_trace()
+        if not obs.flight_enabled():
+            obs.enable_flight(obs.flight_len_from_env(
+                getattr(config, "tpu_flight_len", 256)))
+        self._overload_times: deque = deque(maxlen=_STORM_N)
+        self._last_flight_dump = None  # monotonic() of the last dump
         self._batcher = MicroBatcher(
             self._execute_batch, max_batch=self.max_batch,
             max_wait_s=self.max_wait_ms / 1e3,
@@ -200,31 +225,53 @@ class PredictorSession:
             b *= 2
         return min(b, self.max_batch)
 
-    def _run_device(self, bins: np.ndarray):
+    def _run_device(self, bins: np.ndarray, span_ctx=None):
         """Pad to the pow2 bucket, run the jitted scan, slice the pad
-        off.  Returns ([n, K] f64 raw scores, bucket)."""
+        off.  Returns ([n, K] f64 raw scores, bucket).  ``span_ctx`` is
+        a list of (trace_id, parent_id) pairs to attribute the pad /
+        device-execute spans to (one pair per member request — the batch
+        phases are shared, the trace trees are per request)."""
         import jax.numpy as jnp
         n = bins.shape[0]
+        t_pad0 = time.time()
         b = self._bucket(n)
         if b > n:
             bins = np.concatenate(
                 [bins, np.zeros((b - n, bins.shape[1]), bins.dtype)])
         with self._lock:
             self._buckets.add(b)
-        out = self._device_fn(self.forest, jnp.asarray(bins))
+        arr = jnp.asarray(bins)
+        t_exec0 = time.time()
+        out = self._device_fn(self.forest, arr)
         raw = np.asarray(out, dtype=np.float64)[:n]
         if self.average_factor:
             raw /= self.average_factor
+        if span_ctx:
+            t_end = time.time()
+            for tid, pid in span_ctx:
+                obs.emit_span("serve/pad", t_pad0, (t_exec0 - t_pad0) * 1e3,
+                              tid, parent_id=pid,
+                              attrs={"rows": n, "bucket": b})
+                obs.emit_span("serve/device_execute", t_exec0,
+                              (t_end - t_exec0) * 1e3, tid, parent_id=pid,
+                              attrs={"bucket": b})
         return raw, b
 
-    def _run_host(self, X: np.ndarray) -> np.ndarray:
+    def _run_host(self, X: np.ndarray, span_ctx=None) -> np.ndarray:
         """Degraded path: per-tree value-space traversal on the host."""
+        t0 = time.time()
         K = self.num_tpi
         out = np.zeros((X.shape[0], K))
         for i, tree in enumerate(self._trees):
             out[:, i % K] += tree.predict(X)
         if self.average_factor:
             out /= self.average_factor
+        if span_ctx:
+            dur = (time.time() - t0) * 1e3
+            for tid, pid in span_ctx:
+                obs.emit_span("serve/host_fallback", t0, dur, tid,
+                              parent_id=pid,
+                              attrs={"rows": int(X.shape[0])})
         return out
 
     def _note_degraded(self, exc: BaseException) -> None:
@@ -235,6 +282,24 @@ class PredictorSession:
                         type(exc).__name__, exc)
             obs.event("serve_degraded",
                       error=f"{type(exc).__name__}: {exc}")
+            # the flip is exactly what the flight recorder exists for:
+            # persist the last N spans/events leading up to it.  force=
+            # True: degradation happens at most once per session, so the
+            # storm cooldown must never swallow ITS post-mortem
+            self._flight_dump("serve_degraded", force=True)
+
+    def _flight_dump(self, reason: str, force: bool = False) -> None:
+        """Rate-limited flight-ring dump (no-op when the ring is off).
+        ``force`` bypasses the cooldown for one-shot events whose dump
+        must not be suppressed by an earlier storm's."""
+        now = time.monotonic()
+        with self._lock:
+            if (not force and self._last_flight_dump is not None
+                    and now - self._last_flight_dump < _STORM_COOLDOWN_S):
+                return
+            self._last_flight_dump = now
+        if obs.flight_enabled():
+            obs.flight_dump(reason, extra={"stats": self.stats()})
 
     def _convert(self, raw: np.ndarray, raw_score: bool) -> np.ndarray:
         squeezed = raw if self.num_tpi > 1 else raw[:, 0]
@@ -265,14 +330,20 @@ class PredictorSession:
 
     # ------------------------------------------------------------------
     def submit(self, X, deadline_ms: Optional[float] = None,
-               raw_score: bool = False) -> Ticket:
+               raw_score: bool = False, trace_id: Optional[str] = None,
+               parent_id: Optional[str] = None) -> Ticket:
         """Queue rows for the next coalesced batch.  Raises
         ``ServeOverloadError`` when the bounded queue is full (explicit
         backpressure).  Oversize submissions are chunked to the batch
-        cap; a chunk is never split across device batches."""
+        cap; a chunk is never split across device batches.  ``trace_id``
+        /``parent_id`` thread the request's trace context through the
+        batcher (the HTTP edge mints them from ``X-Request-Id``); a
+        direct caller gets a fresh trace id when recording is on."""
         X = self._check_input(X)
         if self._closed:
             raise RuntimeError("session is closed")
+        if trace_id is None and obs.span_record_enabled():
+            trace_id = obs.new_trace_id()
         deadline = (time.monotonic() + float(deadline_ms) / 1e3
                     if deadline_ms is not None else None)
         parts = []
@@ -280,15 +351,24 @@ class PredictorSession:
             for lo in range(0, max(X.shape[0], 1), self.max_batch):
                 chunk = X[lo:lo + self.max_batch]
                 req = Request(self.space.bin_matrix(chunk), chunk,
-                              deadline=deadline)
+                              deadline=deadline, trace_id=trace_id,
+                              parent_id=parent_id)
                 parts.append((self._batcher.submit(req), chunk.shape[0]))
         except ServeOverloadError:
+            storm = False
+            now = time.monotonic()
             with self._lock:
                 self._n_overload += 1
+                self._overload_times.append(now)
+                storm = (len(self._overload_times) == _STORM_N
+                         and now - self._overload_times[0]
+                         <= _STORM_WINDOW_S)
             obs.event("serve_overload", rows=int(X.shape[0]),
                       queue_rows=self._batcher.queue_rows)
             for fut, _ in parts:  # a partially queued ticket must not leak
                 fut.cancel()
+            if storm:
+                self._flight_dump("overload_storm")
             raise
         return Ticket(parts, int(X.shape[0]), raw_score)
 
@@ -324,14 +404,14 @@ class PredictorSession:
         ticket.counted = True
         reason = ("deadline" if isinstance(exc, DeadlineExceeded)
                   else type(exc).__name__)
+        total_ms = (time.perf_counter() - ticket.t0) * 1e3
         with self._lock:
             self._n_req += 1
             if reason == "deadline":
                 self._n_deadline += 1
+        self.metrics.observe(total_ms, ok=False)
         obs.event("serve_request", rows=int(ticket.rows),
-                  total_ms=round((time.perf_counter() - ticket.t0) * 1e3,
-                                 3),
-                  ok=False, reason=reason)
+                  total_ms=round(total_ms, 3), ok=False, reason=reason)
 
     # ------------------------------------------------------------------
     def _execute_batch(self, reqs) -> None:
@@ -356,6 +436,28 @@ class PredictorSession:
         if not live:
             return
         rows = sum(r.n for r in live)
+        span_ctx = None
+        if obs.span_record_enabled():
+            # queue-wait + coalesce spans per member request: the batch
+            # phases are shared wall time, but each request's trace tree
+            # must carry the whole queue->coalesce->pad->execute chain
+            t_dispatch = time.time()
+            span_ctx = []
+            for r in live:
+                tid = r.trace_id or obs.new_trace_id()
+                obs.emit_span("serve/queue_wait", r.t_submit_wall,
+                              (now - r.t_submit) * 1e3, tid,
+                              parent_id=r.parent_id,
+                              attrs={"rows": r.n})
+                # the coalesce span starts at THIS request's submit, not
+                # the batch's oldest member — a child slice must not
+                # begin before its root span nor charge other requests'
+                # wait to this trace
+                obs.emit_span("serve/coalesce", r.t_submit_wall,
+                              max(t_dispatch - r.t_submit_wall, 0.0)
+                              * 1e3, tid, parent_id=r.parent_id,
+                              attrs={"requests": len(live), "rows": rows})
+                span_ctx.append((tid, r.parent_id))
         t0 = time.perf_counter()
         degraded = self._degraded
         raw, bucket = None, rows
@@ -363,13 +465,22 @@ class PredictorSession:
             try:
                 bins = (live[0].bins if len(live) == 1
                         else np.concatenate([r.bins for r in live]))
-                raw, bucket = self._run_device(bins)
+                raw, bucket = self._run_device(bins, span_ctx=span_ctx)
             except Exception as exc:  # noqa: BLE001 — degrade, don't fail
                 self._note_degraded(exc)
                 degraded = True
         if degraded:
-            raw = np.concatenate([self._run_host(r.raw) for r in live]) \
-                if len(live) > 1 else self._run_host(live[0].raw)
+            raw = (np.concatenate([self._run_host(r.raw) for r in live])
+                   if len(live) > 1
+                   else self._run_host(live[0].raw, span_ctx=span_ctx))
+            if span_ctx and len(live) > 1:
+                # chunk-level spans would mis-attribute across requests;
+                # one fallback span per request trace instead
+                t_end = time.time()
+                for tid, pid in span_ctx:
+                    obs.emit_span("serve/host_fallback", t_dispatch,
+                                  (t_end - t_dispatch) * 1e3, tid,
+                                  parent_id=pid, attrs={"rows": rows})
         exec_ms = (time.perf_counter() - t0) * 1e3
         off = 0
         for r in live:
@@ -401,6 +512,7 @@ class PredictorSession:
             self._lat_ms.append(total_ms)
             if len(self._lat_ms) > _LAT_RESERVOIR:
                 del self._lat_ms[:_LAT_RESERVOIR // 2]
+        self.metrics.observe(total_ms, ok=True)
         obs.event("serve_request", rows=int(rows),
                   total_ms=round(total_ms, 3), ok=True)
 
@@ -436,6 +548,14 @@ class PredictorSession:
                 "num_class": self.num_tpi,
                 "num_features": self.num_features,
                 "max_batch": self.max_batch,
+                # load-balancer-grade health signals (ISSUE 6): how long
+                # this replica has lived, how many XLA compiles it paid,
+                # and how fast it is burning its p99 error budget
+                "uptime_s": round(time.time() - self._t_start, 1),
+                "compile_count": int(obs.compile_count()
+                                     - self._compiles0),
+                "slo_p99_ms": self.slo_p99_ms or None,
+                "slo_burn": self.metrics.slo_burn(),
             }
 
     def close(self) -> None:

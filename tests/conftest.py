@@ -110,6 +110,19 @@ def pytest_configure(config):
     config.addinivalue_line("markers", "quick: fast tier (everything else)")
 
 
+import pytest as _pytest_mod
+
+
+@_pytest_mod.fixture(autouse=True)
+def _flight_dumps_to_tmp(tmp_path, monkeypatch):
+    """The flight recorder (obs/spans.py) dumps FLIGHT_rN.json on
+    degradations and health aborts — several tests trigger those on
+    purpose.  Default the dump dir to the test's tmp dir so no test can
+    litter the repo root (a test that asserts on the dump location sets
+    LGBM_TPU_FLIGHT_DIR itself and wins, monkeypatch being per-test)."""
+    monkeypatch.setenv("LGBM_TPU_FLIGHT_DIR", str(tmp_path))
+
+
 def pytest_collection_modifyitems(config, items):
     import pytest as _pytest
     tests_root = config.rootpath / "tests"

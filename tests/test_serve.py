@@ -195,9 +195,9 @@ def test_overload_raises_and_counts(binary_model, monkeypatch):
                             queue_depth=8)
     orig = sess._run_device
 
-    def slow(bins):
+    def slow(bins, **kw):
         time.sleep(0.4)
-        return orig(bins)
+        return orig(bins, **kw)
 
     monkeypatch.setattr(sess, "_run_device", slow)
     t1 = sess.submit(_nan_matrix(rng, 8, 6))   # in flight (worker busy)
@@ -218,9 +218,9 @@ def test_deadline_exceeded_in_queue(binary_model, monkeypatch):
     sess = PredictorSession(binary_model, max_batch=8, max_wait_ms=0.0)
     orig = sess._run_device
 
-    def slow(bins):
+    def slow(bins, **kw):
         time.sleep(0.3)
-        return orig(bins)
+        return orig(bins, **kw)
 
     monkeypatch.setattr(sess, "_run_device", slow)
     t1 = sess.submit(_nan_matrix(rng, 8, 6))
@@ -234,8 +234,10 @@ def test_deadline_exceeded_in_queue(binary_model, monkeypatch):
     assert st["deadline_missed"] == 1
 
 
-def test_degrades_to_host_predictor(binary_model, monkeypatch):
+def test_degrades_to_host_predictor(binary_model, monkeypatch, tmp_path):
     rng = np.random.default_rng(8)
+    # the degradation flip dumps the flight ring; keep it out of cwd
+    monkeypatch.setenv("LGBM_TPU_FLIGHT_DIR", str(tmp_path))
     Xt = _nan_matrix(rng, 50, 6)
     want = _host_predict(binary_model, Xt)
     sess = PredictorSession(binary_model, max_batch=32)

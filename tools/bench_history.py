@@ -69,14 +69,22 @@ _DIRECTIONS = [
     ("serve_p99_ms", False),
     ("serve_open_p99_ms", False),
     ("serve_occupancy", True),
+    ("serve_server_p99_ms", False),
+    ("serve_slo_burn", False),
+    ("serve_client_server_skew", False),
 ]
 
 # the headline columns of the human table, in order
 _TABLE_COLS = ["value", "vs_baseline", "per_iter_s", "compile_s",
                "train_auc", "rank_row_iters_per_s", "peak_hbm_bytes",
-               "serve_p99_ms", "serve_occupancy"]
+               "serve_p99_ms", "serve_server_p99_ms", "serve_occupancy"]
 
 _CONTEXT_KEYS = ("backend", "rows", "iters", "num_leaves", "max_bin")
+
+# client-observed p99 more than this multiple of the server-side p99 is
+# flagged: the excess lives in the network / front-end queue, not the
+# session (tools/bench_serve.py embeds both views per round)
+_SKEW_FLAG = 3.0
 
 
 def metric_direction(name: str) -> Optional[bool]:
@@ -117,15 +125,34 @@ def load_round(path: str) -> dict:
                           parsed.get("trees"), parsed.get("max_batch"))
         closed = parsed.get("closed") or {}
         opened = parsed.get("open") or {}
+        server = parsed.get("server") or {}
         for name, v in (("serve_rows_per_s", closed.get("rows_per_s")),
                         ("value", closed.get("rows_per_s")),
                         ("serve_p50_ms", closed.get("p50_ms")),
                         ("serve_p99_ms", closed.get("p99_ms")),
                         ("serve_open_p99_ms", opened.get("p99_ms")),
                         ("serve_occupancy", parsed.get("occupancy")),
+                        ("serve_server_p99_ms", server.get("p99_ms")),
+                        ("serve_slo_burn", server.get("slo_burn")),
                         ("jax_compiles", parsed.get("compiles"))):
             if isinstance(v, (int, float)) and not isinstance(v, bool):
                 row["metrics"][name] = float(v)
+        # client-vs-server p99 skew: the server-side number (session
+        # submit->result) excludes HTTP/network and client queueing — a
+        # big ratio means latency is accumulating OUTSIDE the session
+        # (network or front-end queue pathology), which no server-side
+        # metric would ever show
+        cp99 = row["metrics"].get("serve_p99_ms")
+        sp99 = row["metrics"].get("serve_server_p99_ms")
+        if cp99 and sp99:
+            skew = round(cp99 / sp99, 3) if sp99 > 0 else None
+            if skew is not None:
+                row["metrics"]["serve_client_server_skew"] = skew
+                if skew > _SKEW_FLAG:
+                    row["note"] = (row.get("note", "") + "; " if
+                                   row.get("note") else "") + \
+                        f"client p99 {skew:g}x server p99 — " \
+                        "network/queue pathology"
         if parsed.get("degraded"):
             row["canary"] = "serve-degraded"
             row["note"] = "degraded to host predictor — excluded from " \

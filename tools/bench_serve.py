@@ -204,12 +204,17 @@ def open_loop(sess, Xpool, k: dict) -> dict:
 
 
 def http_smoke(server, Xpool, k: dict) -> dict:
-    """Concurrent mixed-size POST /predict + GET /health over real HTTP."""
+    """Concurrent mixed-size POST /predict + GET /health over real HTTP,
+    with a poller hammering /metrics and /debug/flight THROUGHOUT the
+    storm — the introspection endpoints must answer under load, not just
+    on an idle server (run_suite.py's serve tier gates on this)."""
     import urllib.request
 
     import numpy as np
     url = server.url
     lat, errors = [], []
+    poll = {"metrics": 0, "flight": 0, "errors": []}
+    done = threading.Event()
     lock = threading.Lock()
 
     def post(seed):
@@ -221,7 +226,8 @@ def http_smoke(server, Xpool, k: dict) -> dict:
                 {"rows": Xpool[lo:lo + n].tolist()}).encode()
             req = urllib.request.Request(
                 url + "/predict", data=body,
-                headers={"Content-Type": "application/json"})
+                headers={"Content-Type": "application/json",
+                         "X-Request-Id": f"smoke-{seed}-{n}"})
             t0 = time.perf_counter()
             try:
                 with urllib.request.urlopen(req, timeout=60) as resp:
@@ -234,17 +240,51 @@ def http_smoke(server, Xpool, k: dict) -> dict:
                 with lock:
                     errors.append(f"{type(exc).__name__}: {exc}")
 
+    def poller():
+        from lightgbm_tpu.serve.metrics import parse_prometheus
+        while not done.is_set():
+            try:
+                with urllib.request.urlopen(url + "/metrics",
+                                            timeout=30) as resp:
+                    pm = parse_prometheus(resp.read().decode())
+                if "tpu_serve_slo_burn" in pm:
+                    poll["metrics"] += 1
+                with urllib.request.urlopen(url + "/debug/flight",
+                                            timeout=30) as resp:
+                    fl = json.loads(resp.read())
+                if isinstance(fl.get("events"), list):
+                    poll["flight"] += 1
+            except Exception as exc:  # noqa: BLE001
+                poll["errors"].append(f"{type(exc).__name__}: {exc}")
+            done.wait(0.05)
+
     threads = [threading.Thread(target=post, args=(s,))
                for s in range(k["clients"])]
+    pt = threading.Thread(target=poller)
+    pt.start()
     for t in threads:
         t.start()
     for t in threads:
         t.join()
+    done.set()
+    pt.join(30)
     with urllib.request.urlopen(url + "/health", timeout=10) as resp:
         health = json.loads(resp.read())
     p50, p99 = _percentiles(lat)
     return {"requests": len(lat), "errors": errors[:5],
-            "p50_ms": p50, "p99_ms": p99, "health": health}
+            "p50_ms": p50, "p99_ms": p99, "health": health,
+            "metrics_polls": poll["metrics"],
+            "flight_polls": poll["flight"],
+            "poll_errors": poll["errors"][:5]}
+
+
+def scrape_metrics(server) -> dict:
+    """One end-of-run /metrics scrape, parsed (the server-side view
+    embedded in SERVE_rN.json next to the client-observed numbers)."""
+    import urllib.request
+    from lightgbm_tpu.serve.metrics import parse_prometheus
+    with urllib.request.urlopen(server.url + "/metrics", timeout=30) as r:
+        return parse_prometheus(r.read().decode())
 
 
 def next_round(out_dir: str) -> int:
@@ -303,11 +343,38 @@ def main(argv=None) -> int:
         }
         record["closed"] = closed_loop(sess, Xpool, k)
         record["open"] = open_loop(sess, Xpool, k)
+        server = PredictServer(sess).start()
         if args.smoke:
-            server = PredictServer(sess).start()
             record["http"] = http_smoke(server, Xpool, k)
-            server.stop()
+        # end-of-run /metrics scrape: the SERVER-SIDE latency view rides
+        # the artifact next to the client-observed one, so
+        # bench_history.py can flag client-vs-server skew (network/queue
+        # pathology the session never sees).  Best-effort: a transient
+        # scrape failure must not void a completed bench round (same
+        # contract as tpu_window.py's export_serve_trace)
+        try:
+            record["metrics_snapshot"] = scrape_metrics(server)
+        except Exception as exc:  # noqa: BLE001 — capture must survive
+            record["metrics_snapshot"] = None
+            record["metrics_scrape_error"] = f"{type(exc).__name__}: {exc}"
+        server.stop()
         st = sess.stats()
+        record["server"] = {
+            "p50_ms": st["p50_ms"], "p99_ms": st["p99_ms"],
+            "slo_p99_ms": st["slo_p99_ms"], "slo_burn": st["slo_burn"],
+            "uptime_s": st["uptime_s"],
+            "compile_count": st["compile_count"],
+        }
+        flight_out = os.environ.get("SERVE_FLIGHT_OUT", "")
+        if flight_out:
+            # tpu_window.py's bench_serve leg: one good window leaves a
+            # flight artifact beside the trace/telemetry captures
+            with open(flight_out, "w") as fh:
+                json.dump({"kind": "flight", "reason": "bench_serve",
+                           "t": round(time.time(), 1),
+                           "events": obs.flight_snapshot()},
+                          fh, indent=1, default=str)
+            record["flight_out"] = flight_out
         sess.close()
         record["compiles"] = int(obs.counter_value("jax/compiles")
                                  - compiles0)
@@ -325,6 +392,17 @@ def main(argv=None) -> int:
             and not record["http"]["errors"],
             "health_ok": record["http"]["health"].get("status")
             in ("ok", "degraded"),
+            # /health must carry the load-balancer signals (ISSUE 6)
+            "health_signals": all(
+                f in record["http"]["health"]
+                for f in ("queue_rows", "uptime_s", "compile_count",
+                          "slo_burn")),
+            # /metrics + /debug/flight answered while the POST storm ran
+            "metrics_under_load": record["http"]["metrics_polls"] >= 1
+            and not record["http"]["poll_errors"],
+            "flight_under_load": record["http"]["flight_polls"] >= 1,
+            "server_p99_recorded":
+                record["server"]["p99_ms"] is not None,
             "compiles_bounded":
                 record["compiles"] <= record["compile_bound"],
             "no_errors": record["closed"]["errors"] == 0
@@ -350,6 +428,8 @@ def main(argv=None) -> int:
                       "closed_rows_per_s": record["closed"]["rows_per_s"],
                       "closed_p99_ms": record["closed"]["p99_ms"],
                       "open_p99_ms": record["open"]["p99_ms"],
+                      "server_p99_ms": record["server"]["p99_ms"],
+                      "slo_burn": record["server"]["slo_burn"],
                       "occupancy": record["occupancy"],
                       "compiles": record["compiles"]}))
     return 0

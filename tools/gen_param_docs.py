@@ -101,6 +101,32 @@ ENV_VARS = [
      "costs one boolean per check site.  `tools/tpu_window.py` runs "
      "every capture leg with `monitor` on so a TPU-window datapoint "
      "certifies itself."),
+    ("LGBM_TPU_TRACE",
+     "set to `1` for trace mode (equivalent to the `tpu_trace` "
+     "parameter): the span layer (`obs/spans.py`) emits one `span` "
+     "event per completed span — serving requests "
+     "(queue→coalesce→pad→device-execute, trace_id minted at the HTTP "
+     "edge from `X-Request-Id`) and training iterations (iteration + "
+     "its phase timers) share the schema, so "
+     "`python tools/trace_export.py <telemetry path>` renders both on "
+     "one Perfetto/Chrome timeline.  PROCESS-WIDE once on; like "
+     "profile mode it sync-brackets phases — attribution runs only, "
+     "never benchmarks."),
+    ("LGBM_TPU_FLIGHT",
+     "flight-recorder ring length (equivalent to the `tpu_flight_len` "
+     "parameter, default 256; `0` disables): the last N spans + "
+     "operational events (health, degradation, overload, iteration, "
+     "serve batches) kept in memory with no telemetry sink needed, and "
+     "dumped as `FLIGHT_rN.json` on a serve degradation flip, an "
+     "overload storm, a `TrainingHealthError`/divergence abort, or on "
+     "demand via `GET /debug/flight`.  `LGBM_TPU_FLIGHT_DIR` chooses "
+     "the dump directory (default: the working directory)."),
+    ("LGBM_TPU_SERVE_SLO_P99_MS",
+     "serving-engine override for `tpu_serve_slo_p99_ms` — the p99 "
+     "latency objective the `/metrics` + `/health` SLO-burn gauge "
+     "measures against (over-target fraction of recent requests "
+     "divided by the 1% budget a p99 objective allows; 1.0 = burning "
+     "budget exactly at the allowed rate)."),
     ("LGBM_TPU_COMPILE_CACHE",
      "directory for JAX's persistent XLA compilation cache (equivalent "
      "to the `tpu_compile_cache_dir` parameter; see "

@@ -22,8 +22,12 @@ identical to the sequential oracle before any perf number is trusted.
 The ``serve`` tier is not a pytest marker: it runs
 ``tools/bench_serve.py --smoke`` — start the HTTP server in-process,
 fire concurrent mixed-size requests, assert p99 recorded + the compile
-count bounded by the pow2 bucket set + clean shutdown — so every suite
-round re-proves the serving engine end to end on CPU.
+count bounded by the pow2 bucket set + clean shutdown, and (ISSUE 6)
+that ``/metrics`` and ``/debug/flight`` keep answering while the POST
+storm runs and ``/health`` carries the load-balancer signals
+(queue_rows, uptime_s, compile_count, slo_burn) — so every suite round
+re-proves the serving engine AND its introspection plane end to end on
+CPU.
 """
 from __future__ import annotations
 

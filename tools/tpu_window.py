@@ -22,7 +22,10 @@ capture checklist with health monitoring enabled:
 6. ``tools/bench_serve.py --json`` — the serving engine's closed-loop +
    Poisson open-loop numbers on the live backend, written as
    ``SERVE_manual_r{N}.json`` (bench_history.py trends it alongside
-   the ``SERVE_r*.json`` CI rounds).
+   the ``SERVE_r*.json`` CI rounds).  The leg runs with
+   ``LGBM_TPU_TRACE=1`` and a flight capture, so one good window also
+   yields a Perfetto-loadable ``serve_trace.json`` (request span trees)
+   and a ``FLIGHT_serve.json`` flight record in the artifacts dir.
 
 Artifacts (``--out``, default repo root):
 
@@ -159,7 +162,14 @@ def checklist_legs(art_dir: str, dry_run: bool, py: str = sys.executable):
                         dry_env=_DRY_PROF_ENV),
          "parse_json": True},
         {"name": "bench_serve", "argv": [py, serve, "--json"],
-         "env": env_for("bench_serve", dry_env=_DRY_SERVE_ENV),
+         "env": env_for("bench_serve",
+                        # trace + flight capture: one good window leaves
+                        # a Perfetto-exportable span stream AND a flight
+                        # record beside the bench numbers (ISSUE 6)
+                        {"LGBM_TPU_TRACE": "1",
+                         "SERVE_FLIGHT_OUT": os.path.join(
+                             art_dir, "FLIGHT_serve.json")},
+                        dry_env=_DRY_SERVE_ENV),
          "parse_json": True},
         {"name": "trace",
          "argv": [py, "-c", _TRACE_CODE, trace_rows, trace_dir],
@@ -244,6 +254,30 @@ def collect_health(art_dir: str) -> dict:
     return out
 
 
+def export_serve_trace(art_dir: str):
+    """Post-process the bench_serve leg's telemetry into a Perfetto
+    trace file (the leg ran with LGBM_TPU_TRACE=1, so its JSONL carries
+    the span stream).  Best-effort: a missing/empty stream returns None
+    rather than failing the capture."""
+    telem = os.path.join(art_dir, "telem_bench_serve")
+    if not os.path.isdir(telem):
+        return None, 0
+    try:
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        import trace_export
+        from lightgbm_tpu.obs.report import load_events
+        doc = trace_export.events_to_chrome(load_events(telem))
+        if not doc["traceEvents"]:
+            return None, 0
+        path = os.path.join(art_dir, "serve_trace.json")
+        with open(path, "w") as fh:
+            json.dump(doc, fh)
+        return path, len(doc["traceEvents"])
+    except Exception as exc:  # noqa: BLE001 — capture must survive
+        print(f"# serve trace export failed: {exc}", file=sys.stderr)
+        return None, 0
+
+
 def run_checklist(out_dir: str, n: int, dry_run: bool,
                   runner=subprocess.run, timeout: int = 1800,
                   backend: str = "", only=None) -> dict:
@@ -287,6 +321,15 @@ def run_checklist(out_dir: str, n: int, dry_run: bool,
             json.dump(serve_parsed, fh, indent=1)
         record["serve_path"] = serve_path
         print(f"# wrote {serve_path}")
+    if "bench_serve" in results:
+        st_path, st_events = export_serve_trace(art_dir)
+        if st_path:
+            record["serve_trace"] = os.path.relpath(st_path, out_dir)
+            record["serve_trace_events"] = st_events
+            print(f"# wrote {st_path} ({st_events} trace events)")
+        flight_path = os.path.join(art_dir, "FLIGHT_serve.json")
+        if os.path.isfile(flight_path):
+            record["serve_flight"] = os.path.relpath(flight_path, out_dir)
     if bench_parsed:
         print(f"# headline: {bench_parsed.get('value')} "
               f"{bench_parsed.get('unit')} "
