@@ -136,11 +136,20 @@ def run_train(cfg: Config, params: Dict) -> None:
                 env.model.save_model(out)
                 log.info("Saved snapshot to %s", out)
         snapshot_cb.order = 100
+        # a checkpoint resume replays the eval history through the
+        # callbacks; rewriting old snapshot files during the replay
+        # would be wasted IO
+        snapshot_cb.skip_on_resume = True
         cbs.append(snapshot_cb)
 
     if cfg.is_provide_training_metric:
         valid_sets = [train_set] + valid_sets
         valid_names = ["training"] + valid_names
+
+    if getattr(cfg, "tpu_checkpoint_dir", ""):
+        log.info("fault tolerance armed: checkpoints every %d iteration(s) "
+                 "to %s (resume is automatic on restart)",
+                 cfg.tpu_checkpoint_freq, cfg.tpu_checkpoint_dir)
 
     init_model = cfg.input_model or None
     bst = train_api(params, train_set,

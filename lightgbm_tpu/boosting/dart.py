@@ -21,6 +21,12 @@ class DART(GBDT):
     # must stay synchronous
     _lag_stop = False
 
+    # _dropping_trees mutates host trees in place (apply_shrinkage)
+    # before the iteration body runs, so a mid-iteration wedge cannot be
+    # rolled back to a consistent boundary — the wedge path relies on
+    # the last periodic checkpoint instead (gbdt._device_fatal_hook)
+    _boundary_rollback = False
+
     def init(self, config, train_ds, objective, metrics) -> None:
         super().init(config, train_ds, objective, metrics)
         self._drop_rng = np.random.default_rng(config.drop_seed)
@@ -28,6 +34,23 @@ class DART(GBDT):
         self.sum_weight = 0.0
         self.drop_index: List[int] = []
         log.info("Using DART")
+
+    def checkpoint_state(self):
+        """DART resume additionally needs the drop RNG (which trees get
+        dropped next), the per-tree weights, and their running sum — the
+        mutated leaf values themselves ride in the model text."""
+        meta, arrays = super().checkpoint_state()
+        meta["drop_rng_state"] = self._drop_rng.bit_generator.state
+        meta["tree_weight"] = [float(w) for w in self.tree_weight]
+        meta["sum_weight"] = float(self.sum_weight)
+        return meta, arrays
+
+    def restore_checkpoint_state(self, meta, arrays) -> None:
+        super().restore_checkpoint_state(meta, arrays)
+        if "drop_rng_state" in meta:
+            self._drop_rng.bit_generator.state = meta["drop_rng_state"]
+        self.tree_weight = [float(w) for w in meta.get("tree_weight", [])]
+        self.sum_weight = float(meta.get("sum_weight", 0.0))
 
     def train_one_iter(self, gradients=None, hessians=None) -> bool:
         self._dropping_trees()

@@ -380,6 +380,10 @@ class GBDT(PredictorBase):
         self._train_score = None      # [N, K] device
         self._valid_scores: List = []  # [Ni, K] device
         self.best_iteration = -1
+        self._guard = None            # robust/watchdog.py DeviceGuard
+        self._ckpt_hook = None        # engine-installed: write a final
+        #                               checkpoint on a fatal wedge
+        self._boundary = None         # iteration-boundary state snapshot
 
     # ------------------------------------------------------------------
     def init(self, config: Config, train_ds, objective, metrics) -> None:
@@ -407,7 +411,12 @@ class GBDT(PredictorBase):
         # TrainingHealthError abort leaves a FLIGHT_rN.json post-mortem
         if getattr(config, "tpu_trace", False):
             obs.enable_trace()
-        if ((obs.trace_enabled() or obs.health_enabled())
+        # the watchdog's wedge path dumps the flight ring — arm it when
+        # the guard will be active (explicit watchdog or armed faults)
+        from ..robust import faults as _faults
+        guard_on = (bool(getattr(config, "tpu_watchdog", False))
+                    or _faults.armed())
+        if ((obs.trace_enabled() or obs.health_enabled() or guard_on)
                 and not obs.flight_enabled()):
             # env override wins, exactly as in serve/session.py — an
             # explicit LGBM_TPU_FLIGHT=0/false must disable the ring
@@ -416,6 +425,18 @@ class GBDT(PredictorBase):
                 getattr(config, "tpu_flight_len", 256)))
         self._train_trace_id = (obs.new_trace_id(f"train-{os.getpid()}")
                                 if obs.trace_enabled() else None)
+        # device-wedge watchdog (robust/watchdog.py): inactive unless
+        # tpu_watchdog is set or the fault harness is armed, so default
+        # runs keep their async dispatch untouched
+        from ..robust.watchdog import DeviceGuard
+        self._guard = DeviceGuard(
+            policy=getattr(config, "tpu_on_device_error", "retry"),
+            retries=int(getattr(config, "tpu_device_retries", 3)),
+            stall_timeout_s=float(getattr(config, "tpu_wedge_timeout_s",
+                                          0.0)),
+            enabled=bool(getattr(config, "tpu_watchdog", False)),
+            seed=int(getattr(config, "seed", 0)),
+            on_fatal=self._device_fatal_hook)
 
         self.config = config
         self.train_ds = train_ds
@@ -1154,6 +1175,17 @@ class GBDT(PredictorBase):
         K = self.num_tpi
         N = self.train_ds.num_data
 
+        if (self._ckpt_hook is not None and self._guard is not None
+                and self._guard.active):
+            # boundary snapshot for the wedge path: O(1) references
+            # (device buffers are immutable) + two small RNG-state dicts,
+            # so a mid-iteration fatal can roll back to the last
+            # consistent iteration boundary before checkpointing.  Gated
+            # on the guard being able to FIRE — its _fatal path is the
+            # only consumer, and the snapshot pins the previous
+            # iteration's score buffers for one extra iteration
+            self._snapshot_boundary()
+
         from ..utils.timetag import sync, timetag
 
         # Telemetry snapshots for the per-iteration record.  Everything in
@@ -1178,7 +1210,9 @@ class GBDT(PredictorBase):
             for k in range(K):
                 init_scores[k] = self._boost_from_average(k)
             with timetag("boosting (grad/hess)"):
-                g, h = self._grad_fn(self._train_score)
+                g, h = self._guard.run(
+                    lambda: self._grad_fn(self._train_score),
+                    point="gradients", iteration=self.iter_)
                 sync(h)
             if health_on and self.objective is not None:
                 self.objective.health_tap(g, h, self.iter_)
@@ -1228,9 +1262,12 @@ class GBDT(PredictorBase):
                     grow_kw = ({"tree_seed": jnp.uint32(self.iter_ * K + k)}
                                if getattr(self, "_bynode_on", False) else {})
                     with timetag("tree growth"):
-                        res = self._grow(self._grow_bins, g[:, k], h[:, k],
-                                         self._bag_mask, feature_mask,
-                                         *self._cegb_state, **grow_kw)
+                        res = self._guard.run(
+                            lambda: self._grow(
+                                self._grow_bins, g[:, k], h[:, k],
+                                self._bag_mask, feature_mask,
+                                *self._cegb_state, **grow_kw),
+                            point="device_execute", iteration=self.iter_)
                         sync(res[1])
                     if self._cegb_on:
                         arrs, leaf_id = res[0], res[1]
@@ -1243,11 +1280,14 @@ class GBDT(PredictorBase):
                 else:
                     with timetag("tree growth"):
                         arrs, leaf_id, new_score, n_waves_dev = \
-                            self._grow_apply(
-                                self._grow_bins, g, h, self._bag_mask,
-                                feature_mask, self._train_score,
-                                jnp.float32(self.shrinkage_rate), k,
-                                seed=jnp.uint32(self.iter_ * K + k))
+                            self._guard.run(
+                                lambda: self._grow_apply(
+                                    self._grow_bins, g, h, self._bag_mask,
+                                    feature_mask, self._train_score,
+                                    jnp.float32(self.shrinkage_rate), k,
+                                    seed=jnp.uint32(self.iter_ * K + k)),
+                                point="device_execute",
+                                iteration=self.iter_)
                         sync(new_score)
                     if lag_ok:
                         nl_dev = arrs.num_leaves
@@ -1540,6 +1580,121 @@ class GBDT(PredictorBase):
                     self._traverse_add(self._valid_scores[v][:, k], arrs,
                                        self._valid_bins[v]))
 
+    # ------------------------------------------------------------------
+    # Fault tolerance (robust/checkpoint.py + robust/watchdog.py)
+    # ------------------------------------------------------------------
+
+    # subclasses that mutate host trees in place mid-iteration (DART's
+    # shrinkage dance) cannot roll a partial iteration back
+    _boundary_rollback = True
+
+    def checkpoint_state(self):
+        """(meta, arrays) for an atomic checkpoint: everything a
+        bit-exact resume needs BESIDES the forest itself (which travels
+        as model text).  The score arrays are saved verbatim because
+        replaying trees onto a fresh score would re-round f64 sums into
+        f32 in a different order; the RNG states make the next bagging /
+        feature-fraction draw identical to the uninterrupted run's."""
+        self._materialize_trees()
+        meta = {
+            "boosting": type(self).__name__.lower(),
+            "iteration": int(self.iter_),
+            "shrinkage_rate": float(self.shrinkage_rate),
+            "num_init_iteration": int(self.num_init_iteration),
+            "rng_state": self._rng.bit_generator.state,
+            "feat_rng_state": self._feat_rng.bit_generator.state,
+        }
+        arrays = {
+            "train_score": np.asarray(self._train_score),
+            "bag_mask": np.asarray(self._bag_mask_host, dtype=np.bool_),
+        }
+        for i, vs in enumerate(self._valid_scores):
+            arrays[f"valid_score_{i}"] = np.asarray(vs)
+        return meta, arrays
+
+    def restore_checkpoint_state(self, meta: dict, arrays: dict) -> None:
+        """Inverse of :meth:`checkpoint_state`; call after
+        ``load_initial_models(..., replay_scores=False)`` reseeded the
+        forest and after every valid set is attached."""
+        import jax.numpy as jnp
+        want = meta.get("boosting", "gbdt")
+        have = type(self).__name__.lower()
+        if want != have:
+            log.warning("checkpoint was written by boosting=%s but this "
+                        "trainer is %s — resuming anyway", want, have)
+        self.iter_ = int(meta["iteration"])
+        self.shrinkage_rate = float(meta["shrinkage_rate"])
+        self.num_init_iteration = int(meta.get("num_init_iteration", 0))
+        self._rng.bit_generator.state = meta["rng_state"]
+        self._feat_rng.bit_generator.state = meta["feat_rng_state"]
+        self._train_score = jnp.asarray(arrays["train_score"])
+        mask = np.asarray(arrays["bag_mask"], dtype=bool)
+        self._bag_mask_host = mask
+        self._bag_mask = jnp.asarray(mask.astype(np.float32))
+        for i in range(len(self._valid_scores)):
+            key = f"valid_score_{i}"
+            if key in arrays:
+                self._valid_scores[i] = jnp.asarray(arrays[key])
+
+    def _snapshot_boundary(self) -> None:
+        """Reference-copy the iteration-boundary state (device arrays
+        are immutable; the RNG ``.state`` property returns a fresh
+        dict), so a fatal mid-iteration wedge can checkpoint a
+        CONSISTENT boundary instead of a half-applied iteration."""
+        self._boundary = {
+            "iter": self.iter_,
+            "n_models": list.__len__(self.models),
+            "shrinkage": self.shrinkage_rate,
+            "rng": self._rng.bit_generator.state,
+            "feat_rng": self._feat_rng.bit_generator.state,
+            "bag_mask": self._bag_mask,
+            "bag_mask_host": self._bag_mask_host,
+            "train_score": self._train_score,
+            "valid_scores": list(self._valid_scores),
+            "pending_nl": self._pending_nl,
+        }
+
+    def _rollback_to_boundary(self) -> bool:
+        """Restore the last boundary snapshot; False when unsupported
+        (DART mutates host trees in place) or no snapshot exists."""
+        b = self._boundary
+        if b is None or not self._boundary_rollback:
+            return False
+        self.iter_ = b["iter"]
+        self.shrinkage_rate = b["shrinkage"]
+        self._rng.bit_generator.state = b["rng"]
+        self._feat_rng.bit_generator.state = b["feat_rng"]
+        self._bag_mask = b["bag_mask"]
+        self._bag_mask_host = b["bag_mask_host"]
+        self._train_score = b["train_score"]
+        self._valid_scores = list(b["valid_scores"])
+        self._pending_nl = b["pending_nl"]
+        extra = list.__len__(self.models) - b["n_models"]
+        if extra > 0:
+            del self.models[b["n_models"]:]
+            self._model_version += 1
+        return True
+
+    def _device_fatal_hook(self, reason: str, exc: BaseException) -> None:
+        """DeviceGuard on_fatal: roll the half-applied iteration back to
+        the boundary and let the engine's checkpoint hook persist it —
+        the 'final checkpoint' of a wedge death.  No hook installed
+        (non-engine training) means flight dump only."""
+        if self._ckpt_hook is None:
+            return
+        if not self._rollback_to_boundary():
+            log.warning("device wedge: no consistent iteration boundary "
+                        "to checkpoint (boosting=%s mutates trees "
+                        "mid-iteration); relying on the last periodic "
+                        "checkpoint", type(self).__name__.lower())
+            return
+        try:
+            self._ckpt_hook(reason)
+        except Exception as hook_exc:  # noqa: BLE001
+            log.warning("wedge checkpoint failed (%s: %s)",
+                        type(hook_exc).__name__, hook_exc)
+
+    # ------------------------------------------------------------------
     def refit_models(self, decay_rate: Optional[float] = None) -> None:
         """Refit the existing tree STRUCTURES to this trainer's (new) data:
         sequentially recompute each tree's leaf outputs from the current

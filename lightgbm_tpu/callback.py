@@ -53,6 +53,10 @@ class _LogEvaluation:
 
     order = 10
     before_iteration = False
+    # display-only: checkpoint resume (engine.train) replays the recorded
+    # eval history through stateful callbacks; re-printing it would be
+    # noise
+    skip_on_resume = True
 
     def __init__(self, period: int, show_stdv: bool):
         self.period = period

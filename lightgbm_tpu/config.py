@@ -357,6 +357,56 @@ class Config:
                                         # profile mode it sync-brackets
                                         # phases — attribution, never
                                         # benchmarks (LGBM_TPU_TRACE env)
+    tpu_checkpoint_dir: str = ""        # fault-tolerance checkpoint
+                                        # directory (robust/checkpoint.py):
+                                        # when set, engine.train writes an
+                                        # atomic versioned checkpoint
+                                        # (forest + RNG + score state +
+                                        # eval history) every
+                                        # tpu_checkpoint_freq iterations
+                                        # and RESUMES bit-exactly from the
+                                        # newest valid one on restart;
+                                        # "" disables checkpointing
+    tpu_checkpoint_freq: int = 100      # boosting iterations between
+                                        # checkpoints (0 = only the
+                                        # preemption/wedge checkpoints);
+                                        # used only with
+                                        # tpu_checkpoint_dir set
+    tpu_checkpoint_keep: int = 3        # newest checkpoints retained;
+                                        # older ones are pruned after
+                                        # each successful save
+    tpu_on_device_error: str = "retry"  # device-wedge policy
+                                        # (robust/watchdog.py): retry =
+                                        # re-dispatch transient failures
+                                        # with bounded exponential
+                                        # backoff + seeded jitter, abort
+                                        # on fatal; abort = fail fast
+                                        # (flight dump + boundary
+                                        # checkpoint + DeviceWedgedError);
+                                        # fallback = after the dump/
+                                        # checkpoint, re-execute the step
+                                        # on the CPU backend and continue
+                                        # (best-effort)
+    tpu_watchdog: bool = False          # arm the device-wedge watchdog
+                                        # for this trainer even without
+                                        # faults injected: every device
+                                        # step is synced + guarded
+                                        # (classify/retry/stall heartbeat)
+                                        # — trades the async-dispatch
+                                        # overlap for fail-safety, like
+                                        # health mode trades it for
+                                        # certainty
+    tpu_device_retries: int = 3         # bounded retry budget for
+                                        # transient device failures
+                                        # (watchdog policy retry/fallback)
+    tpu_wedge_timeout_s: float = 0.0    # stall heartbeat deadline in
+                                        # seconds; 0 = automatic (4x the
+                                        # rolling per-step p99, floored
+                                        # at 60s).  A step exceeding it
+                                        # is stamped with a device_stall
+                                        # event + flight dump (advisory:
+                                        # a hung XLA call cannot be
+                                        # interrupted from Python)
     tpu_flight_len: int = 256           # flight-recorder ring length:
                                         # the last N spans + operational
                                         # events kept in memory and
@@ -385,6 +435,15 @@ class Config:
     tpu_serve_host: str = "127.0.0.1"   # bind address for task=serve
     tpu_serve_port: int = 0             # task=serve HTTP port (0 = pick
                                         # an ephemeral port and log it)
+    tpu_serve_reprobe_s: float = 30.0   # seconds between device
+                                        # re-probes while a serving
+                                        # session is degraded to the
+                                        # host predictor: a successful
+                                        # probe flips /health back from
+                                        # "degraded" (probe-and-recover
+                                        # instead of the old one-way
+                                        # latch); 0 disables re-probing
+                                        # (LGBM_TPU_SERVE_REPROBE_S env)
     tpu_serve_slo_p99_ms: float = 250.0  # serving p99 latency objective:
                                         # /metrics + /health report the
                                         # SLO-burn rate against it (the
@@ -511,6 +570,19 @@ class Config:
             log.fatal("tpu_serve_slo_p99_ms should be >= 0")
         if self.tpu_flight_len < 0:
             log.fatal("tpu_flight_len should be >= 0")
+        if self.tpu_on_device_error not in ("abort", "fallback", "retry"):
+            log.fatal("tpu_on_device_error should be abort, fallback or "
+                      "retry")
+        if self.tpu_checkpoint_freq < 0:
+            log.fatal("tpu_checkpoint_freq should be >= 0")
+        if self.tpu_checkpoint_keep < 1:
+            log.fatal("tpu_checkpoint_keep should be >= 1")
+        if self.tpu_device_retries < 0:
+            log.fatal("tpu_device_retries should be >= 0")
+        if self.tpu_wedge_timeout_s < 0:
+            log.fatal("tpu_wedge_timeout_s should be >= 0")
+        if self.tpu_serve_reprobe_s < 0:
+            log.fatal("tpu_serve_reprobe_s should be >= 0")
 
     # ------------------------------------------------------------------
     def num_model_per_iteration(self) -> int:

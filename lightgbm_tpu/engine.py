@@ -64,8 +64,20 @@ def train(params: Dict[str, Any], train_set: Dataset,
                             f"file path, met {type(init_model).__name__}")
 
     booster = Booster(params=params, train_set=train_set)
+    # fault tolerance (robust/checkpoint.py): with tpu_checkpoint_dir
+    # set, periodic atomic checkpoints + bit-exact resume from the
+    # newest valid one.  The peek happens BEFORE init_model seeding —
+    # a checkpoint (this run's own progress) supersedes the init model
+    # it was itself seeded from.
+    from .robust.checkpoint import CheckpointManager
+    ckpt_mgr = CheckpointManager.from_config(booster.config)
+    ckpt_peeked = ckpt_mgr.peek(booster.config) if ckpt_mgr else None
     if init_trees:
-        booster._gbdt.load_initial_models(init_trees)
+        if ckpt_peeked is not None:
+            log.warning("init_model ignored: resuming from checkpoint %s",
+                        ckpt_peeked[0])
+        else:
+            booster._gbdt.load_initial_models(init_trees)
     is_valid_contain_train = False
     train_data_name = "training"
     if valid_sets is not None:
@@ -103,38 +115,134 @@ def train(params: Dict[str, Any], train_set: Dataset,
     cbs_before.sort(key=lambda c: getattr(c, "order", 0))
     cbs_after.sort(key=lambda c: getattr(c, "order", 0))
 
+    # ---- checkpoint resume (robust/checkpoint.py) --------------------
+    # Restore AFTER valid sets attach (their score slots must exist),
+    # then replay the recorded eval history through the STATEFUL
+    # callbacks so early stopping / record_evaluation continue exactly
+    # mid-stream; display-only callbacks (skip_on_resume) stay silent.
     evaluation_result_list: List = []
-    for i in range(num_boost_round):
-        for cb in cbs_before:
-            cb(callback.CallbackEnv(model=booster, params=params, iteration=i,
-                                    begin_iteration=0,
-                                    end_iteration=num_boost_round,
-                                    evaluation_result_list=None))
-        if booster.update(fobj=fobj):
-            break  # can't split anymore
-        evaluation_result_list = []
-        # evaluate only when something consumes the result: attached valid
-        # sets, or the train set explicitly requested via valid_sets
-        # (the reference likewise skips evaluation without valid_sets —
-        # a per-iteration metric pass costs an O(N) device sync)
-        if booster.valid_sets or is_valid_contain_train:
-            entries = booster._eval_all(feval,
-                                        include_train=is_valid_contain_train)
-            if is_valid_contain_train:
-                evaluation_result_list.extend(
-                    e for e in entries if e[0] == train_data_name)
-            evaluation_result_list.extend(
-                e for e in entries if e[0] != train_data_name)
+    eval_history: List = []
+    start_round = 0
+    stopped_in_replay = False
+    if ckpt_peeked is not None:
+        resume = ckpt_mgr.resume(booster, ckpt_peeked)
+        start_round = resume.iteration
+        eval_history = list(resume.eval_history)
+        # reconcile the callback-visible params with the restored state:
+        # a reset_parameter(learning_rate=[...]) schedule compares the
+        # scheduled value against env.params, and a fresh process's
+        # params still hold the ORIGINAL learning rate — without this
+        # the first resumed iteration would silently train at the
+        # checkpoint's restored rate when the schedule says otherwise
+        params["learning_rate"] = booster._gbdt.shrinkage_rate
         try:
-            for cb in cbs_after:
-                cb(callback.CallbackEnv(model=booster, params=params,
-                                        iteration=i, begin_iteration=0,
-                                        end_iteration=num_boost_round,
-                                        evaluation_result_list=evaluation_result_list))
+            for it, entries in eval_history:
+                env = callback.CallbackEnv(
+                    model=booster, params=params, iteration=it,
+                    begin_iteration=0, end_iteration=num_boost_round,
+                    evaluation_result_list=entries)
+                for cb in cbs_after:
+                    if getattr(cb, "skip_on_resume", False):
+                        continue
+                    cb(env)
+                evaluation_result_list = entries
         except callback.EarlyStopException as es:
             booster.best_iteration = es.best_iteration + 1
             evaluation_result_list = es.best_score
-            break
+            stopped_in_replay = True
+
+    # ---- graceful preemption (SIGTERM/SIGINT) ------------------------
+    # Only armed while checkpointing is configured: the first signal
+    # finishes the current iteration, writes a final checkpoint + flight
+    # record, and re-raises; a second signal falls through to the
+    # default handler (hard kill).
+    import signal as _signal
+    import threading as _threading
+    preempted: Dict[str, int] = {}
+    prev_handlers = {}
+    arm_signals = (ckpt_mgr is not None
+                   and _threading.current_thread()
+                   is _threading.main_thread())
+    if arm_signals:
+        def _on_signal(signum, frame):
+            preempted["sig"] = signum
+            for s, h in prev_handlers.items():   # next signal acts default
+                _signal.signal(s, h)
+            log.warning("signal %d: finishing the current iteration, "
+                        "then checkpointing and exiting (send again to "
+                        "kill immediately)", signum)
+        for s in (_signal.SIGTERM, _signal.SIGINT):
+            try:
+                prev_handlers[s] = _signal.signal(s, _on_signal)
+            except (ValueError, OSError):   # non-main thread / platform
+                prev_handlers.pop(s, None)
+
+    completed = start_round
+    if ckpt_mgr is not None:
+        # the wedge hook: a fatal device error mid-iteration rolls back
+        # to the iteration boundary and checkpoints it (eval_history is
+        # captured by reference, so the hook always sees the latest)
+        booster._gbdt._ckpt_hook = (
+            lambda reason: ckpt_mgr.save(booster, booster._gbdt.iter_,
+                                         eval_history, reason=reason))
+    try:
+        for i in range(start_round, num_boost_round):
+            if stopped_in_replay or preempted:
+                break
+            for cb in cbs_before:
+                cb(callback.CallbackEnv(model=booster, params=params, iteration=i,
+                                        begin_iteration=0,
+                                        end_iteration=num_boost_round,
+                                        evaluation_result_list=None))
+            if booster.update(fobj=fobj):
+                break  # can't split anymore
+            completed = i + 1
+            evaluation_result_list = []
+            # evaluate only when something consumes the result: attached valid
+            # sets, or the train set explicitly requested via valid_sets
+            # (the reference likewise skips evaluation without valid_sets —
+            # a per-iteration metric pass costs an O(N) device sync)
+            if booster.valid_sets or is_valid_contain_train:
+                entries = booster._eval_all(feval,
+                                            include_train=is_valid_contain_train)
+                if is_valid_contain_train:
+                    evaluation_result_list.extend(
+                        e for e in entries if e[0] == train_data_name)
+                evaluation_result_list.extend(
+                    e for e in entries if e[0] != train_data_name)
+            try:
+                for cb in cbs_after:
+                    cb(callback.CallbackEnv(model=booster, params=params,
+                                            iteration=i, begin_iteration=0,
+                                            end_iteration=num_boost_round,
+                                            evaluation_result_list=evaluation_result_list))
+            except callback.EarlyStopException as es:
+                booster.best_iteration = es.best_iteration + 1
+                evaluation_result_list = es.best_score
+                break
+            if ckpt_mgr is not None:
+                eval_history.append((i, list(evaluation_result_list)))
+                if ckpt_mgr.should_save(i + 1):
+                    ckpt_mgr.save(booster, i + 1, eval_history)
+    finally:
+        for s, h in prev_handlers.items():
+            try:
+                _signal.signal(s, h)
+            except (ValueError, OSError):
+                pass
+    if preempted:
+        from . import obs
+        ckpt_mgr.save(booster, completed, eval_history, reason="preempted")
+        if obs.flight_enabled():
+            obs.flight_dump("preempted")
+        sig = preempted["sig"]
+        log.warning("training preempted by signal %d at iteration %d; "
+                    "checkpoint written to %s — rerun with the same "
+                    "tpu_checkpoint_dir to resume", sig, completed,
+                    ckpt_mgr.dir)
+        if sig == _signal.SIGINT:
+            raise KeyboardInterrupt
+        raise SystemExit(128 + sig)
 
     booster.best_score = collections.defaultdict(collections.OrderedDict)
     for ds_name, mname, value, _ in (evaluation_result_list or []):

@@ -148,6 +148,9 @@ def summarize(events: List[dict]) -> dict:
     trace = trace_summary(events)
     if trace:
         out["trace"] = trace
+    robust = robust_summary(events)
+    if robust:
+        out["robust"] = robust
     return out
 
 
@@ -313,6 +316,53 @@ def serve_summary(events: List[dict]) -> dict:
     return out
 
 
+def robust_summary(events: List[dict]) -> dict:
+    """Fold the fault-tolerance events (robust/: ``checkpoint`` /
+    ``restore`` / ``retry`` / ``fault_injected`` / ``device_stall`` /
+    ``serve_recovered``) into one recovery digest: how often the run
+    checkpointed, what it recovered from, and what was injected.  Empty
+    when the run saw no recovery activity."""
+    cps = [e for e in events if e.get("event") == "checkpoint"]
+    rst = [e for e in events if e.get("event") == "restore"]
+    rets = [e for e in events if e.get("event") == "retry"]
+    inj = [e for e in events if e.get("event") == "fault_injected"]
+    stalls = [e for e in events if e.get("event") == "device_stall"]
+    recov = [e for e in events if e.get("event") == "serve_recovered"]
+    if not (cps or rst or rets or inj or stalls or recov):
+        return {}
+    by_point = defaultdict(lambda: {"retries": 0, "transient": 0,
+                                    "fatal": 0})
+    for e in rets:
+        p = by_point[e.get("point", "?")]
+        p["retries"] += 1
+        p[e.get("classify", "fatal")] = p.get(e.get("classify", "fatal"),
+                                              0) + 1
+    out = {
+        "checkpoints": len(cps),
+        "restores": len(rst),
+        "retries": len(rets),
+        "faults_injected": len(inj),
+        "stalls": len(stalls),
+        "serve_recoveries": len(recov),
+    }
+    if by_point:
+        out["retries_by_point"] = {k: dict(v)
+                                   for k, v in sorted(by_point.items())}
+    if inj:
+        pts = defaultdict(int)
+        for e in inj:
+            pts[e.get("point", "?")] += 1
+        out["faults_by_point"] = dict(sorted(pts.items()))
+    if cps:
+        last = cps[-1]
+        out["last_checkpoint"] = {"iteration": last.get("iteration"),
+                                  "reason": last.get("reason"),
+                                  "path": last.get("path")}
+    if rst:
+        out["resumed_from_iteration"] = rst[-1].get("iteration")
+    return out
+
+
 def trace_summary(events: List[dict]) -> dict:
     """Fold ``span`` events (obs/spans.py) into the trace digest:
     span/trace counts and per-name call/duration aggregates.  Empty when
@@ -424,6 +474,45 @@ EVENT_SCHEMAS = {
         "latency_ms": (_NUM, True),
         "trace_id": (str, True),
     },
+    # fault tolerance (robust/checkpoint.py + robust/watchdog.py +
+    # robust/faults.py)
+    "checkpoint": {
+        "iteration": (int, True),
+        "path": (str, True),
+        "bytes": (int, False),
+        "ms": (_NUM, False),
+        "reason": (str, False),
+    },
+    "restore": {
+        "iteration": (int, True),
+        "path": (str, True),
+    },
+    "retry": {
+        "point": (str, True),
+        "attempt": (int, True),
+        "classify": (str, True),
+        "action": (str, True),
+        "error": (str, False),
+        "delay_ms": (_NUM, False),
+        "iteration": (int, False),
+    },
+    "fault_injected": {
+        "point": (str, True),
+        "action": (str, True),
+        "call": (int, True),
+        "iteration": (int, False),
+    },
+    "device_stall": {
+        "point": (str, True),
+        "elapsed_s": (_NUM, True),
+        "deadline_s": (_NUM, True),
+        "iteration": (int, False),
+    },
+    "serve_probe": {
+        "ok": (bool, True),
+        "error": (str, False),
+    },
+    "serve_recovered": {},
 }
 
 
@@ -551,6 +640,26 @@ def render(digest: dict) -> str:
         if s.get("overloads") or s.get("deadline_missed"):
             out.append(f"  overloads {s.get('overloads', 0)}, deadline "
                        f"misses {s.get('deadline_missed', 0)}")
+    if digest.get("robust"):
+        r = digest["robust"]
+        out.append("")
+        out.append(f"recovery: {r['checkpoints']} checkpoint(s), "
+                   f"{r['restores']} restore(s), {r['retries']} device "
+                   f"retr{'y' if r['retries'] == 1 else 'ies'}, "
+                   f"{r['stalls']} stall(s), {r['serve_recoveries']} "
+                   f"serve recover(ies), {r['faults_injected']} injected "
+                   f"fault(s)")
+        if r.get("resumed_from_iteration") is not None:
+            out.append(f"  resumed from iteration "
+                       f"{r['resumed_from_iteration']}")
+        if r.get("last_checkpoint"):
+            lc = r["last_checkpoint"]
+            out.append(f"  last checkpoint: iteration {lc.get('iteration')}"
+                       f" ({lc.get('reason')})")
+        for point, v in (r.get("retries_by_point") or {}).items():
+            out.append(f"  retries at {point:<20} {v.get('retries', 0)} "
+                       f"({v.get('transient', 0)} transient, "
+                       f"{v.get('fatal', 0)} fatal)")
     if digest.get("trace"):
         t = digest["trace"]
         out.append("")

@@ -216,12 +216,21 @@ def _allgather_exact(arr):
     from .. import obs
 
     a = np.ascontiguousarray(arr)
-    if a.dtype.itemsize == 8:
-        u = a.view(np.uint32)
-        g = np.asarray(multihost_utils.process_allgather(jnp.asarray(u)))
-        g = g.view(a.dtype)
-    else:
-        g = np.asarray(multihost_utils.process_allgather(jnp.asarray(a)))
+    # collective fault point + transient retry (robust/): the guard is a
+    # passthrough unless the fault harness is armed, but the injection
+    # site is THE place a real cross-host gather fails — bin-sample
+    # pooling and the divergence audit both route through here
+    from ..robust.watchdog import guarded_call
+
+    def _gather():
+        if a.dtype.itemsize == 8:
+            u = a.view(np.uint32)
+            return np.asarray(
+                multihost_utils.process_allgather(jnp.asarray(u))
+            ).view(a.dtype)
+        return np.asarray(multihost_utils.process_allgather(jnp.asarray(a)))
+
+    g = guarded_call(_gather, point="collective")
     # host-driven collective: the gathered result size IS the runtime
     # receive traffic (every process materializes all hosts' payloads)
     obs.record_collective_host("process_allgather", g.nbytes)

@@ -1,0 +1,30 @@
+"""Fault tolerance: atomic checkpoint/resume, the device-wedge watchdog,
+and the deterministic fault-injection harness.
+
+Three cooperating layers, each usable alone:
+
+- :mod:`.checkpoint` — ``CheckpointManager``: versioned
+  write-temp-fsync-rename checkpoints (forest + RNG + score state + eval
+  history + config digest) every ``tpu_checkpoint_freq`` iterations, and
+  bit-exact resume from the newest valid one (``engine.train`` drives it
+  when ``tpu_checkpoint_dir`` is set).
+- :mod:`.watchdog` — ``DeviceGuard``: classify device failures
+  (transient vs fatal), retry transients with bounded exponential
+  backoff + deterministic jitter, stamp stalled steps against a rolling
+  per-step p99 deadline, and on a fatal wedge dump the flight recorder,
+  write a boundary checkpoint, and abort / fall back to CPU per
+  ``tpu_on_device_error``.
+- :mod:`.faults` — the ``LGBM_TPU_FAULTS`` injection harness: seeded,
+  deterministic faults (``raise``/``transient``/``sleep``) at named
+  points (device_execute, gradients, collective, serve_device,
+  checkpoint_write) so every recovery branch is CI-provable on CPU.
+"""
+from .checkpoint import CheckpointManager, config_digest
+from .faults import FaultInjected, FaultTransient
+from .watchdog import DeviceGuard, DeviceWedgedError, classify_error
+
+__all__ = [
+    "CheckpointManager", "config_digest",
+    "DeviceGuard", "DeviceWedgedError", "classify_error",
+    "FaultInjected", "FaultTransient",
+]

@@ -42,6 +42,13 @@ class ServeMetrics:
         self._failed = 0
         self._status: Dict[int, int] = {}
         self._recent = deque(maxlen=_SLO_WINDOW)
+        # degradation is a recoverable state (serve/session.py re-probes
+        # the device), so the gauge needs transition counters beside it:
+        # how many times the session fell back, and how many times the
+        # probe brought it back
+        self._degraded = False
+        self._degraded_transitions = 0
+        self._recoveries = 0
 
     # ---- hot path ----------------------------------------------------
     def observe(self, latency_ms: float, ok: bool = True) -> None:
@@ -67,6 +74,27 @@ class ServeMetrics:
         code = int(code)
         with self._lock:
             self._status[code] = self._status.get(code, 0) + 1
+
+    def set_degraded(self, flag: bool) -> None:
+        """Record a degradation-state transition (session -> host
+        fallback, or a successful device re-probe recovering it)."""
+        flag = bool(flag)
+        with self._lock:
+            if flag and not self._degraded:
+                self._degraded_transitions += 1
+            elif not flag and self._degraded:
+                self._recoveries += 1
+            self._degraded = flag
+
+    @property
+    def degraded_transitions(self) -> int:
+        with self._lock:
+            return self._degraded_transitions
+
+    @property
+    def recoveries(self) -> int:
+        with self._lock:
+            return self._recoveries
 
     # ---- scrape time -------------------------------------------------
     def slo_burn(self) -> Optional[float]:
@@ -98,6 +126,9 @@ class ServeMetrics:
                 "status": dict(sorted(self._status.items())),
                 "slo_p99_ms": self.slo_p99_ms or None,
                 "slo_burn": burn,
+                "degraded": self._degraded,
+                "degraded_transitions": self._degraded_transitions,
+                "recoveries": self._recoveries,
             }
 
 
@@ -163,8 +194,15 @@ def render_prometheus(session) -> str:
          "in queue.", st.get("deadline_missed")),
         ("tpu_serve_recompiles_total", "counter", "XLA compiles since "
          "the session started.", st.get("compile_count")),
-        ("tpu_serve_degraded", "gauge", "1 when the session fell back to "
-         "the host predictor.", bool(st.get("degraded"))),
+        ("tpu_serve_degraded", "gauge", "1 while the session is falling "
+         "back to the host predictor (recoverable: the session re-probes "
+         "the device).", bool(st.get("degraded"))),
+        ("tpu_serve_degraded_transitions_total", "counter", "Times the "
+         "session fell back to the host predictor.",
+         st.get("degraded_transitions")),
+        ("tpu_serve_recoveries_total", "counter", "Times a device "
+         "re-probe recovered a degraded session.",
+         st.get("recoveries")),
         ("tpu_serve_uptime_seconds", "gauge", "Seconds since the session "
          "packed its model.", st.get("uptime_s")),
         ("tpu_serve_slo_p99_ms", "gauge", "Configured p99 latency "

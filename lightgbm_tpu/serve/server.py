@@ -36,6 +36,11 @@ host numpy predictor (serve/session.py) — requests keep succeeding and
 ``/health`` flips to ``"degraded"`` so a load balancer can drain the
 replica gracefully instead of seeing a wall of 500s (and the flight
 recorder dumps ``FLIGHT_rN.json`` with the moments before the flip).
+Degradation is NOT a one-way latch: the session re-probes the device
+every ``tpu_serve_reprobe_s`` seconds and a successful probe flips
+``/health`` (and the ``/metrics`` ``tpu_serve_degraded`` gauge) back to
+``"ok"`` — the ``tpu_serve_degraded_transitions_total`` /
+``tpu_serve_recoveries_total`` counters record every flip.
 """
 from __future__ import annotations
 

@@ -38,6 +38,7 @@ import numpy as np
 
 from .. import obs
 from ..config import Config
+from ..robust import faults
 from ..utils import log
 from .batcher import (DeadlineExceeded, MicroBatcher, Request,
                       ServeOverloadError)
@@ -186,6 +187,13 @@ class PredictorSession:
             "LGBM_TPU_SERVE_SLO_P99_MS", float,
             getattr(config, "tpu_serve_slo_p99_ms", 250.0)))
         self.metrics = ServeMetrics(slo_p99_ms=self.slo_p99_ms)
+        # probe-and-recover: while degraded, re-try the device every
+        # reprobe_s seconds so a transient backend error is not a
+        # one-way latch (0 disables — the pre-ISSUE-7 behavior)
+        self.reprobe_s = float(_env_num(
+            "LGBM_TPU_SERVE_REPROBE_S", float,
+            getattr(config, "tpu_serve_reprobe_s", 30.0)))
+        self._last_probe = 0.0
         if getattr(config, "tpu_trace", False):
             obs.enable_trace()
         if not obs.flight_enabled():
@@ -242,6 +250,7 @@ class PredictorSession:
             self._buckets.add(b)
         arr = jnp.asarray(bins)
         t_exec0 = time.time()
+        faults.check("serve_device")
         out = self._device_fn(self.forest, arr)
         raw = np.asarray(out, dtype=np.float64)[:n]
         if self.average_factor:
@@ -277,16 +286,47 @@ class PredictorSession:
     def _note_degraded(self, exc: BaseException) -> None:
         if not self._degraded:
             self._degraded = True
+            self._last_probe = time.monotonic()
+            self.metrics.set_degraded(True)
             log.warning("serve: device predictor failed (%s: %s); "
-                        "degrading to the host predictor",
+                        "degrading to the host predictor"
+                        + (" (re-probing every %.3gs)" % self.reprobe_s
+                           if self.reprobe_s > 0 else ""),
                         type(exc).__name__, exc)
             obs.event("serve_degraded",
                       error=f"{type(exc).__name__}: {exc}")
             # the flip is exactly what the flight recorder exists for:
             # persist the last N spans/events leading up to it.  force=
-            # True: degradation happens at most once per session, so the
+            # True: each degradation is a distinct incident, so the
             # storm cooldown must never swallow ITS post-mortem
             self._flight_dump("serve_degraded", force=True)
+
+    def _maybe_reprobe(self) -> bool:
+        """While degraded, periodically try one tiny device execution;
+        success flips the session (and /health, and the /metrics
+        ``degraded`` gauge) back to the device path.  Returns True when
+        the probe recovered the device."""
+        if not self._degraded or self.reprobe_s <= 0:
+            return False
+        now = time.monotonic()
+        with self._lock:
+            if now - self._last_probe < self.reprobe_s:
+                return False
+            self._last_probe = now
+        try:
+            self._run_device(
+                np.zeros((1, self.num_features), np.int32))
+        except Exception as exc:  # noqa: BLE001 — stay degraded
+            obs.event("serve_probe", ok=False,
+                      error=f"{type(exc).__name__}: {exc}")
+            return False
+        self._degraded = False
+        self.metrics.set_degraded(False)
+        obs.event("serve_probe", ok=True)
+        obs.event("serve_recovered")
+        log.info("serve: device probe succeeded — leaving degraded mode, "
+                 "device predictions resume")
+        return True
 
     def _flight_dump(self, reason: str, force: bool = False) -> None:
         """Rate-limited flight-ring dump (no-op when the ring is off).
@@ -321,6 +361,8 @@ class PredictorSession:
         return self._convert(raw, raw_score)
 
     def _predict_chunk(self, X: np.ndarray) -> np.ndarray:
+        if self._degraded:
+            self._maybe_reprobe()
         if not self._degraded:
             try:
                 return self._run_device(self.space.bin_matrix(X))[0]
@@ -459,6 +501,8 @@ class PredictorSession:
                               attrs={"requests": len(live), "rows": rows})
                 span_ctx.append((tid, r.parent_id))
         t0 = time.perf_counter()
+        if self._degraded:
+            self._maybe_reprobe()
         degraded = self._degraded
         raw, bucket = None, rows
         if not degraded:
@@ -556,6 +600,11 @@ class PredictorSession:
                                      - self._compiles0),
                 "slo_p99_ms": self.slo_p99_ms or None,
                 "slo_burn": self.metrics.slo_burn(),
+                # probe-and-recover (ISSUE 7): degradation is no longer
+                # a one-way latch — these say how often it flipped
+                "reprobe_s": self.reprobe_s or None,
+                "degraded_transitions": self.metrics.degraded_transitions,
+                "recoveries": self.metrics.recoveries,
             }
 
     def close(self) -> None:

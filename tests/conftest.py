@@ -102,6 +102,9 @@ _SLOW = {
     "test_wave_apply.py::test_batched_apply_differential[bagging-7]",
     "test_wave_apply.py::test_batched_apply_differential[bagging-23]",
     "test_wave_apply.py::test_batched_apply_mesh_parallel",
+    "test_robust.py::test_resume_bit_identical_dart",
+    "test_robust.py::test_resume_bit_identical_two_device_mesh",
+    "test_robust.py::test_sigterm_checkpoints_and_resumes",
 }
 
 

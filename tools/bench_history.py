@@ -17,7 +17,11 @@ Rounds whose bench produced no parseable line (``"parsed": null`` —
 e.g. round 1's empty tail) are listed but carry no metrics.  Serving
 rounds trend rows/s + p50/p99 + batch occupancy under their own
 context, and a round that degraded to the host predictor is excluded
-from baselines like a CPU-fallback canary.
+from baselines like a CPU-fallback canary.  A manual-window round whose
+legs needed wedge retries (``wedge_retries`` > 0, stamped by
+``tools/tpu_window.py``) is flagged "recovered" in the table —
+distinguishable from clean rounds without being discarded (the backend
+did answer in the end).
 
 Regression flagging compares each metric of the LATEST comparable round
 against the best earlier comparable round — comparable meaning the same
@@ -169,6 +173,17 @@ def load_round(path: str) -> dict:
         _fold_digest(row["metrics"], parsed)
         return row
     row["context"] = tuple(parsed.get(k) for k in _CONTEXT_KEYS)
+    wr = payload.get("wedge_retries")
+    if isinstance(wr, int) and wr > 0:
+        # a RECOVERED round (tools/tpu_window.py retried wedged legs):
+        # the numbers are real — the backend answered in the end — but
+        # the flag distinguishes them from clean rounds when judging a
+        # flaky window
+        row["recovered"] = wr
+        row["metrics"]["wedge_retries"] = float(wr)
+        row["note"] = ((row.get("note", "") + "; ") if row.get("note")
+                       else "") + f"recovered after {wr} wedge retr" \
+            f"{'y' if wr == 1 else 'ies'}"
     backend = parsed.get("backend")
     if backend:
         # cpu-fallback / cpu-forced rounds are wedge CANARIES: evidence

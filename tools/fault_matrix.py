@@ -1,0 +1,223 @@
+"""CPU-smokeable fault-injection matrix: prove every recovery branch.
+
+Runs the ``LGBM_TPU_FAULTS`` injection points against every recovery
+mode in one process and emits a per-check verdict map, exactly like
+``bench_serve.py --smoke`` — ``tools/run_suite.py`` runs it as the
+``faults`` tier, so every suite round re-proves on CPU that:
+
+- a TRANSIENT device failure retries with backoff and the final model is
+  bit-identical to the no-fault run (retry is a pure re-execution);
+- a FATAL failure under ``abort`` raises ``DeviceWedgedError`` AFTER
+  writing a boundary checkpoint + flight dump, and resuming from that
+  wedge checkpoint reproduces the no-fault model bit-exactly;
+- ``fallback`` re-executes the step on the CPU backend and completes;
+- a transient GRADIENT failure and a transient COLLECTIVE failure both
+  retry clean;
+- an injected serve-device failure degrades the session, and the
+  periodic re-probe recovers it (health + metrics flip back);
+- a failed CHECKPOINT write is survived (training never dies for it)
+  and the loader skips a corrupted checkpoint for the previous valid
+  one.
+
+    python tools/fault_matrix.py --json      # one JSON verdict line
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+CHECKS = {}
+
+
+def check(name, ok, detail=""):
+    CHECKS[name] = bool(ok)
+    print(f"# {'ok ' if ok else 'FAIL'} {name}"
+          + (f" — {detail}" if detail and not ok else ""), flush=True)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description="Fault-injection matrix")
+    ap.add_argument("--json", action="store_true",
+                    help="print a machine-readable verdict line")
+    args = ap.parse_args(argv)
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu import obs
+    from lightgbm_tpu.robust import DeviceWedgedError, faults
+    from lightgbm_tpu.robust.watchdog import guarded_call
+
+    t0 = time.time()
+    art = tempfile.mkdtemp(prefix="fault_matrix_")
+    os.environ["LGBM_TPU_FLIGHT_DIR"] = art
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(500, 6))
+    y = (X[:, 0] - 0.3 * X[:, 2] > 0).astype(np.float64)
+    P = {"objective": "binary", "num_leaves": 7, "min_data_in_leaf": 5,
+         "verbose": -1, "bagging_fraction": 0.8, "bagging_freq": 2}
+
+    def train(extra=None, n=6):
+        p = dict(P)
+        p.update(extra or {})
+        ds = lgb.Dataset(X, label=y, params=p)
+        b = lgb.train(p, ds, num_boost_round=n, verbose_eval=False)
+        return b.model_to_string(num_iteration=-1).split("\nparameters:")[0]
+
+    ref = train()
+
+    # ---- device_execute x retry ------------------------------------
+    faults.configure("device_execute:transient@iter=2")
+    try:
+        m = train({"tpu_on_device_error": "retry"})
+        check("device_execute.retry.bit_identical", m == ref)
+    except Exception as exc:  # noqa: BLE001
+        check("device_execute.retry.bit_identical", False, repr(exc))
+    faults.disarm()
+
+    # ---- device_execute x abort (+ wedge checkpoint + resume) ------
+    ckdir = os.path.join(art, "wedge_ckpt")
+    faults.configure("device_execute:raise@iter=3")
+    wedged = False
+    try:
+        train({"tpu_on_device_error": "abort", "tpu_checkpoint_dir": ckdir,
+               "tpu_checkpoint_freq": 0})
+    except DeviceWedgedError:
+        wedged = True
+    except SystemExit:
+        pass
+    faults.disarm()
+    check("device_execute.abort.raises", wedged)
+    cks = glob.glob(os.path.join(ckdir, "ckpt_*"))
+    check("device_execute.abort.wedge_checkpoint", len(cks) == 1)
+    check("device_execute.abort.flight_dumped",
+          len(glob.glob(os.path.join(art, "FLIGHT_*.json"))) >= 1)
+    try:
+        m = train({"tpu_checkpoint_dir": ckdir, "tpu_checkpoint_freq": 0})
+        check("device_execute.abort.resume_bit_identical", m == ref)
+    except Exception as exc:  # noqa: BLE001
+        check("device_execute.abort.resume_bit_identical", False, repr(exc))
+
+    # ---- device_execute x fallback ---------------------------------
+    faults.configure("device_execute:raise@iter=2")
+    try:
+        m = train({"tpu_on_device_error": "fallback"})
+        check("device_execute.fallback.completes", m == ref)
+    except Exception as exc:  # noqa: BLE001
+        check("device_execute.fallback.completes", False, repr(exc))
+    faults.disarm()
+
+    # ---- gradients x retry -----------------------------------------
+    faults.configure("gradients:transient@iter=1")
+    try:
+        m = train({"tpu_on_device_error": "retry"})
+        check("gradients.retry.bit_identical", m == ref)
+    except Exception as exc:  # noqa: BLE001
+        check("gradients.retry.bit_identical", False, repr(exc))
+    faults.disarm()
+
+    # ---- collective x retry (direct guarded call) ------------------
+    faults.configure("collective:transient")
+    calls = []
+    try:
+        out = guarded_call(lambda: calls.append(1) or 42,
+                           point="collective")
+        check("collective.retry.recovers", out == 42 and len(calls) == 1)
+    except Exception as exc:  # noqa: BLE001
+        check("collective.retry.recovers", False, repr(exc))
+    faults.disarm()
+
+    # ---- stall detection -------------------------------------------
+    obs.enable_flight(64)
+    faults.configure("device_execute:sleep=0.25@iter=1")
+    try:
+        train({"tpu_wedge_timeout_s": 0.05})
+        stalls = [e for e in obs.flight_snapshot()
+                  if e.get("event") == "device_stall"]
+        check("device_execute.stall.stamped", len(stalls) >= 1)
+    except Exception as exc:  # noqa: BLE001
+        check("device_execute.stall.stamped", False, repr(exc))
+    faults.disarm()
+
+    # ---- serve_device x probe-and-recover --------------------------
+    from lightgbm_tpu.serve import PredictorSession
+    ds = lgb.Dataset(X, label=y, params=dict(P))
+    bst = lgb.train(dict(P), ds, num_boost_round=5, verbose_eval=False)
+    faults.configure("serve_device:raise@call=1")
+    sess = PredictorSession(bst, config=dict(
+        P, tpu_serve_reprobe_s=0.05, tpu_serve_max_batch=128))
+    p_ref = bst.predict(X[:16])
+    out1 = sess.predict(X[:16])
+    st1 = sess.stats()
+    check("serve_device.degrades", bool(st1["degraded"])
+          and st1["degraded_transitions"] == 1)
+    check("serve_device.host_fallback_correct",
+          np.allclose(out1, p_ref, atol=1e-6))
+    time.sleep(0.11)
+    out2 = sess.predict(X[:16])
+    st2 = sess.stats()
+    check("serve_device.reprobe_recovers",
+          not st2["degraded"] and st2["recoveries"] == 1)
+    check("serve_device.device_after_recovery_correct",
+          np.allclose(out2, p_ref, atol=1e-6))
+    sess.close()
+    faults.disarm()
+
+    # ---- checkpoint_write fault is survived; corrupt ckpt skipped --
+    ckdir2 = os.path.join(art, "ckpt2")
+    faults.configure("checkpoint_write:raise@call=2")
+    try:
+        m = train({"tpu_checkpoint_dir": ckdir2, "tpu_checkpoint_freq": 2})
+        check("checkpoint_write.fault_survived", m == ref)
+    except Exception as exc:  # noqa: BLE001
+        check("checkpoint_write.fault_survived", False, repr(exc))
+    faults.disarm()
+    cks = sorted(glob.glob(os.path.join(ckdir2, "ckpt_*")))
+    if cks:
+        # corrupt the newest checkpoint's state; the loader must fall
+        # back to the previous valid one
+        with open(os.path.join(cks[-1], "state.npz"), "ab") as fh:
+            fh.write(b"garbage")
+        from lightgbm_tpu.config import Config
+        from lightgbm_tpu.robust import CheckpointManager
+        mgr = CheckpointManager(ckdir2)
+        peeked = mgr.peek(Config.from_params(
+            dict(P, tpu_checkpoint_dir=ckdir2, tpu_checkpoint_freq=2)))
+        ok = (peeked is not None
+              and peeked[0] != cks[-1]) if len(cks) > 1 else \
+            (peeked is None)
+        check("checkpoint.corrupt_newest_skipped", ok,
+              f"picked {peeked and peeked[0]}, had {cks}")
+    else:
+        check("checkpoint.corrupt_newest_skipped", False,
+              "no checkpoints written")
+
+    record = {
+        "kind": "fault_matrix",
+        "t": round(time.time(), 1),
+        "wall_s": round(time.time() - t0, 1),
+        "checks": CHECKS,
+        "ok": all(CHECKS.values()),
+        "artifacts_dir": art,
+    }
+    if args.json:
+        print(json.dumps(record))
+    else:
+        print(f"# {sum(CHECKS.values())}/{len(CHECKS)} checks passed "
+              f"({record['wall_s']}s)")
+    return 0 if record["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -152,6 +152,26 @@ ENV_VARS = [
      "serving-engine override for `tpu_serve_queue_depth` — the queued-"
      "row bound after which `submit` fails fast with an overload error "
      "(explicit backpressure instead of unbounded buffering)."),
+    ("LGBM_TPU_FAULTS",
+     "deterministic fault-injection spec (robust/faults.py) — "
+     "`point:action[@cond[&cond...]]` legs separated by `;`.  Points: "
+     "`device_execute`, `gradients`, `collective`, `serve_device`, "
+     "`checkpoint_write`.  Actions: `raise` (fatal), `transient` (the "
+     "watchdog's retry path), `sleep=S` (stall the step), `hang`.  "
+     "Conds: `iter=N` (boosting iteration), `call=N` (N-th check at "
+     "that point), `p=F` (seeded probability), `n=N` (fire at most N "
+     "times, default 1, -1 = always).  Example: "
+     "`device_execute:transient@iter=3&n=2;serve_device:raise`.  Used "
+     "by the `tools/fault_matrix.py` suite tier to prove every "
+     "recovery branch on CPU."),
+    ("LGBM_TPU_FAULTS_SEED",
+     "seed for the fault harness's probabilistic conds (`p=`); the same "
+     "spec + seed replays the identical fault schedule (default 0)."),
+    ("LGBM_TPU_SERVE_REPROBE_S",
+     "serving-engine override for `tpu_serve_reprobe_s` — seconds "
+     "between device re-probes while a session is degraded to the host "
+     "predictor; a successful probe flips `/health` back to `ok` "
+     "(`0` disables, restoring the old one-way latch)."),
     ("LGBM_TPU_PREDICT_MIN_WORK",
      "CLI `task=predict` routing override: the rows x trees work "
      "threshold above which value predictions go through the serving "

@@ -28,6 +28,14 @@ storm runs and ``/health`` carries the load-balancer signals
 (queue_rows, uptime_s, compile_count, slo_burn) — so every suite round
 re-proves the serving engine AND its introspection plane end to end on
 CPU.
+
+The ``faults`` tier (ISSUE 7) runs ``tools/fault_matrix.py --json``:
+every ``LGBM_TPU_FAULTS`` injection point x recovery mode — transient
+retry (bit-identical model), fatal abort (wedge checkpoint + flight
+dump + bit-exact resume), CPU fallback, collective retry, stall
+stamping, serve degrade-and-reprobe, checkpoint-write faults and
+corrupt-checkpoint fallback — so every suite round re-proves the whole
+fault-tolerance plane on CPU.
 """
 from __future__ import annotations
 
@@ -105,11 +113,20 @@ def run_tier(tier: str, select: str, timeout: int,
     }
 
 
-def run_serve_smoke(timeout: int, runner=subprocess.run,
-                    py: str = sys.executable) -> dict:
-    """The serve leg: one ``bench_serve.py --smoke`` subprocess; its
-    per-check verdict map becomes this tier's counts."""
-    argv = [py, os.path.join(REPO, "tools", "bench_serve.py"), "--smoke"]
+# built-in (non-pytest) tiers: tier name -> argv tail under tools/
+_TOOL_TIERS = {
+    "serve": ["bench_serve.py", "--smoke"],
+    "faults": ["fault_matrix.py", "--json"],
+}
+
+
+def run_tool_smoke(tier: str, timeout: int, runner=subprocess.run,
+                   py: str = sys.executable) -> dict:
+    """A built-in tool tier (serve smoke / fault matrix): one subprocess
+    whose last JSON line carries a per-check verdict map — that map
+    becomes the tier's counts."""
+    tool = _TOOL_TIERS[tier]
+    argv = [py, os.path.join(REPO, "tools", tool[0])] + tool[1:]
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = env.get("JAX_PLATFORMS", "cpu")
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
@@ -132,8 +149,8 @@ def run_serve_smoke(timeout: int, runner=subprocess.run,
     counts = {"passed": sum(1 for v in checks.values() if v),
               "failed": sum(1 for v in checks.values() if not v)}
     return {
-        "tier": "serve",
-        "cmd": "tools/bench_serve.py --smoke",
+        "tier": tier,
+        "cmd": "tools/" + " ".join(tool),
         "rc": rc,
         "ok": rc == 0 and bool((parsed or {}).get("ok")),
         "empty": False,
@@ -144,13 +161,19 @@ def run_serve_smoke(timeout: int, runner=subprocess.run,
     }
 
 
+def run_serve_smoke(timeout: int, runner=subprocess.run,
+                    py: str = sys.executable) -> dict:
+    """Back-compat alias for the serve tool tier."""
+    return run_tool_smoke("serve", timeout, runner=runner, py=py)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="Run the quick/slow test tiers and write SUITE_rN.json")
-    ap.add_argument("--tiers", default="quick,slow,serve",
+    ap.add_argument("--tiers", default="quick,slow,serve,faults",
                     help="comma list of tiers: pytest markers plus the "
-                         "built-in 'serve' smoke leg "
-                         "(default quick,slow,serve)")
+                         "built-in 'serve' smoke and 'faults' matrix "
+                         "legs (default quick,slow,serve,faults)")
     ap.add_argument("--select", default="",
                     help="pytest collection target (file or node id) "
                          "instead of the whole tests/ dir")
@@ -166,20 +189,21 @@ def main(argv=None) -> int:
 
     tiers = [t.strip() for t in args.tiers.split(",") if t.strip()]
     if args.select and len(tiers) > 1:
-        # --select narrows pytest collection; the serve smoke is not a
-        # pytest tier, so a narrowed run drops it — unless serve is the
-        # ONLY tier asked for (then it runs, ignoring the selection)
-        tiers = [t for t in tiers if t != "serve"]
+        # --select narrows pytest collection; the tool tiers are not
+        # pytest tiers, so a narrowed run drops them — unless a tool
+        # tier is the ONLY tier asked for (then it runs, ignoring the
+        # selection)
+        tiers = [t for t in tiers if t not in _TOOL_TIERS]
     record = {"kind": "suite", "t": round(time.time(), 1), "tiers": {}}
     total = 0.0
     for tier in tiers:
-        if tier == "serve":
-            print("# tier serve: tools/bench_serve.py --smoke ...",
-                  flush=True)
-            res = run_serve_smoke(args.timeout)
-            record["tiers"]["serve"] = res
+        if tier in _TOOL_TIERS:
+            print(f"# tier {tier}: tools/"
+                  f"{' '.join(_TOOL_TIERS[tier])} ...", flush=True)
+            res = run_tool_smoke(tier, args.timeout)
+            record["tiers"][tier] = res
             total += res["wall_s"]
-            print(f"# tier serve: rc={res['rc']} {res['counts']} "
+            print(f"# tier {tier}: rc={res['rc']} {res['counts']} "
                   f"({res['wall_s']}s)", flush=True)
             continue
         print(f"# tier {tier}: pytest -m {tier} "

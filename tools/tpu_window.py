@@ -137,7 +137,12 @@ def checklist_legs(art_dir: str, dry_run: bool, py: str = sys.executable):
 
     def env_for(tag, extra=None, dry_env=None):
         env = {"LGBM_TPU_HEALTH": "monitor",
-               "LGBM_TPU_TELEMETRY": os.path.join(art_dir, f"telem_{tag}")}
+               "LGBM_TPU_TELEMETRY": os.path.join(art_dir, f"telem_{tag}"),
+               # every leg carries a flight ring dumping into the
+               # artifacts dir, so a wedged leg leaves its own
+               # post-mortem beside the bench numbers (ISSUE 7)
+               "LGBM_TPU_FLIGHT": "256",
+               "LGBM_TPU_FLIGHT_DIR": art_dir}
         if dry_run:
             env.update(dry_env if dry_env is not None else _DRY_BENCH_ENV)
         if extra:
@@ -190,36 +195,74 @@ def _parse_json_tail(stdout: str):
     return None
 
 
-def run_legs(legs, runner=subprocess.run, timeout: int = 1800):
+def _run_one(leg, runner, timeout):
+    env = {**os.environ, **leg["env"]}
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    try:
+        r = runner(leg["argv"], env=env, cwd=REPO, timeout=timeout,
+                   capture_output=True, text=True)
+        return r.returncode, r.stdout or "", r.stderr or "", False
+    except subprocess.TimeoutExpired as exc:
+        # keep the partial output: how far a leg got before wedging
+        # IS the diagnostic this watcher exists to capture
+        def _s(b):
+            return (b.decode(errors="replace")
+                    if isinstance(b, bytes) else (b or ""))
+        return (-1, _s(exc.stdout),
+                _s(exc.stderr) + f"\n[timed out after {timeout}s]", True)
+    except OSError as exc:
+        return -2, "", f"{type(exc).__name__}: {exc}", False
+
+
+def run_legs(legs, runner=subprocess.run, timeout: int = 1800,
+             wedge_retries: int = 1, backoff_s: float = 5.0):
+    """Run the checklist legs; a leg that dies in a WEDGE-shaped way
+    (timeout, or a transient runtime error in its output tail) is
+    retried up to ``wedge_retries`` times with exponential backoff +
+    seeded jitter instead of abandoning the window — the same
+    classify/backoff path the in-process watchdog applies, lifted to
+    the subprocess level (robust/watchdog.py classify_text).  Each
+    leg's record carries ``wedge_retries``/``wedge_class`` so
+    bench_history.py can distinguish recovered rounds from clean
+    ones."""
+    from lightgbm_tpu.robust.watchdog import backoff_delays, classify_text
     results = {}
     for leg in legs:
-        env = {**os.environ, **leg["env"]}
-        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
         t0 = time.time()
         print(f"# leg {leg['name']}: {' '.join(leg['argv'][:2])} ...",
               flush=True)
-        try:
-            r = runner(leg["argv"], env=env, cwd=REPO, timeout=timeout,
-                       capture_output=True, text=True)
-            rc, out, err = r.returncode, r.stdout or "", r.stderr or ""
-        except subprocess.TimeoutExpired as exc:
-            # keep the partial output: how far a leg got before wedging
-            # IS the diagnostic this watcher exists to capture
-            def _s(b):
-                return (b.decode(errors="replace")
-                        if isinstance(b, bytes) else (b or ""))
-            rc = -1
-            out = _s(exc.stdout)
-            err = _s(exc.stderr) + f"\n[timed out after {timeout}s]"
-        except OSError as exc:
-            rc, out, err = -2, "", f"{type(exc).__name__}: {exc}"
+        attempts = 0
+        wedge_class = None
+        delays = backoff_delays(max(wedge_retries, 0), base_s=backoff_s,
+                                cap_s=8 * backoff_s)
+        while True:
+            rc, out, err, timed_out = _run_one(leg, runner, timeout)
+            if rc == 0 or attempts >= wedge_retries:
+                break
+            cls = classify_text(out + "\n" + err, timed_out=timed_out)
+            if cls is None:
+                break  # a real failure — retrying would only repeat it
+            wedge_class = cls
+            delay = delays[min(attempts, len(delays) - 1)] if delays else 0
+            print(f"# leg {leg['name']}: {cls} failure (rc={rc}) — "
+                  f"retrying in {delay:.1f}s "
+                  f"({attempts + 1}/{wedge_retries})", flush=True)
+            time.sleep(delay)
+            attempts += 1
         rec = {"rc": rc, "seconds": round(time.time() - t0, 1)}
+        if attempts:
+            rec["wedge_retries"] = attempts
+            rec["wedge_class"] = wedge_class
+            rec["recovered"] = rc == 0
         if leg["parse_json"]:
             rec["parsed"] = _parse_json_tail(out)
         tail = (out + ("\n" + err if err else "")).splitlines()[-8:]
         rec["tail"] = tail
         results[leg["name"]] = rec
         status = "ok" if rc == 0 else f"rc={rc}"
+        if attempts:
+            status += f" after {attempts} wedge retr" \
+                      f"{'y' if attempts == 1 else 'ies'}"
         print(f"# leg {leg['name']}: {status} ({rec['seconds']}s)",
               flush=True)
     return results
@@ -280,13 +323,15 @@ def export_serve_trace(art_dir: str):
 
 def run_checklist(out_dir: str, n: int, dry_run: bool,
                   runner=subprocess.run, timeout: int = 1800,
-                  backend: str = "", only=None) -> dict:
+                  backend: str = "", only=None,
+                  wedge_retries: int = 1) -> dict:
     art_dir = os.path.join(out_dir, f"tpu_window_r{n:02d}")
     os.makedirs(art_dir, exist_ok=True)
     legs, trace_dir = checklist_legs(art_dir, dry_run)
     if only:
         legs = [leg for leg in legs if leg["name"] in only]
-    results = run_legs(legs, runner=runner, timeout=timeout)
+    results = run_legs(legs, runner=runner, timeout=timeout,
+                       wedge_retries=wedge_retries)
     health = collect_health(art_dir)
     bench_parsed = (results.get("bench") or {}).get("parsed")
     record = {
@@ -300,6 +345,14 @@ def run_checklist(out_dir: str, n: int, dry_run: bool,
         "rc": 0 if all(r["rc"] == 0 for r in results.values()) else 1,
         "parsed": bench_parsed,
         "legs": results,
+        # total wedge retries across RECOVERED legs: >0 marks a
+        # recovered round — bench_history.py flags it so a number that
+        # needed retries is never quoted as a clean datapoint.  Legs
+        # that retried and STILL failed leave rc!=0 on the record; their
+        # attempts must not dress the round up as recovered
+        "wedge_retries": sum(r.get("wedge_retries", 0)
+                             for r in results.values()
+                             if r.get("recovered")),
         "health": health,
         "trace_dir": os.path.relpath(trace_dir, out_dir),
         "trace_files": sum(len(fs) for _, _, fs in os.walk(trace_dir)),
@@ -371,6 +424,11 @@ def main(argv=None) -> int:
                     help="comma list restricting which checklist legs "
                          "run (bench,bench_profile,bench_maxbin63,"
                          "prof_kernels,trace); default all")
+    ap.add_argument("--wedge-retries", type=int, default=1,
+                    help="times a wedge-shaped leg failure (timeout / "
+                         "transient runtime error) is retried with "
+                         "backoff before the leg is abandoned "
+                         "(default 1; 0 restores the old behavior)")
     args = ap.parse_args(argv)
     only = {s.strip() for s in args.legs.split(",") if s.strip()} or None
 
@@ -388,7 +446,8 @@ def main(argv=None) -> int:
                   f"capturing window as round r{n:02d}", flush=True)
             rec = run_checklist(args.out, n, args.dry_run,
                                 timeout=args.leg_timeout, backend=backend,
-                                only=only)
+                                only=only,
+                                wedge_retries=args.wedge_retries)
             # exit 0 only for a FULLY clean capture: every leg rc 0 and
             # (when the bench leg ran) a parsed headline line — a failed
             # trace/prof leg must be visible to cron wrappers even though
