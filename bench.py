@@ -12,7 +12,9 @@ vs_baseline > 1 means faster than the reference CPU result.
 
 Env knobs: BENCH_ROWS (default 1_000_000), BENCH_ITERS (default 10),
 BENCH_LEAVES (default 255), BENCH_MAXBIN (default 255 — 63 fills the
-MXU 4x denser via feature packing, see docs/ROOFLINE.md). BENCH_TASK=rank switches to an
+MXU 4x denser via feature packing, see docs/ROOFLINE.md), BENCH_FUSED=0
+(disable in-kernel sibling subtraction — the tpu_window A/B leg).
+BENCH_TASK=rank switches to an
 MSLR-WEB30K-shaped lambdarank run only (ragged queries of 1..1251 docs,
 136 features, NDCG@10) against the reference's published MSLR CPU time
 (BASELINE.md: 215.32 s for 500 iters over 2.27M rows).
@@ -80,6 +82,15 @@ def _embed_observability(result: dict) -> None:
     if kernels:
         result["kernel_roofline"] = {
             k: v["roofline_frac"] for k, v in kernels.items()}
+    wave = td.get("wave_pipeline") or {}
+    # flat wave-pipeline stamps: bench_history trends these so a silent
+    # histogram-mode downgrade is flagged like a perf regression
+    if wave.get("waves_per_tree") is not None:
+        result["waves_per_tree"] = wave["waves_per_tree"]
+    if wave.get("hist_mode"):
+        result["hist_mode"] = wave["hist_mode"]
+    if wave.get("fused_sibling") is not None:
+        result["fused_sibling"] = wave["fused_sibling"]
     counters = td.get("counters") or {}
     if counters.get("health/checks"):
         # health-mode runs carry their verdict in the bench line itself,
@@ -250,6 +261,11 @@ def main() -> None:
     params = {"objective": "binary", "metric": "auc", "num_leaves": leaves,
               "learning_rate": 0.1, "max_bin": max_bin,
               "min_data_in_leaf": 100, "verbose": -1}
+    # BENCH_FUSED=0: the unfused-sibling A/B leg (tools/tpu_window.py
+    # bench_unfused) — trees are bit-identical, only the kernel pipeline
+    # differs, so value deltas are pure fusion economics
+    if os.environ.get("BENCH_FUSED", "") == "0":
+        params["tpu_fused_sibling"] = False
     per_iter, compile_time, bin_time, auc_val, _ = _measure(
         params, X, y, None, iters, "auc")
 

@@ -498,8 +498,11 @@ class GBDT(PredictorBase):
 
         self._raw_cached = False  # set True when _grow_raw is _JIT_CACHE'd
         self._report_waves = False  # wave grower emits its pass count
-        self._wave_cost_args = None  # (F_kern, B_kern, mode) for profile
+        self._wave_cost_args = None  # (F_kern, B_kern, mode, packed,
+        #                               fused) for profile attribution
         self._wave_batched = False  # wave path applies splits one-pass
+        self._wave_info = None  # telemetry: {hist_mode, wave_capacity,
+        #                         fused_sibling} when the wave path runs
 
         # ---- CEGB (reference: cost_effective_gradient_boosting.hpp) -----
         self._cegb_on = False
@@ -620,11 +623,28 @@ class GBDT(PredictorBase):
                     gain_gate=float(config.tpu_wave_gain_gate),
                     block_rows=int(config.tpu_block_rows),
                     batched_apply=bool(
-                        getattr(config, "tpu_batched_split_apply", True)))
+                        getattr(config, "tpu_batched_split_apply", True)),
+                    packed=True,
+                    fused_sibling=bool(
+                        getattr(config, "tpu_fused_sibling", True)))
             use_wave = tl == "data" and wave_kw is not None
             self.uses_wave = use_wave
             self._wave_batched = bool(
                 use_wave and wave_kw.get("batched_apply", True))
+            if use_wave:
+                from ..core.wave_grower import effective_pipeline
+                # the mesh grower runs under reduce_fn (siblings are
+                # subtracted after the psum) — effective_pipeline is the
+                # same gate build_wave_grow_fn applies
+                _, cap_eff, fused_eff = effective_pipeline(
+                    int(config.tpu_wave_capacity),
+                    fused_sibling=wave_kw["fused_sibling"],
+                    data_parallel=True)
+                self._wave_info = {
+                    "hist_mode": self._hist_mode(config),
+                    "wave_capacity": cap_eff,
+                    "fused_sibling": fused_eff,
+                }
             self._grow = make_engine_grower(
                 tl, self.meta, self.split_cfg, self.B, mesh,
                 wave_kw=wave_kw if use_wave else None,
@@ -656,6 +676,21 @@ class GBDT(PredictorBase):
 
             batched = bool(getattr(config, "tpu_batched_split_apply", True))
             self._wave_batched = batched
+            fused_knob = bool(getattr(config, "tpu_fused_sibling", True))
+            # the EFFECTIVE pipeline (same gates build_wave_grow_fn
+            # applies): packed lane pairs whenever the kernel owns every
+            # column — the mixed-width side-pass speaks the triple
+            # layout — and fusion additionally needs un-bundled
+            from ..core.wave_grower import effective_pipeline
+            packed, cap_eff, fused_eff = effective_pipeline(
+                int(config.tpu_wave_capacity),
+                fused_sibling=fused_knob,
+                mixed=mixed_info is not None, bundled=self._bundled)
+            self._wave_info = {
+                "hist_mode": self._hist_mode(config),
+                "wave_capacity": cap_eff,
+                "fused_sibling": fused_eff,
+            }
 
             def build_wave():
                 return build_wave_grow_fn(
@@ -667,7 +702,8 @@ class GBDT(PredictorBase):
                     B_phys=self.B_phys, bundled=self._bundled,
                     cegb=cegb_cfg, mixed=mixed_info,
                     report_waves=self._report_waves,
-                    batched_apply=batched)
+                    batched_apply=batched,
+                    packed=packed, fused_sibling=fused_knob)
             if cegb_cfg is None:
                 mixed_key = (None if mixed_info is None else
                              (mixed_info.narrow_idx.tobytes(),
@@ -679,7 +715,7 @@ class GBDT(PredictorBase):
                        self._hist_mode(config),
                        float(config.tpu_wave_gain_gate),
                        int(config.tpu_block_rows), mixed_key,
-                       self._report_waves, batched)
+                       self._report_waves, batched, packed, fused_knob)
                 self._grow_raw = _cached_jit(key, build_wave)
                 self._raw_cached = True
             else:
@@ -696,14 +732,14 @@ class GBDT(PredictorBase):
                         xbt[mixed_info.narrow_idx]).astype(np.uint8)),
                     jnp.asarray(np.ascontiguousarray(
                         xbt[mixed_info.wide_idx])))
-            # kernel-shape triple for profile mode's analytical wave-
+            # kernel-shape tuple for profile mode's analytical wave-
             # kernel attribution (ops/pallas_hist.wave_kernel_cost)
             self._wave_cost_args = (
                 (len(mixed_info.narrow_idx) if mixed_info is not None
                  else int(train_ds.X_bin.shape[1])),
                 (int(mixed_info.B_narrow) if mixed_info is not None
                  else self.B_phys),
-                self._hist_mode(config))
+                self._hist_mode(config), packed, fused_eff)
         else:
             from ..core.grower import build_grow_fn
             from ..core.histogram import hist_onehot, hist_scatter
@@ -758,16 +794,18 @@ class GBDT(PredictorBase):
 
     @staticmethod
     def _hist_mode(config: Config) -> str:
-        """Histogram precision: "2xbf16" (default for float32 — hi/lo bf16
-        split, ~16 mantissa bits on g/h, f32 accumulation; the reference
-        keeps float histograms even in single-precision GPU mode,
-        gpu_tree_learner.h:80-84), "highest" for gpu_use_dp or explicit
-        opt-in, "bf16" on explicit opt-in."""
+        """Histogram precision, resolved to the kernel-mode name: "2xbf16"
+        (the default — hi/lo bf16 split, ~16 mantissa bits on g/h, f32
+        accumulation; the reference keeps float histograms even in
+        single-precision GPU mode, gpu_tree_learner.h:80-84), "highest"
+        for gpu_use_dp or explicit opt-in, "bf16" on explicit opt-in.
+        ``tpu_hist_dtype`` accepts the kernel-mode names directly;
+        "float32"/"bfloat16" survive as back-compat aliases."""
         if config.gpu_use_dp or config.tpu_hist_dtype == "highest":
             return "highest"
-        if config.tpu_hist_dtype == "bfloat16":
+        if config.tpu_hist_dtype in ("bfloat16", "bf16"):
             return "bf16"
-        return "2xbf16"
+        return "2xbf16"  # "2xbf16" or its alias "float32"
 
     def _jit_helpers(self) -> None:
         """Fuse the whole boosting iteration into a handful of jitted
@@ -1437,6 +1475,15 @@ class GBDT(PredictorBase):
         # attribution this field exists for
         part_passes = ((int(waves) if waves else None) if part_batched
                        else splits)
+        # wave-pipeline mode stamps (ISSUE 8): which histogram kernel ran
+        # and at what effective capacity — bench_history trends these so
+        # a silent mode downgrade is flagged like a perf regression
+        wave_fields = {}
+        if self.uses_wave and self._wave_info is not None:
+            wave_fields = dict(
+                hist_mode=self._wave_info["hist_mode"],
+                wave_capacity=self._wave_info["wave_capacity"],
+                fused_sibling=self._wave_info["fused_sibling"])
         obs.event(
             "iteration",
             iteration=self.iter_,
@@ -1452,7 +1499,8 @@ class GBDT(PredictorBase):
             partition_passes=part_passes,
             partition_batched=part_batched,
             cum_row_iters_per_s=round(
-                N * self._telem_iters / max(self._telem_train_s, 1e-9), 1))
+                N * self._telem_iters / max(self._telem_train_s, 1e-9), 1),
+            **wave_fields)
         if obs.profile_enabled():
             if kern_rows and kern_rows > 0 and recompiles == 0 \
                     and getattr(self, "_wave_cost_args", None):
@@ -1464,9 +1512,11 @@ class GBDT(PredictorBase):
                 # trace/compile lands inside phase_s['tree growth'] and
                 # would drown the fraction the operator acts on.
                 from ..ops.pallas_hist import wave_kernel_cost
-                Fk, Bk, mode = self._wave_cost_args
+                Fk, Bk, mode, packed_k, fused_k = self._wave_cost_args
                 flops, nbytes = wave_kernel_cost(kern_rows, Fk, Bk, mode,
-                                                 waves=waves or 1)
+                                                 waves=waves or 1,
+                                                 packed=packed_k,
+                                                 fused=fused_k)
                 achieved = phase_s.get("tree growth", iter_s)
                 obs.record_kernel("lgbm/pallas_hist_wave", flops, nbytes,
                                   achieved, phase="tree growth",

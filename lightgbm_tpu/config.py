@@ -287,16 +287,29 @@ class Config:
     gpu_platform_id: int = -1
     gpu_device_id: int = -1
     gpu_use_dp: bool = False
-    tpu_hist_dtype: str = "float32"     # histogram matmul input precision:
-                                        # float32 = hi/lo bf16 split (~16
-                                        # mantissa bits on g/h, f32 accum,
-                                        # 2 MXU passes), highest = exact f32
+    tpu_hist_dtype: str = "2xbf16"      # histogram matmul input precision,
+                                        # by kernel-mode name: 2xbf16 =
+                                        # hi/lo bf16 split (~16 mantissa
+                                        # bits on g/h, f32 accum, 2 MXU
+                                        # passes), highest = exact f32
                                         # (3 passes; also via gpu_use_dp),
-                                        # bfloat16 = 1 pass (~8 bits)
+                                        # bf16 = 1 pass (~8 bits).
+                                        # Back-compat aliases: float32 ->
+                                        # 2xbf16, bfloat16 -> bf16
     tpu_block_rows: int = 1024          # Pallas histogram kernel row-block
-    tpu_wave_capacity: int = 42         # leaves histogrammed per wave pass
-                                        # (<= 42: 3 channels each in the
-                                        # 128-lane Pallas kernel)
+    tpu_wave_capacity: int = 63         # leaves histogrammed per wave pass
+                                        # (<= 63: a g/h lane pair each in
+                                        # the 128-lane Pallas kernel, the
+                                        # count channel folded into one
+                                        # extra single-pass matmul)
+    tpu_fused_sibling: bool = True      # compute each wave's sibling
+                                        # histograms (parent minus smaller
+                                        # child) INSIDE the wave kernel
+                                        # launch instead of a separate XLA
+                                        # subtraction pass — histograms
+                                        # are bit-identical either way;
+                                        # false keeps the unfused path as
+                                        # the differential-test oracle
     tpu_wave_gain_gate: float = 0.5     # split-phase throttle: only commit
                                         # leaves with gain >= gate * best
                                         # ready gain (1 = strict best-first
@@ -544,8 +557,12 @@ class Config:
                 log.fatal("bagging_freq and bagging_fraction (in (0,1)) are required for rf")
         if not (0.0 <= self.tpu_wave_gain_gate <= 1.0):
             log.fatal("tpu_wave_gain_gate should be in [0.0, 1.0]")
-        if self.tpu_hist_dtype not in ("float32", "bfloat16", "highest"):
-            log.fatal("tpu_hist_dtype should be float32, bfloat16 or highest")
+        if self.tpu_hist_dtype not in ("2xbf16", "bf16", "highest",
+                                       "float32", "bfloat16"):
+            log.fatal("tpu_hist_dtype should be 2xbf16, bf16 or highest "
+                      "(aliases: float32 -> 2xbf16, bfloat16 -> bf16)")
+        if self.tpu_wave_capacity < 1:
+            log.fatal("tpu_wave_capacity should be >= 1")
         if self.tpu_block_rows < 128 or self.tpu_block_rows % 128 != 0:
             log.fatal("tpu_block_rows should be a positive multiple of 128 "
                       "(TPU lane-tile alignment)")

@@ -78,7 +78,7 @@ def summarize(events: List[dict]) -> dict:
     for e in iters0:
         for k, v in (e.get("phase_s") or {}).items():
             phase_s[k] += float(v)
-        per_iteration.append({
+        row = {
             "iteration": e.get("iteration"),
             "iter_s": e.get("iter_s"),
             "leaves": e.get("leaves"),
@@ -87,7 +87,11 @@ def summarize(events: List[dict]) -> dict:
             "phase_s": e.get("phase_s") or {},
             "metrics": e.get("metrics") or {},
             "cum_row_iters_per_s": e.get("cum_row_iters_per_s"),
-        })
+        }
+        for k in ("hist_mode", "wave_capacity", "fused_sibling"):
+            if e.get(k) is not None:
+                row[k] = e[k]
+        per_iteration.append(row)
 
     counters = defaultdict(float)
     summaries = [e for e in events if e.get("event") == "summary"]
@@ -116,7 +120,28 @@ def summarize(events: List[dict]) -> dict:
                 counters[f"collective/{kind}/{tag}calls"] += 1
                 counters[f"collective/{kind}/{tag}bytes"] += e.get("bytes", 0)
 
+    # waves-per-tree: kernel launches per grown tree, the CPU-measurable
+    # wave-scheduling efficiency figure (ISSUE 8 — packed lane pairs cut
+    # it ~1.5x on deep trees); trees that failed to grow don't count
+    waves_sum = trees_sum = 0
+    for e in iters0:
+        w = e.get("waves")
+        if isinstance(w, (int, float)) and w >= 0:
+            grown = sum(1 for x in (e.get("leaves") or [])
+                        if isinstance(x, (int, float)) and x > 1)
+            if grown:
+                waves_sum += w
+                trees_sum += grown
+
     last = per_iteration[-1] if per_iteration else {}
+    wave_pipeline = {}
+    if trees_sum:
+        wave_pipeline["waves_per_tree"] = round(waves_sum / trees_sum, 3)
+        wave_pipeline["waves_total"] = int(waves_sum)
+        wave_pipeline["trees_grown"] = int(trees_sum)
+    for k in ("hist_mode", "wave_capacity", "fused_sibling"):
+        if last.get(k) is not None:
+            wave_pipeline[k] = last[k]
     out = {
         "processes": procs,
         "iterations": len(per_iteration),
@@ -130,6 +155,8 @@ def summarize(events: List[dict]) -> dict:
         "parse_errors": sum(e.get("count", 0) for e in events
                             if e.get("event") == "_parse_errors"),
     }
+    if wave_pipeline:
+        out["wave_pipeline"] = wave_pipeline
     skew = phase_skew(proc_phase)
     if skew:
         out["phase_skew"] = skew
@@ -392,6 +419,25 @@ def trace_summary(events: List[dict]) -> dict:
 _NUM = (int, float)
 EVENT_SCHEMAS = {
     # event name -> {field: (types..., required)}
+    # per-iteration training record (boosting/gbdt.py).  Nullable fields
+    # (waves, kernel_rows, partition_passes — None off the wave path) are
+    # deliberately NOT listed: the validator type-checks listed fields
+    # only, and a null would fail the int check on legitimate streams.
+    "iteration": {
+        "iteration": (int, True),
+        "iter_s": (_NUM, True),
+        "leaves": (list, False),
+        "metrics": (dict, False),
+        "phase_s": (dict, False),
+        "recompiles": (int, False),
+        "partition_batched": (bool, False),
+        "cum_row_iters_per_s": (_NUM, False),
+        # wave-pipeline mode stamps (ISSUE 8): emitted only on the wave
+        # path, never null
+        "hist_mode": (str, False),
+        "wave_capacity": (int, False),
+        "fused_sibling": (bool, False),
+    },
     "kernel_profile": {
         "kernel": (str, True),
         "phase": (str, False),
@@ -578,6 +624,21 @@ def render(digest: dict) -> str:
         if digest.get("cum_row_iters_per_s"):
             out.append(f"cumulative row-iterations/s: "
                        f"{digest['cum_row_iters_per_s']:,}")
+    if digest.get("wave_pipeline"):
+        w = digest["wave_pipeline"]
+        parts = []
+        if w.get("waves_per_tree") is not None:
+            parts.append(f"{w['waves_per_tree']} waves/tree "
+                         f"({w['waves_total']} waves / "
+                         f"{w['trees_grown']} trees)")
+        if w.get("hist_mode"):
+            parts.append(f"hist_mode={w['hist_mode']}")
+        if w.get("wave_capacity") is not None:
+            parts.append(f"capacity={w['wave_capacity']}")
+        if w.get("fused_sibling") is not None:
+            parts.append(f"fused_sibling={'on' if w['fused_sibling'] else 'off'}")
+        out.append("")
+        out.append("wave pipeline: " + ", ".join(parts))
     if digest.get("phase_skew"):
         out.append("")
         out.append(f"{'phase skew (cross-process)':<28}{'min_s':>9}"

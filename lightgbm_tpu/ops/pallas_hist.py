@@ -10,10 +10,29 @@ one-hot tiles.
 
 Channel packing: the MXU processes 128 output lanes per pass regardless of
 how many are used, so the kernel accumulates ``C=128`` weight channels at
-once. Callers pack (g*m, h*m, m) triples for up to 42 different leaf masks
-into those channels, making one data pass produce 42 leaves' histograms —
-this is what makes wave-scheduled leaf growth (core/wave_grower.py) run at
-full MXU utilization.
+once.  Two channel layouts exist:
+
+* ``packed`` (the default fast path): each leaf owns a LANE PAIR (g*m,
+  h*m) — 63 leaves per wave — and the count channel is folded into the
+  same accumulation as ONE extra single-pass matmul whose channel matrix
+  is the 0/1 membership mask.  The mask is exactly representable in
+  bf16 and accumulation is f32, so the folded counts are bit-identical
+  to dedicated f32 count lanes while costing one hardware pass instead
+  of a third of the lane budget.  Capacity 42 -> 63 leaves per launch
+  means ~1.5x fewer kernel launches (and full bins-array reads) per
+  tree.
+* ``triple`` (the differential oracle): (g*m, h*m, m) triples for up to
+  42 leaf masks — the original layout, kept for packed-vs-triple
+  differential testing and for the mixed-width XLA side-pass, which
+  speaks this layout.
+
+Sibling fusion: with a ``parent`` operand the kernel also emits
+parent-minus-child sibling histograms from the same ``pallas_call`` —
+the parent block is read into VMEM once per feature block and the
+sibling written on the final row step, eliminating the separate XLA
+subtraction pass and its extra [F, B, C] HBM round-trip per wave
+(reference: serial_tree_learner.cpp:567 subtracts the smaller child
+from the parent the same way).
 
 Data layout: bins are FEATURE-MAJOR ``[F, N]`` uint8 (the TPU-native
 resident layout — per-feature column access is a contiguous row slice, and
@@ -37,6 +56,25 @@ from jax.experimental.pallas import tpu as pltpu
 C_MAX = 128
 _DEF_BR = 1024
 _DEF_FB = 32  # uint8 sublane tile
+# wave capacity per layout: triple = 3 lanes/leaf; packed = a lane pair
+# per leaf with the top pair left free (63, matching the max_bin=63
+# economics the docs quote) so the count-lane map keeps a dead sentinel
+P_MAX_TRIPLE = C_MAX // 3       # 42
+P_MAX_PACKED = C_MAX // 2 - 1   # 63
+# VMEM budget select_wave_blocks fits the per-grid-step blocks into:
+# ~16MB physical minus headroom for double buffering + compiler temps
+_VMEM_BUDGET = 10 * 2 ** 20
+
+
+def wave_capacity_max(packed: bool) -> int:
+    """Leaves one kernel launch can histogram under the given layout."""
+    return P_MAX_PACKED if packed else P_MAX_TRIPLE
+
+
+def _feat_pack(B: int, FB: int) -> int:
+    """Features whose one-hot factors share one MXU pass (B <= 64)."""
+    pack = max(1, 128 // B)
+    return pack if 128 % B == 0 and FB % pack == 0 else 1
 
 # pallas-tpu renamed TPUCompilerParams -> CompilerParams between the jax
 # versions we run on (CPU CI container vs TPU image); take whichever exists
@@ -52,15 +90,28 @@ def _hist_kernel(bins_ref, gh_ref, out_ref, *, B: int, FB: int):
         out_ref[...] = jnp.zeros_like(out_ref)
 
     gh = gh_ref[...]  # [BR, C]
+    # bin-width specialization: B <= 64 concatenates 128//B features'
+    # one-hot factors into one MXU operand (see _hist_wave_kernel — the
+    # wave kernel had this; the channel kernel now shares it)
+    pack = _feat_pack(B, FB)
     iota = jax.lax.broadcasted_iota(jnp.int32, (1, B), 1)
-    for f in range(FB):
-        col = bins_ref[f, :].astype(jnp.int32)           # [BR]
-        oh = (col[:, None] == iota).astype(jnp.float32)  # [BR, B]
+    for f in range(0, FB, pack):
+        if pack == 1:
+            eq = bins_ref[f, :].astype(jnp.int32)[:, None] == iota
+        else:
+            eq = jnp.concatenate(
+                [bins_ref[f + p, :].astype(jnp.int32)[:, None] == iota
+                 for p in range(pack)], axis=1)           # [BR, pack*B]
+        oh = eq.astype(jnp.float32)
         acc = jax.lax.dot_general(
-            oh, gh, (((0,), (0,)), ((), ())),            # [B, C]
+            oh, gh, (((0,), (0,)), ((), ())),             # [pack*B, C]
             precision=jax.lax.Precision.HIGHEST,
             preferred_element_type=jnp.float32)
-        out_ref[f] += acc
+        if pack == 1:
+            out_ref[f] += acc
+        else:
+            for p in range(pack):
+                out_ref[f + p] += acc[p * B:(p + 1) * B]
 
 
 @functools.partial(jax.jit, static_argnames=("B", "block_rows", "feat_block"))
@@ -103,10 +154,10 @@ def hist_pallas_channels(bins_fm, gh, B: int, block_rows: int = _DEF_BR,
     return out[:F]
 
 
-def _hist_wave_kernel(bins_ref, vecs_ref, slot_ref, out_ref, *,
-                      B: int, FB: int, mode: str):
-    """Multi-leaf histogram step: the (g,h,count)x42-leaf channel matrix is
-    built in VMEM from leaf_id + the slot->leaf map, never touching HBM.
+def _hist_wave_kernel(*refs, B: int, FB: int, mode: str, packed: bool,
+                      fused: bool):
+    """Multi-leaf histogram step: the per-leaf channel matrix is built in
+    VMEM from leaf_id + the slot->leaf map, never touching HBM.
 
     ``mode`` selects the matmul precision/throughput trade:
       "highest" — f32 operands at Precision.HIGHEST (~3 MXU passes);
@@ -115,21 +166,48 @@ def _hist_wave_kernel(bins_ref, vecs_ref, slot_ref, out_ref, *,
                   accumulation is always f32, so only g/h are rounded — to
                   ~16 mantissa bits, tighter than one bf16 pass and ~1.5x
                   faster than "highest";
-      "bf16"    — single bf16 pass (~8 mantissa bits on g/h)."""
+      "bf16"    — single bf16 pass (~8 mantissa bits on g/h).
+
+    ``packed`` selects the channel layout: lane pairs (g, h) per leaf with
+    the count channel folded into one extra single-pass matmul (63 leaves)
+    vs (g, h, count) lane triples (42 leaves).  The folded count pass runs
+    in bf16 in EVERY mode — the membership weights are the 0/1 bag mask,
+    exact in bf16, and accumulation is f32, so folded counts are
+    bit-identical to dedicated count lanes at any precision mode.
+
+    ``fused`` adds parent blocks as inputs and sibling blocks as outputs:
+    on the final row step (the accumulators now hold the full child
+    histograms for this feature block) the sibling is written as
+    parent - child straight from VMEM."""
+    n_out = 2 if packed else 1
+    n_par = n_out if fused else 0
+    bins_ref, vecs_ref, slot_ref = refs[:3]
+    par_refs = refs[3:3 + n_par]
+    acc_refs = refs[3 + n_par:3 + n_par + n_out]
+    sib_refs = refs[3 + n_par + n_out:]
+
     i = pl.program_id(1)
 
     @pl.when(i == 0)
     def _init():
-        out_ref[...] = jnp.zeros_like(out_ref)
+        for r in acc_refs:
+            r[...] = jnp.zeros_like(r)
 
     vecs = vecs_ref[...]                                  # [BR, 4]
     leaf = vecs[:, 3].astype(jnp.int32)                   # [BR]
     slot_leaf = slot_ref[0, :].astype(jnp.int32)          # [C]
-    kind = jax.lax.broadcasted_iota(jnp.int32, (1, C_MAX), 1) % 3
+    lanes = 2 if packed else 3
+    kind = jax.lax.broadcasted_iota(jnp.int32, (1, C_MAX), 1) % lanes
     m = (leaf[:, None] == slot_leaf[None, :]) & (slot_leaf >= 0)[None, :]
-    vals = jnp.where(kind == 0, vecs[:, 0][:, None],
-                     jnp.where(kind == 1, vecs[:, 1][:, None],
-                               vecs[:, 2][:, None]))
+    if packed:
+        vals = jnp.where(kind == 0, vecs[:, 0][:, None], vecs[:, 1][:, None])
+        slot_ct = slot_ref[1, :].astype(jnp.int32)        # [C] count lanes
+        mc = (leaf[:, None] == slot_ct[None, :]) & (slot_ct >= 0)[None, :]
+        ct_b = jnp.where(mc, vecs[:, 2][:, None], 0.0).astype(jnp.bfloat16)
+    else:
+        vals = jnp.where(kind == 0, vecs[:, 0][:, None],
+                         jnp.where(kind == 1, vecs[:, 1][:, None],
+                                   vecs[:, 2][:, None]))
     gh = jnp.where(m, vals, 0.0)                          # [BR, C]
     if mode == "2xbf16":
         gh_hi = gh.astype(jnp.bfloat16)
@@ -143,9 +221,9 @@ def _hist_wave_kernel(bins_ref, vecs_ref, slot_ref, out_ref, *,
     # max_bin=63 run really is ~4x cheaper than max_bin=255 (the reference's
     # GPU backend has the same bins-per-workgroup economics and recommends
     # 63 bins, docs/GPU-Performance.rst:128-130).
-    pack = max(1, 128 // B) if 128 % B == 0 and FB % max(1, 128 // B) == 0 \
-        else 1
+    pack = _feat_pack(B, FB)
     iota = jax.lax.broadcasted_iota(jnp.int32, (1, B), 1)
+    dims = (((0,), (0,)), ((), ()))
     for f in range(0, FB, pack):
         if pack == 1:
             eq = bins_ref[f, :].astype(jnp.int32)[:, None] == iota
@@ -156,12 +234,11 @@ def _hist_wave_kernel(bins_ref, vecs_ref, slot_ref, out_ref, *,
         if mode == "highest":
             oh = eq.astype(jnp.float32)
             acc = jax.lax.dot_general(
-                oh, gh, (((0,), (0,)), ((), ())),
+                oh, gh, dims,
                 precision=jax.lax.Precision.HIGHEST,
                 preferred_element_type=jnp.float32)
         elif mode == "2xbf16":
             oh = eq.astype(jnp.bfloat16)
-            dims = (((0,), (0,)), ((), ()))
             acc = (jax.lax.dot_general(
                        oh, gh_hi, dims,
                        preferred_element_type=jnp.float32)
@@ -171,13 +248,30 @@ def _hist_wave_kernel(bins_ref, vecs_ref, slot_ref, out_ref, *,
         else:
             oh = eq.astype(jnp.bfloat16)
             acc = jax.lax.dot_general(
-                oh, gh_b, (((0,), (0,)), ((), ())),
+                oh, gh_b, dims,
+                preferred_element_type=jnp.float32)
+        if packed:
+            acc_ct = jax.lax.dot_general(
+                eq.astype(jnp.bfloat16), ct_b, dims,
                 preferred_element_type=jnp.float32)
         if pack == 1:
-            out_ref[f] += acc
+            acc_refs[0][f] += acc
+            if packed:
+                acc_refs[1][f] += acc_ct
         else:
             for p in range(pack):
-                out_ref[f + p] += acc[p * B:(p + 1) * B]
+                acc_refs[0][f + p] += acc[p * B:(p + 1) * B]
+                if packed:
+                    acc_refs[1][f + p] += acc_ct[p * B:(p + 1) * B]
+
+    if fused:
+        # final row step: accumulators hold the complete child histograms
+        # for this feature block — emit the sibling without the child
+        # ever round-tripping through HBM
+        @pl.when(i == pl.num_programs(1) - 1)
+        def _sibling():
+            for par, accr, sibr in zip(par_refs, acc_refs, sib_refs):
+                sibr[...] = par[...] - accr[...]
 
 
 def _resolve_mode(highest) -> str:
@@ -194,7 +288,8 @@ WAVE_MXU_PASSES = {"highest": 3, "2xbf16": 2, "bf16": 1}
 
 
 def wave_kernel_cost(rows, F: int, B: int, mode="2xbf16",
-                     feat_block: int = _DEF_FB, waves: int = 1):
+                     feat_block: int = _DEF_FB, waves: int = 1,
+                     packed: bool = False, fused: bool = False):
     """Analytical (FLOPs, HBM bytes) of ``hist_pallas_wave`` over ``rows``
     total rows across ``waves`` kernel launches — ``docs/ROOFLINE.md``'s
     hand-written cost model in code, so profile mode and
@@ -205,41 +300,101 @@ def wave_kernel_cost(rows, F: int, B: int, mode="2xbf16",
     operand is 255/256 zeros but every lane is paid for.  Mirrors the
     kernel's feature packing (B <= 64 packs 128//B features per matmul);
     an unpacked B < 128 operand still occupies one full 128-lane group.
+    ``packed`` charges the folded count as one extra hardware pass on
+    top of the mode's g/h passes (the lane-pair layout fits 63 leaves
+    where triples fit 42, so per-LEAF MXU cost is unchanged — the win is
+    1.5x fewer launches, i.e. fewer ``waves`` and fewer bins reads).
     Bytes count the HBM legs only — bins + packed [N, 4] vectors read
-    once per ROW, the [F, B, C] output written once per LAUNCH (hence
-    ``waves``); the one-hot factor lives in VMEM and never touches HBM.
-    ``rows`` is the tier-compacted total (the wave grower's
-    ``report_waves`` stats carry exactly this figure).
+    once per ROW, the histogram outputs written once per LAUNCH (hence
+    ``waves``; two output arrays when packed); ``fused`` adds the parent
+    read and sibling write per launch, and is what REPLACES the separate
+    XLA subtraction pass (which paid the same parent/sibling legs PLUS a
+    re-read of the child).  The one-hot factor lives in VMEM and never
+    touches HBM.  ``rows`` is the tier-compacted total (the wave
+    grower's ``report_waves`` stats carry exactly this figure).
     """
     mode = _resolve_mode(mode)
-    passes = WAVE_MXU_PASSES[mode]
-    pack = max(1, 128 // B) if 128 % B == 0 and \
-        feat_block % max(1, 128 // B) == 0 else 1
+    passes = WAVE_MXU_PASSES[mode] + (1 if packed else 0)
+    pack = _feat_pack(B, feat_block)
     lanes = max(pack * B, C_MAX) / pack      # charged output rows / feature
     flops = passes * 2.0 * float(rows) * F * lanes * C_MAX
+    hist_bytes = F * B * C_MAX * 4
+    n_out = 2 if packed else 1
+    per_launch = hist_bytes * n_out          # child histogram write(s)
+    if fused:
+        per_launch += 2 * hist_bytes * n_out  # parent read + sibling write
     nbytes = (float(rows) * (F * 1 + 4 * 4)
-              + max(int(waves), 1) * F * B * C_MAX * 4)
+              + max(int(waves), 1) * per_launch)
     return flops, nbytes
+
+
+def select_wave_blocks(B: int, mode="2xbf16", packed: bool = True,
+                       fused: bool = True, block_rows: int = _DEF_BR,
+                       vmem_budget: int = _VMEM_BUDGET):
+    """Cost-model-driven (block_rows, feat_block) for ``hist_pallas_wave``.
+
+    The per-grid-step VMEM residency is dominated by the [FB, B, C] f32
+    histogram blocks: 1 (triple) or 2 (packed) accumulators, plus parent
+    and sibling blocks of the same shape when fused.  This picks the
+    largest feat_block whose blocks + streamed operands fit the budget —
+    bin-width specialization in block form: B=64 runs FB=32 fused where
+    B=256 must drop to FB=8, and the unfused/triple oracle paths get the
+    larger blocks their smaller footprint allows.  ``block_rows`` is
+    passed through (row blocking is an HBM-streaming knob, not a VMEM
+    one, at these shapes)."""
+    mode = _resolve_mode(mode)
+    n_out = 2 if packed else 1
+    n_big = n_out * (3 if fused else 1)   # acc (+ parent + sibling)
+    for FB in (128, 64, 32, 16, 8):
+        pack = _feat_pack(B, FB)
+        oh_bytes = block_rows * max(pack * B, C_MAX) * \
+            (4 if mode == "highest" else 2)
+        stream = 2 * (FB * block_rows + block_rows * 4 * 4)  # bins + vecs
+        total = FB * B * C_MAX * 4 * n_big + oh_bytes + stream
+        if total <= vmem_budget:
+            return block_rows, FB
+    return block_rows, 8
 
 
 @functools.partial(jax.jit,
                    static_argnames=("B", "block_rows", "feat_block", "highest",
-                                    "interpret"))
+                                    "interpret", "packed"))
 @jax.named_scope("lgbm/pallas_hist_wave")
 def hist_pallas_wave(bins_fm, gv, hv, cv, leaf_id, slot_leaf, B: int,
                      block_rows: int = 1024, feat_block: int = _DEF_FB,
-                     highest="bf16", interpret: bool = False):
+                     highest="bf16", interpret: bool = False,
+                     packed: bool = False, parent=None):
     """Wave histogram: bins_fm [F, N] uint8; gv/hv/cv f32 [N] (bag-masked
-    g, h, ones); leaf_id i32 [N]; slot_leaf i32 [C_MAX] maps channel c to a
-    leaf id (channel kinds cycle g,h,count; -1 = unused).  Returns
-    [F, B, C_MAX] f32 where channels 3s..3s+2 hold leaf slot_leaf[3s]'s
-    (sum_g, sum_h, count) histograms.
+    g, h, ones); leaf_id i32 [N]; slot_leaf i32 [C_MAX] maps channel c to
+    a leaf id (-1 = unused).
+
+    Channel layouts (``packed``):
+      triple (False) — channel kinds cycle g,h,count; returns
+        [F, B, C_MAX] f32 where channels 3s..3s+2 hold leaf
+        slot_leaf[3s]'s (sum_g, sum_h, count) histograms.
+      packed (True) — channels pair up (g, h) per leaf (slot_leaf[2s] ==
+        slot_leaf[2s+1] is leaf s); the count channel is folded into the
+        same accumulation as one extra bf16 pass whose lane s carries
+        leaf slot_leaf[2s]'s count.  Returns ``(gh, cnt)``: gh [F, B,
+        C_MAX] with the lane pairs, cnt [F, B, C_MAX] with counts in the
+        first C_MAX//2 lanes.  Exactness: count weights are the 0/1 bag
+        mask — exact in bf16 with f32 accumulation, so folded counts
+        bit-match dedicated lanes in every precision mode.
+
+    ``parent`` fuses sibling subtraction in-kernel: pass the parent
+    histograms in the SAME channel layout as the output ([F, B, C_MAX],
+    or the (gh, cnt) pair when packed) and the call returns
+    ``(child, sibling)`` with sibling = parent - child written from VMEM
+    on the final row step — no separate XLA subtraction pass, no child
+    re-read from HBM.
 
     ``highest``: precision mode — True/"highest", "2xbf16", or
     False/"bf16" (see _hist_wave_kernel)."""
     F, N = bins_fm.shape
     BR = min(block_rows, max(128, N))
     FB = min(feat_block, max(F, 1))
+    fused = parent is not None
+    par_arrs = (list(parent) if packed else [parent]) if fused else []
     pad_rows = (-N) % BR
     if pad_rows:
         bins_fm = jnp.pad(bins_fm, ((0, 0), (0, pad_rows)))
@@ -250,6 +405,8 @@ def hist_pallas_wave(bins_fm, gv, hv, cv, leaf_id, slot_leaf, B: int,
     pad_f = (-F) % FB
     if pad_f:
         bins_fm = jnp.pad(bins_fm, ((0, pad_f), (0, 0)))
+        par_arrs = [jnp.pad(pa, ((0, pad_f), (0, 0), (0, 0)))
+                    for pa in par_arrs]
     Fp, Np = bins_fm.shape
     mode = _resolve_mode(highest)
     # pack row vectors into one [N, 4] array (g, h, count-weight, leaf_id);
@@ -257,26 +414,47 @@ def hist_pallas_wave(bins_fm, gv, hv, cv, leaf_id, slot_leaf, B: int,
     vecs = jnp.stack([gv, hv, cv, leaf_id.astype(jnp.float32)], axis=1)
     nb = Np // BR
 
+    if packed:
+        # second slot row: the count-lane map (lane s -> leaf of pair s)
+        half = C_MAX // 2
+        slot_ct = jnp.concatenate(
+            [slot_leaf[::2],
+             jnp.full((C_MAX - half,), -1, slot_leaf.dtype)])
+        slot = jnp.stack([slot_leaf, slot_ct])
+    else:
+        slot = slot_leaf.reshape(1, C_MAX)
+
+    n_out = 2 if packed else 1
+    hist_spec = pl.BlockSpec((FB, B, C_MAX), lambda j, i: (j, 0, 0),
+                             memory_space=pltpu.VMEM)
+    in_specs = [
+        pl.BlockSpec((FB, BR), lambda j, i: (j, i),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((BR, 4), lambda j, i: (i, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((slot.shape[0], C_MAX), lambda j, i: (0, 0),
+                     memory_space=pltpu.VMEM),
+    ] + [hist_spec] * len(par_arrs)
+    n_res = n_out * (2 if fused else 1)
     grid = (Fp // FB, nb)
-    out = pl.pallas_call(
-        functools.partial(_hist_wave_kernel, B=B, FB=FB, mode=mode),
+    res = pl.pallas_call(
+        functools.partial(_hist_wave_kernel, B=B, FB=FB, mode=mode,
+                          packed=packed, fused=fused),
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((FB, BR), lambda j, i: (j, i),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((BR, 4), lambda j, i: (i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, C_MAX), lambda j, i: (0, 0),
-                         memory_space=pltpu.VMEM),
-        ],
-        out_specs=pl.BlockSpec((FB, B, C_MAX), lambda j, i: (j, 0, 0),
-                               memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((Fp, B, C_MAX), jnp.float32),
+        in_specs=in_specs,
+        out_specs=[hist_spec] * n_res,
+        out_shape=[jax.ShapeDtypeStruct((Fp, B, C_MAX), jnp.float32)
+                   for _ in range(n_res)],
         compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary")),
         interpret=interpret,
-    )(bins_fm, vecs, slot_leaf.reshape(1, C_MAX))
-    return out[:F]
+    )(bins_fm, vecs, slot, *par_arrs)
+    res = [r[:F] for r in res]
+    child = (res[0], res[1]) if packed else res[0]
+    if not fused:
+        return child
+    sib = (res[2], res[3]) if packed else res[1]
+    return child, sib
 
 
 def hist_pallas_fm(bins_fm, g, h, mask, B: int):

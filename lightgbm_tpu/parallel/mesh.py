@@ -246,7 +246,18 @@ def make_data_parallel_wave_grower(meta: DeviceMeta, cfg: SplitConfig, B: int,
     each device re-partitions only its LOCAL row shard in the single
     vectorized pass — the per-device partition traffic drops from
     O(splits x N/D) to O(N/D) per wave exactly as on one device.  False
-    keeps the sequential per-split walk (the differential oracle)."""
+    keeps the sequential per-split walk (the differential oracle).
+
+    The packed lane-pair channel layout (``packed`` in wave_kw, default
+    True) composes with sharding unchanged — each device's kernel emits
+    its local (gh, cnt) pair and both arrays are psum'd.  In-kernel
+    sibling subtraction does NOT apply here regardless of
+    ``fused_sibling``: the sibling must be parent minus the GLOBAL child
+    histogram, so the subtraction happens after the psum
+    (build_wave_grow_fn gates fusion off under reduce_fn — the reference
+    likewise subtracts after its histogram exchange,
+    data_parallel_tree_learner.cpp:246), and trees stay bit-identical to
+    the single-device fused path."""
     from ..core.wave_grower import build_wave_grow_fn
     grow = build_wave_grow_fn(meta, cfg, B, reduce_fn=_psum,
                               batched_apply=batched_apply, **wave_kw)

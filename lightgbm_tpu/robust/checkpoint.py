@@ -59,6 +59,9 @@ _DIGEST_SKIP = frozenset((
     "tpu_health", "tpu_fingerprint_freq", "tpu_compile_cache_dir",
     "tpu_watchdog", "tpu_on_device_error", "tpu_device_retries",
     "tpu_wedge_timeout_s",
+    # kernel-pipeline knobs proven bit-identical by the ISSUE 8
+    # differential suite: flipping them must not refuse a resume
+    "tpu_fused_sibling", "tpu_batched_split_apply",
 ))
 
 
@@ -72,6 +75,12 @@ def config_digest(config) -> str:
         v = getattr(config, f.name)
         if isinstance(v, (list, tuple)):
             v = list(v)
+        if f.name == "tpu_hist_dtype":
+            # hash the RESOLVED kernel mode so back-compat aliases
+            # ("float32" -> "2xbf16", "bfloat16" -> "bf16") and the
+            # ISSUE 8 default rename don't invalidate old checkpoints
+            from ..boosting.gbdt import GBDT
+            v = GBDT._hist_mode(config)
         items[f.name] = v
     blob = json.dumps(items, sort_keys=True, default=str)
     return hashlib.sha256(blob.encode()).hexdigest()[:16]

@@ -102,6 +102,11 @@ _SLOW = {
     "test_wave_apply.py::test_batched_apply_differential[bagging-7]",
     "test_wave_apply.py::test_batched_apply_differential[bagging-23]",
     "test_wave_apply.py::test_batched_apply_mesh_parallel",
+    "test_hist_fused.py::test_fused_packed_differential[nan_default_left-7]",
+    "test_hist_fused.py::test_fused_packed_differential[categorical_bitset-7]",
+    "test_hist_fused.py::test_fused_packed_differential[categorical_bitset-23]",
+    "test_hist_fused.py::test_mesh_data_parallel_packed_matches_single",
+    "test_hist_fused.py::test_packed_capacity_cuts_waves",
     "test_robust.py::test_resume_bit_identical_dart",
     "test_robust.py::test_resume_bit_identical_two_device_mesh",
     "test_robust.py::test_sigterm_checkpoints_and_resumes",

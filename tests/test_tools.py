@@ -202,6 +202,36 @@ def test_bench_history_canary_trend(tmp_path):
     assert "canary trend" in text and "-50.0%" in text
 
 
+def test_bench_history_mode_regressions(tmp_path):
+    """Wave-pipeline stamps: waves_per_tree trends numerically (lower is
+    better) while hist_mode / fused_sibling downgrades are flagged
+    categorically — even when throughput improved, because a bf16 round
+    can post a better value while computing a worse histogram."""
+    bh, rows = _history(tmp_path, [
+        _bench_round(1, 1000.0, 1.0, waves_per_tree=16.0,
+                     hist_mode="2xbf16", fused_sibling=True),
+        _bench_round(2, 1500.0, 0.7, waves_per_tree=19.0,
+                     hist_mode="f32", fused_sibling=False),
+    ])
+    assert rows[0]["mode"] == {"hist_mode": "2xbf16",
+                               "fused_sibling": True}
+    regs = bh.find_regressions(rows, threshold=0.1)
+    by_metric = {r["metric"]: r for r in regs}
+    assert "waves_per_tree" in by_metric       # lower-is-better numeric
+    mregs = bh.find_mode_regressions(rows)
+    assert {m["metric"] for m in mregs} == {"fused_sibling", "hist_mode"}
+    text = bh.render(rows, regs, mregs)
+    assert "MODE REGRESSIONS" in text and "2xbf16" in text
+    # same modes, no prior downgrade → nothing flagged
+    bh2, rows2 = _history(tmp_path, [
+        _bench_round(1, 1000.0, 1.0, hist_mode="2xbf16",
+                     fused_sibling=True),
+        _bench_round(2, 900.0, 1.1, hist_mode="2xbf16",
+                     fused_sibling=True),
+    ])
+    assert bh2.find_mode_regressions(rows2) == []
+
+
 def test_bench_history_cli_exit_codes(tmp_path, monkeypatch, capsys):
     tool = os.path.join(TOOLS, "bench_history.py")
     for i, r in enumerate([_bench_round(1, 2000.0, 0.5),
@@ -525,13 +555,13 @@ def test_tpu_window_checklist_stubbed(tmp_path):
     assert rec["parsed"]["value"] == 123.0
     assert rec["parsed"]["health_failures"] == 0
     assert set(rec["legs"]) == {"bench", "bench_profile",
-                                "bench_maxbin63", "prof_kernels",
-                                "bench_serve", "trace"}
+                                "bench_maxbin63", "bench_unfused",
+                                "prof_kernels", "bench_serve", "trace"}
     assert all(leg["rc"] == 0 for leg in rec["legs"].values())
-    # bench legs ran three times (clean, profile, maxbin63)
+    # bench legs ran four times (clean, profile, maxbin63, unfused)
     bench_calls = [c for c in fake.calls if any("bench.py" in a
                                                 for a in c)]
-    assert len(bench_calls) == 3
+    assert len(bench_calls) == 4
     # the record is bench_history-compatible: it folds into the
     # trajectory as a canary (cpu-forced), never a baseline
     bh = _import_tool("bench_history")

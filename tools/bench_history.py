@@ -58,6 +58,11 @@ _DIRECTIONS = [
     ("rank_vs_baseline", True),
     ("rank_train_ndcg10", True),
     ("kernel_roofline/*", True),
+    # wave-pipeline stamps (ISSUE 8): more kernel launches per tree, or a
+    # capacity drop, is a scheduling regression even when throughput
+    # noise hides it
+    ("waves_per_tree", False),
+    ("wave_capacity", True),
     ("per_iter_s", False),
     ("rank_per_iter_s", False),
     ("compile_s", False),
@@ -80,8 +85,9 @@ _DIRECTIONS = [
 
 # the headline columns of the human table, in order
 _TABLE_COLS = ["value", "vs_baseline", "per_iter_s", "compile_s",
-               "train_auc", "rank_row_iters_per_s", "peak_hbm_bytes",
-               "serve_p99_ms", "serve_server_p99_ms", "serve_occupancy"]
+               "train_auc", "waves_per_tree", "rank_row_iters_per_s",
+               "peak_hbm_bytes", "serve_p99_ms", "serve_server_p99_ms",
+               "serve_occupancy"]
 
 _CONTEXT_KEYS = ("backend", "rows", "iters", "num_leaves", "max_bin")
 
@@ -204,11 +210,27 @@ def load_round(path: str) -> dict:
     td = parsed.get("telemetry")
     if isinstance(td, dict):
         _fold_digest(row["metrics"], td)
+    # wave-pipeline mode stamps (non-numeric — hist_mode is a string,
+    # fused_sibling a bool — so the numeric fold above skips them): kept
+    # on the row for find_mode_regressions, bench.py flat fields first,
+    # the embedded digest's wave_pipeline section as fallback
+    wp = (td.get("wave_pipeline") if isinstance(td, dict) else None) or {}
+    mode = {}
+    for k in ("hist_mode", "fused_sibling"):
+        v = parsed.get(k, wp.get(k))
+        if v is not None:
+            mode[k] = v
+    if mode:
+        row["mode"] = mode
     return row
 
 
 def _fold_digest(metrics: dict, digest: dict) -> None:
     """Pull trajectory-worthy numbers out of an obs digest."""
+    wp = digest.get("wave_pipeline") or {}
+    for k in ("waves_per_tree", "wave_capacity"):
+        if isinstance(wp.get(k), (int, float)):
+            metrics.setdefault(k, float(wp[k]))
     counters = digest.get("counters") or {}
     if "jax/compiles" in counters:
         metrics.setdefault("jax_compiles", float(counters["jax/compiles"]))
@@ -278,6 +300,38 @@ def find_regressions(rows: List[dict], threshold: float) -> List[dict]:
     return sorted(out, key=lambda r: -abs(r["change_frac"]))
 
 
+def find_mode_regressions(rows: List[dict]) -> List[dict]:
+    """Wave-pipeline MODE downgrades, flagged like perf regressions
+    (ISSUE 8): a round whose histogram precision mode changed, or whose
+    in-kernel sibling fusion silently flipped off, against the most
+    recent comparable prior round.  These are categorical, not numeric —
+    a bf16 round can post a better throughput while computing a worse
+    histogram, which no threshold on ``value`` would ever catch.
+    (waves_per_tree / wave_capacity drift is numeric and handled by
+    ``find_regressions``.)"""
+    rows = [r for r in rows if not r.get("canary")]
+    latest = next((r for r in reversed(rows) if r.get("mode")), None)
+    if latest is None:
+        return []
+    prior = next((r for r in reversed(rows)
+                  if r is not latest and r.get("mode")
+                  and r["context"] == latest["context"]), None)
+    if prior is None:
+        return []
+    out = []
+    lm, pm = latest["mode"], prior["mode"]
+    if pm.get("fused_sibling") is True and lm.get("fused_sibling") is False:
+        out.append({"metric": "fused_sibling", "round": latest["round"],
+                    "value": "off", "prior": "on",
+                    "prior_round": prior["round"]})
+    if (lm.get("hist_mode") and pm.get("hist_mode")
+            and lm["hist_mode"] != pm["hist_mode"]):
+        out.append({"metric": "hist_mode", "round": latest["round"],
+                    "value": lm["hist_mode"], "prior": pm["hist_mode"],
+                    "prior_round": prior["round"]})
+    return out
+
+
 def canary_trend(rows: List[dict]) -> List[dict]:
     """per_iter_s + throughput trajectory across CANARY rounds of the
     same context.  Canaries never enter regression baselines
@@ -307,7 +361,8 @@ def canary_trend(rows: List[dict]) -> List[dict]:
     return out
 
 
-def render(rows: List[dict], regressions: List[dict]) -> str:
+def render(rows: List[dict], regressions: List[dict],
+           mode_regressions: List[dict] = ()) -> str:
     cols = [c for c in _TABLE_COLS
             if any(c in r["metrics"] for r in rows)]
     out = [f"{'round':<6}{'context':<34}"
@@ -338,6 +393,13 @@ def render(rows: List[dict], regressions: List[dict]) -> str:
     else:
         out.append("")
         out.append("no regressions against comparable prior rounds")
+    if mode_regressions:
+        out.append("")
+        out.append("MODE REGRESSIONS (wave-pipeline downgrade vs prior "
+                   "comparable round):")
+        for g in mode_regressions:
+            out.append(f"  {g['metric']:<32} {g['value']} vs "
+                       f"{g['prior']} ({g['prior_round']})")
     trend = [t for t in canary_trend(rows)
              if "per_iter_s_change_frac" in t or "value_change_frac" in t]
     if trend:
@@ -379,12 +441,14 @@ def main() -> int:
         print("no bench rounds found", file=sys.stderr)
         return 1
     regressions = find_regressions(rows, args.threshold)
+    mode_regressions = find_mode_regressions(rows)
     if args.json:
         print(json.dumps({"rounds": rows, "regressions": regressions,
+                          "mode_regressions": mode_regressions,
                           "canary_trend": canary_trend(rows)}))
     else:
-        print(render(rows, regressions))
-    if regressions and args.fail_on_regression:
+        print(render(rows, regressions, mode_regressions))
+    if (regressions or mode_regressions) and args.fail_on_regression:
         return 1
     return 0
 

@@ -8,20 +8,31 @@ achieved fraction — the numbers ``docs/ROOFLINE.md``'s "measured" column
 is filled from, and the first thing to run in a TPU window.
 
 Legs (``PROF_LEGS`` comma-list, default all):
-  kernel    — bare ``hist_pallas_wave`` full passes vs the MXU roofline
-  full      — ``build_wave_grow_fn`` as shipped (batched split apply)
-  seqapply  — ``batched_apply=False`` (the per-split partition oracle —
-              full-vs-seqapply is the tentpole's win, measured)
-  nokernel  — kernel stubbed to shaped noise (everything-but-kernel)
-  nocompact — ``compact=False`` (no tier gathers, full-N kernel per wave)
-  gathers   — compaction-primitive microbenches (index build + tier
-              gathers, the nocompact-vs-full arbitration)
-  partition — wave-partition microbench: the batched one-pass split
-              apply AND the sequential per-split walk on the same slot
-              tables, each against ``splitter.partition_cost``
+  kernel       — bare ``hist_pallas_wave`` full passes (triple-layout
+                 oracle) vs the MXU roofline
+  kernelpacked — bare packed-lane kernel pass (63 leaves, count folded —
+                 the shipped layout; packed-vs-kernel is the
+                 launches-per-tree win at equal per-pass cost)
+  kernelfused  — packed kernel WITH in-kernel sibling subtraction (the
+                 shipped fast path; fused-vs-kernelpacked measures the
+                 saved XLA subtraction + HBM round-trip)
+  full         — ``build_wave_grow_fn`` as shipped (packed + fused +
+                 batched split apply)
+  nofuse       — ``tpu_fused_sibling=false`` (the separate XLA
+                 subtraction pass — full-vs-nofuse is the fusion win)
+  triple       — packed=False, fused off (the PR-7-era grower, the
+                 packed-channel differential oracle end to end)
+  seqapply     — ``batched_apply=False`` (the per-split partition oracle)
+  nokernel     — kernel stubbed to shaped noise (everything-but-kernel)
+  nocompact    — ``compact=False`` (no tier gathers, full-N kernel/wave)
+  gathers      — compaction-primitive microbenches (index build + tier
+                 gathers, the nocompact-vs-full arbitration)
+  partition    — wave-partition microbench: the batched one-pass split
+                 apply AND the sequential per-split walk on the same slot
+                 tables, each against ``splitter.partition_cost``
 
 Env knobs: ``PROF_ROWS`` (1_000_000), ``PROF_FEATURES`` (28),
-``PROF_LEAVES`` (255), ``PROF_MAXBIN`` (255), ``PROF_CAPACITY`` (42),
+``PROF_LEAVES`` (255), ``PROF_MAXBIN`` (255), ``PROF_CAPACITY`` (63),
 ``PROF_REPEAT`` (3), ``PROF_LEGS``, ``PROF_JSON=1`` (append one
 machine-readable JSON line), ``PROF_INTERPRET=1`` (Pallas interpreter
 mode — the CPU smoke path CI exercises between TPU windows).
@@ -98,7 +109,7 @@ def build_problem(rows: int, F: int, leaves: int, max_bin: int):
     fmask = jnp.ones(F, bool)
     return dict(meta=meta, B=B, scfg=scfg, binsT=binsT, g=g, h=h,
                 mask=mask, fmask=fmask, rows=rows, F=F,
-                capacity=_env_int("PROF_CAPACITY", 42),
+                capacity=_env_int("PROF_CAPACITY", 63),
                 block_rows=_env_int("PROF_BLOCK_ROWS", 1024))
 
 
@@ -123,28 +134,46 @@ def _report(results: dict, name: str, seconds: float, flops=None,
     print(line, flush=True)
 
 
-def leg_kernel(p, results, n_rep: int):
+def leg_kernel(p, results, n_rep: int, name="kernel full pass",
+               packed=False, fused=False):
     """Bare wave-kernel full passes vs the analytical MXU roofline AND
-    XLA's own cost_analysis of the compiled kernel."""
+    XLA's own cost_analysis of the compiled kernel.  ``packed`` runs the
+    lane-pair layout (63 leaves, count folded), ``fused`` additionally
+    feeds a parent operand so the sibling subtraction happens in-kernel
+    — the three variants share one problem, so their deltas ARE the
+    layout economics."""
     rows, F, B = p["rows"], p["F"], p["B"]
     rng = np.random.default_rng(1)
-    Pcap = max(1, min(p["capacity"], pallas_hist.C_MAX // 3))
+    lanes = 2 if packed else 3
+    Pcap = max(1, min(p["capacity"], pallas_hist.wave_capacity_max(packed)))
     sl = np.full(pallas_hist.C_MAX, -1, np.int32)
-    sl[:3 * Pcap] = np.repeat(np.arange(Pcap), 3)
+    sl[:lanes * Pcap] = np.repeat(np.arange(Pcap), lanes)
     slot_leaf = jnp.asarray(sl)
     leaf_id = jnp.asarray(rng.integers(0, Pcap, rows, dtype=np.int32))
+    parent = None
+    if fused:
+        shape = (F, B, pallas_hist.C_MAX)
+        par = jnp.asarray(rng.normal(size=shape).astype(np.float32))
+        parent = (par, par) if packed else par
+    # feat_block from the same VMEM model the grower uses — the fused
+    # blocks at B=256 don't fit the default FB=32 on a real chip
+    _, FBk = pallas_hist.select_wave_blocks(
+        B, mode=MODE, packed=packed, fused=fused,
+        block_rows=p["block_rows"])
     kf = jax.jit(lambda: pallas_hist.hist_pallas_wave(
         p["binsT"], p["g"], p["h"], p["mask"], leaf_id, slot_leaf, B=B,
-        block_rows=p["block_rows"], highest=MODE, interpret=INTERP))
-    flops, nbytes = pallas_hist.wave_kernel_cost(rows, F, B, MODE)
-    extra = {}
+        block_rows=p["block_rows"], feat_block=FBk, highest=MODE,
+        interpret=INTERP, packed=packed, parent=parent))
+    flops, nbytes = pallas_hist.wave_kernel_cost(rows, F, B, MODE,
+                                                 packed=packed, fused=fused)
+    extra = {"leaves_per_launch": Pcap}
     try:
         ca = extract_cost(cost_analysis_dict(kf.lower().compile()))
-        extra = {"xla_flops": ca[0], "xla_bytes": ca[1]}
+        extra.update(xla_flops=ca[0], xla_bytes=ca[1])
     except Exception as exc:  # noqa: BLE001 — interpret mode may decline
-        extra = {"xla_cost_error": f"{type(exc).__name__}"}
+        extra["xla_cost_error"] = f"{type(exc).__name__}"
     dt, _ = timeit(kf, n=n_rep)
-    _report(results, "kernel full pass", dt, flops, nbytes, extra)
+    _report(results, name, dt, flops, nbytes, extra)
 
 
 def leg_partition(p, results, n_rep: int):
@@ -203,33 +232,49 @@ def leg_partition(p, results, n_rep: int):
 
 
 def leg_grow(p, results, name: str, n_rep: int, compact=True,
-             stub_kernel=False, batched_apply=True):
+             stub_kernel=False, batched_apply=True, packed=True,
+             fused=True):
     """One grower variant, timed end to end per tree."""
     rows, F, B = p["rows"], p["F"], p["B"]
     real = pallas_hist.hist_pallas_wave
     if stub_kernel:
-        def stub(bins_fm, gv, hv, cv, leaf_id, slot_leaf, B, **kw):
+        def stub(bins_fm, gv, hv, cv, leaf_id, slot_leaf, B, packed=False,
+                 parent=None, **kw):
             """Shape-compatible fake histograms with enough structure that
             the grower keeps splitting (positive counts/hessians, wiggly g
-            sums) — measures everything-but-kernel."""
+            sums) — measures everything-but-kernel.  Speaks both channel
+            layouts and the fused (child, sibling) contract."""
             Fdim = bins_fm.shape[0]
             i = jnp.arange(B, dtype=jnp.float32)[None, :, None]
             c = jnp.arange(pallas_hist.C_MAX, dtype=jnp.float32)[None, None, :]
             f = jnp.arange(Fdim, dtype=jnp.float32)[:, None, None]
             base = jnp.sin(i * 0.37 + c * 1.3 + f * 2.1)
-            kind = (jnp.arange(pallas_hist.C_MAX) % 3)[None, None, :]
-            out = jnp.where(kind == 0, base * 3.0,
-                            jnp.where(kind == 1, 40.0 + 0.0 * base,
-                                      160.0 + 0.0 * base))
             s = (gv[0] + hv[0] + cv[0] + leaf_id[0].astype(jnp.float32)) * 0
-            return out + s
+            if packed:
+                kind = (jnp.arange(pallas_hist.C_MAX) % 2)[None, None, :]
+                gh = jnp.where(kind == 0, base * 3.0, 40.0 + 0.0 * base) + s
+                child = (gh, 160.0 + 0.0 * base + s)
+            else:
+                kind = (jnp.arange(pallas_hist.C_MAX) % 3)[None, None, :]
+                child = jnp.where(
+                    kind == 0, base * 3.0,
+                    jnp.where(kind == 1, 40.0 + 0.0 * base,
+                              160.0 + 0.0 * base)) + s
+            if parent is None:
+                return child
+            if packed:
+                sib = tuple(pa - ch for pa, ch in zip(parent, child))
+            else:
+                sib = parent - child
+            return child, sib
         wave_grower.hist_pallas_wave = stub
     try:
         grow = jax.jit(wave_grower.build_wave_grow_fn(
             p["meta"], p["scfg"], B, wave_capacity=p["capacity"],
             highest=MODE, gain_gate=0.5, block_rows=p["block_rows"],
             compact=compact, interpret=INTERP, report_waves=True,
-            batched_apply=batched_apply))
+            batched_apply=batched_apply, packed=packed,
+            fused_sibling=fused))
         t0 = time.time()
         tr, lid, stats = grow(p["binsT"], p["g"], p["h"], p["mask"],
                               p["fmask"])
@@ -244,11 +289,12 @@ def leg_grow(p, results, name: str, n_rep: int, compact=True,
     flops = nbytes = None
     if not stub_kernel:
         # kernel share of this tree, from the EXACT rows histogrammed
-        flops, nbytes = pallas_hist.wave_kernel_cost(kern_rows, F, B, MODE,
-                                                     waves=waves)
+        flops, nbytes = pallas_hist.wave_kernel_cost(
+            kern_rows, F, B, MODE, waves=waves, packed=packed, fused=fused)
     _report(results, name, dt, flops, nbytes,
             {"leaves": leaves, "waves": waves, "kernel_rows": kern_rows,
-             "compile_s": round(compile_s, 1),
+             "compile_s": round(compile_s, 1), "packed": packed,
+             "fused_sibling": fused,
              "full_pass_equiv": round(kern_rows / rows, 2)})
 
 
@@ -288,7 +334,8 @@ def main() -> int:
     n_rep = _env_int("PROF_REPEAT", 3)
     legs = [s for s in os.environ.get(
         "PROF_LEGS",
-        "kernel,full,seqapply,nokernel,nocompact,gathers,partition"
+        "kernel,kernelpacked,kernelfused,full,nofuse,triple,seqapply,"
+        "nokernel,nocompact,gathers,partition"
     ).split(",") if s]
     pf, pb = device_peaks()
     print(f"backend: {jax.default_backend()}  interpret: {INTERP}  "
@@ -298,8 +345,18 @@ def main() -> int:
     results = {}
     if "kernel" in legs:
         leg_kernel(p, results, n_rep)
+    if "kernelpacked" in legs:
+        leg_kernel(p, results, n_rep, name="kernel packed", packed=True)
+    if "kernelfused" in legs:
+        leg_kernel(p, results, n_rep, name="kernel packed+fused",
+                   packed=True, fused=True)
     if "full" in legs:
         leg_grow(p, results, "grow full", n_rep)
+    if "nofuse" in legs:
+        leg_grow(p, results, "grow nofuse", n_rep, fused=False)
+    if "triple" in legs:
+        leg_grow(p, results, "grow triple", n_rep, packed=False,
+                 fused=False)
     if "seqapply" in legs:
         leg_grow(p, results, "grow seqapply", n_rep, batched_apply=False)
     if "nokernel" in legs:

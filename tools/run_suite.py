@@ -18,6 +18,11 @@ The quick tier carries the differential-apply smoke
 (``tests/test_wave_apply.py::test_batched_apply_differential_smoke``):
 every quick run re-proves the batched one-pass wave split apply byte-
 identical to the sequential oracle before any perf number is trusted.
+It also carries the fused-kernel smoke (ISSUE 8,
+``tests/test_hist_fused.py::test_fused_packed_smoke``): the packed
+lane-pair + in-kernel-sibling wave kernel, run in Pallas interpret mode
+on CPU, bit-matches the triple-layout unfused oracle — so a histogram-
+pipeline regression can never hide behind a green perf round.
 
 The ``serve`` tier is not a pytest marker: it runs
 ``tools/bench_serve.py --smoke`` — start the HTTP server in-process,

@@ -15,9 +15,13 @@ capture checklist with health monitoring enabled:
    roofline fractions + the HBM census;
 3. ``python bench.py`` with ``BENCH_MAXBIN=63`` — the 4x-denser MXU
    packing variant the roofline model predicts wins;
+3b. ``python bench.py`` with ``BENCH_FUSED=0`` — the unfused-sibling
+   A/B (ISSUE 8): same trees, separate XLA subtraction pass, so the
+   delta vs leg 1 is the in-kernel fusion win, end to end;
 4. ``tools/prof_kernels.py`` (``PROF_JSON=1``) — the leg decomposition,
    including the wave-partition legs (batched one-pass split apply vs
-   the sequential per-split oracle, against ``partition_cost``);
+   the sequential per-split oracle, against ``partition_cost``) and the
+   packed/fused kernel-layout legs (triple vs lane-pair vs fused);
 5. a ``jax.profiler`` trace capture of a short training run;
 6. ``tools/bench_serve.py --json`` — the serving engine's closed-loop +
    Poisson open-loop numbers on the live backend, written as
@@ -68,7 +72,7 @@ _DRY_PROF_ENV = {
     "JAX_PLATFORMS": "cpu",
     "PROF_INTERPRET": "1", "PROF_ROWS": "4096", "PROF_FEATURES": "6",
     "PROF_LEAVES": "7", "PROF_MAXBIN": "63", "PROF_REPEAT": "1",
-    "PROF_LEGS": "kernel,gathers,partition",
+    "PROF_LEGS": "kernel,kernelpacked,kernelfused,gathers,partition",
 }
 _DRY_SERVE_ENV = {
     "JAX_PLATFORMS": "cpu",
@@ -161,6 +165,12 @@ def checklist_legs(art_dir: str, dry_run: bool, py: str = sys.executable):
          "parse_json": True},
         {"name": "bench_maxbin63", "argv": [py, bench],
          "env": env_for("bench_maxbin63", {"BENCH_MAXBIN": "63"}),
+         "parse_json": True},
+        # the fused-sibling A/B: one window measures the in-kernel
+        # subtraction win end to end (ISSUE 8) — bench_history reads the
+        # fused_sibling stamp so the legs trend separately
+        {"name": "bench_unfused", "argv": [py, bench],
+         "env": env_for("bench_unfused", {"BENCH_FUSED": "0"}),
          "parse_json": True},
         {"name": "prof_kernels", "argv": [py, prof],
          "env": env_for("prof_kernels", {"PROF_JSON": "1"},
