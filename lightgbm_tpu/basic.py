@@ -525,9 +525,11 @@ class Booster:
         if pred_leaf:
             return self._gbdt.predict_leaf(mat, num_iteration, start_iteration)
         if pred_contrib:
-            from .core.shap import predict_contrib
-            return predict_contrib(self._gbdt, mat, num_iteration,
-                                   start_iteration)
+            # routes heavy inputs through the batched device TreeSHAP
+            # kernel (explain/) when a device is available; small inputs
+            # and count-less models stay on the host oracle (core/shap)
+            return self._gbdt.predict_contrib(mat, num_iteration,
+                                              start_iteration)
         return self._gbdt.predict(mat, num_iteration, raw_score,
                                   start_iteration)
 

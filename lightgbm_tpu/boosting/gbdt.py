@@ -177,6 +177,93 @@ class PredictorBase:
                 cols.append(self.models[it * K + k].predict_leaf(X))
         return np.stack(cols, axis=1) if cols else np.zeros((X.shape[0], 0))
 
+    # TreeSHAP is O(leaves x depth^2) PYTHON work per row-tree on the
+    # host, so the device path pays off far below the value-predict
+    # threshold; LGBM_TPU_CONTRIB_MIN_WORK overrides (0 forces device)
+    _DEVICE_CONTRIB_MIN_WORK = 50_000
+    _CONTRIB_CHUNK = 4096
+
+    def predict_contrib(self, X, num_iteration=None,
+                        start_iteration: int = 0) -> np.ndarray:
+        """Per-row SHAP contributions, [n, F+1] (last column = expected
+        value) or [n, K*(F+1)] for multiclass — the ``predict_contrib``
+        surface.  Heavy inputs route through the batched device TreeSHAP
+        kernel (explain/); the host recursion (core/shap.py) stays the
+        small-input path and the oracle."""
+        X = np.ascontiguousarray(X, dtype=np.float64)
+        K = self.num_tpi
+        start, stop = self._iter_window(num_iteration, start_iteration)
+        work = X.shape[0] * max(stop - start, 0) * K
+        try:
+            min_work = int(os.environ.get("LGBM_TPU_CONTRIB_MIN_WORK", "")
+                           or self._DEVICE_CONTRIB_MIN_WORK)
+        except ValueError:
+            min_work = self._DEVICE_CONTRIB_MIN_WORK
+        if work >= min_work and self._device_predict_ready(stop - start):
+            try:
+                return self._predict_contrib_device(X, start, stop)
+            except ValueError:
+                # a model without cover counts cannot be explained on
+                # device; fall through so the host oracle owns the error
+                pass
+        from ..core.shap import predict_contrib as host_contrib
+        return host_contrib(self, X, num_iteration, start_iteration)
+
+    def _predict_contrib_device(self, X: np.ndarray, start: int,
+                                stop: int) -> np.ndarray:
+        """Batched device TreeSHAP over the iteration window.  Always
+        packs through the model-derived serving bin space — contribution
+        columns are REAL feature indices, and the training bin space's
+        trivial-feature node rewrites (``_tree_bin_space``) would break
+        path enumeration."""
+        import jax.numpy as jnp
+
+        from ..core.forest import stack_forest
+        from ..explain import forest_shap_fn, stack_explain
+        from ..serve.packing import ServeBinSpace
+        K = self.num_tpi
+        F = (int(self.train_ds.num_total_features)
+             if self.train_ds is not None else self._model_num_features())
+        key = (start, stop, len(self.models),
+               getattr(self, "_model_version", 0))
+        if getattr(self, "_contrib_cache_key", None) != key:
+            trees = list(self.models)[start * K:stop * K]
+            # loaded models share the predict path's cached serving
+            # space (same key) instead of building a second one; only
+            # trained boosters pack a contrib-private space, because
+            # their F (num_total_features) can exceed the loaded-model
+            # feature count heuristic
+            space = (self._model_bin_space(start, stop)
+                     if self.train_ds is None
+                     else ServeBinSpace(trees, F))
+            trees_np = [space.tree_arrays_np(t, with_counts=True)
+                        for t in trees]
+            class_ids = np.asarray([k for _ in range(start, stop)
+                                    for k in range(K)], np.int32)
+            # counts ride only in the host dicts: stack_explain folds
+            # them into the path metadata, so the device forest stays
+            # count-free (same pytree structure as the serve path's —
+            # one kernel compilation, no unused [T, M] arrays in HBM)
+            forest = stack_forest(trees_np, class_ids,
+                                  min_words=space.min_words)
+            explain = stack_explain(trees_np, F)
+            fn = forest_shap_fn(space.meta, K, F)
+            if obs.profile_enabled():
+                fn = obs.profile_wrap("lgbm/forest_shap", fn)
+            self._contrib_cache = (space, forest, explain, fn)
+            self._contrib_cache_key = key
+        space, forest, explain, fn = self._contrib_cache
+        from ..utils.timetag import timetag
+        out = np.zeros((X.shape[0], K, F + 1))
+        with timetag("predict (treeshap scan)"):
+            for lo in range(0, X.shape[0], self._CONTRIB_CHUNK):
+                chunk = X[lo:lo + self._CONTRIB_CHUNK]
+                bins = space.bin_matrix(chunk)
+                out[lo:lo + chunk.shape[0]] = np.asarray(
+                    fn(forest, explain, jnp.asarray(bins)), np.float64)
+        return out.reshape(X.shape[0], K * (F + 1)) if K > 1 \
+            else out[:, 0, :]
+
     # ------------------------------------------------------------------
     # Device prediction plumbing shared by predict_raw / predict_leaf.
     # With a live train_ds the training bin space is reused; without one
@@ -1053,14 +1140,16 @@ class GBDT(PredictorBase):
             right[i] = child
         return inner_feats, thr_bin, dl, cat_bits, left, right
 
-    def _tree_arrays_np(self, tree: Tree) -> dict:
+    def _tree_arrays_np(self, tree: Tree, with_counts: bool = False) -> dict:
         """Bin-space numpy arrays for one host tree, unpadded — the unit
-        ``core.forest.stack_forest`` batches for device prediction."""
+        ``core.forest.stack_forest`` batches for device prediction.
+        ``with_counts`` adds the per-node data-cover counts TreeSHAP's
+        zero fractions need (predict-only callers skip the HBM cost)."""
         nl = tree.num_leaves
         nn = max(nl - 1, 0)
         inner_feats, thr_bin, dl, cat_bits, left, right = \
             self._tree_bin_space(tree)
-        return dict(
+        out = dict(
             split_feature=inner_feats,
             threshold_bin=thr_bin,
             default_left=dl,
@@ -1070,6 +1159,11 @@ class GBDT(PredictorBase):
             num_leaves=np.int32(nl),
             cat_bitset=cat_bits[:nn] if nn else cat_bits[:0],
         )
+        if with_counts:
+            out["internal_count"] = \
+                tree.internal_count[:nn].astype(np.int32)
+            out["leaf_count"] = tree.leaf_count[:nl].astype(np.int32)
+        return out
 
     def _tree_to_device(self, tree: Tree) -> TreeArrays:
         """Host Tree -> device arrays (bin space) for score replay."""

@@ -467,6 +467,29 @@ class Config:
                                         # rate); 0 disables the gauge
                                         # (LGBM_TPU_SERVE_SLO_P99_MS env)
 
+    # ---- Explanation serving (explain/ subsystem) ----
+    tpu_explain: bool = True            # arm POST /explain and
+                                        # PredictorSession.explain():
+                                        # packs the per-node cover counts
+                                        # + path metadata on FIRST use
+                                        # (predict-only sessions never
+                                        # pay the HBM cost); false
+                                        # removes the endpoint
+                                        # (LGBM_TPU_EXPLAIN env)
+    tpu_explain_max_batch: int = 256    # row cap per coalesced device
+                                        # TreeSHAP batch — its OWN pow2
+                                        # bucket family, compiling at
+                                        # most ceil(log2(max_batch))+1
+                                        # shapes; smaller than predict's
+                                        # because each row costs
+                                        # O(leaves x depth^2)
+                                        # (LGBM_TPU_EXPLAIN_MAX_BATCH env)
+    tpu_explain_max_wait_ms: float = 5.0  # longest the explain
+                                        # microbatcher holds the oldest
+                                        # queued request while coalescing
+                                        # (LGBM_TPU_EXPLAIN_MAX_WAIT_MS
+                                        # env)
+
     # ---- derived (not user-settable) ----
     is_parallel: bool = dataclasses.field(default=False, repr=False)
 
@@ -585,6 +608,10 @@ class Config:
             log.fatal("tpu_serve_port should be in [0, 65535]")
         if self.tpu_serve_slo_p99_ms < 0:
             log.fatal("tpu_serve_slo_p99_ms should be >= 0")
+        if self.tpu_explain_max_batch < 1:
+            log.fatal("tpu_explain_max_batch should be >= 1")
+        if self.tpu_explain_max_wait_ms < 0:
+            log.fatal("tpu_explain_max_wait_ms should be >= 0")
         if self.tpu_flight_len < 0:
             log.fatal("tpu_flight_len should be >= 0")
         if self.tpu_on_device_error not in ("abort", "fallback", "retry"):

@@ -25,7 +25,12 @@ from .meta import DeviceMeta
 
 class ForestArrays(NamedTuple):
     """Stacked bin-space forest: every field is a [T, ...] batch of the
-    corresponding ``TreeArrays`` field (fixed node capacity across trees)."""
+    corresponding ``TreeArrays`` field (fixed node capacity across trees).
+
+    ``internal_count``/``leaf_count`` are the per-node data-cover counts
+    TreeSHAP's zero-fractions derive from (reference: tree.h:331-358) —
+    ``None`` unless the forest was stacked ``with_counts=True``, so
+    predict-only sessions never pay their HBM footprint."""
     split_feature: object   # i32 [T, M]
     threshold_bin: object   # i32 [T, M]
     default_left: object    # bool [T, M]
@@ -35,16 +40,22 @@ class ForestArrays(NamedTuple):
     num_leaves: object      # i32 [T]
     cat_bitset: object      # u32 [T, M, W]
     class_id: object        # i32 [T] (tree t updates score column class_id[t])
+    internal_count: object = None   # i32 [T, M] (with_counts only)
+    leaf_count: object = None       # i32 [T, M+1] (with_counts only)
 
 
 def stack_forest(trees_np: list, class_ids: np.ndarray,
-                 min_words: int = 0) -> ForestArrays:
+                 min_words: int = 0, with_counts: bool = False
+                 ) -> ForestArrays:
     """Stack per-tree numpy array dicts (from ``GBDT._tree_arrays_np``)
     into one device-ready batch, padded to the widest tree.
 
     ``min_words`` pads every category bitset with zero words so an
     out-of-range sentinel bin (unseen/NaN categories at predict time) tests
-    False and routes right."""
+    False and routes right.  ``with_counts`` additionally stacks the
+    per-node ``internal_count``/``leaf_count`` cover counts (the tree
+    dicts must carry them — ``_tree_arrays_np(..., with_counts=True)``)
+    for the explain/ TreeSHAP path."""
     import jax.numpy as jnp
 
     M = max(max(t["split_feature"].shape[0] for t in trees_np), 1)
@@ -69,6 +80,10 @@ def stack_forest(trees_np: list, class_ids: np.ndarray,
             np.asarray([t["num_leaves"] for t in trees_np], np.int32)),
         cat_bitset=batch("cat_bitset", (M, W), np.uint32),
         class_id=jnp.asarray(class_ids.astype(np.int32)),
+        internal_count=(batch("internal_count", (M,), np.int32)
+                        if with_counts else None),
+        leaf_count=(batch("leaf_count", (M + 1,), np.int32)
+                    if with_counts else None),
     )
 
 
@@ -93,14 +108,17 @@ def forest_predict_fn(meta: DeviceMeta, K: int, early_stop: Optional[dict] = Non
 
         def body(carry, tree):
             score, comp, active, t = carry
-            (sf, tb, dl, lc, rc, lv, nl, cb, k) = tree
+            k = tree.class_id
+            lv = tree.leaf_value
             arrs = TreeArrays(
-                split_feature=sf, threshold_bin=tb, default_left=dl,
-                left_child=lc, right_child=rc,
+                split_feature=tree.split_feature,
+                threshold_bin=tree.threshold_bin,
+                default_left=tree.default_left,
+                left_child=tree.left_child, right_child=tree.right_child,
                 split_gain=None, internal_value=None, internal_count=None,
                 internal_weight=None,
                 leaf_value=lv, leaf_count=None, leaf_weight=None,
-                num_leaves=nl, cat_bitset=cb)
+                num_leaves=tree.num_leaves, cat_bitset=tree.cat_bitset)
             leaf = predict_leaf_bins(arrs, bins, meta)
             add = jnp.where(active, lv[leaf], 0.0)
             # Kahan-compensated f32 accumulation: the host oracle sums in
@@ -144,14 +162,16 @@ def forest_leaf_fn(meta: DeviceMeta):
     @jax.named_scope("lgbm/forest_leaf")
     def leaves(forest: ForestArrays, bins):
         def body(carry, tree):
-            (sf, tb, dl, lc, rc, lv, nl, cb, _k) = tree
             arrs = TreeArrays(
-                split_feature=sf, threshold_bin=tb, default_left=dl,
-                left_child=lc, right_child=rc,
+                split_feature=tree.split_feature,
+                threshold_bin=tree.threshold_bin,
+                default_left=tree.default_left,
+                left_child=tree.left_child, right_child=tree.right_child,
                 split_gain=None, internal_value=None, internal_count=None,
                 internal_weight=None,
-                leaf_value=lv, leaf_count=None, leaf_weight=None,
-                num_leaves=nl, cat_bitset=cb)
+                leaf_value=tree.leaf_value, leaf_count=None,
+                leaf_weight=None,
+                num_leaves=tree.num_leaves, cat_bitset=tree.cat_bitset)
             return carry, predict_leaf_bins(arrs, bins, meta)
 
         _, out = jax.lax.scan(body, jnp.int32(0), forest)

@@ -1,0 +1,24 @@
+"""Device TreeSHAP over stacked forests — explanation serving.
+
+The reference ships TreeSHAP as per-row host recursion
+(reference: tree.h:331-358, tree.cpp:609-716); ``core/shap.py`` mirrors
+it and stays the oracle.  This package recasts the same recurrence as a
+batched device kernel over the SoA ``ForestArrays``:
+
+- ``paths``: host-side pack-time metadata — per-leaf root->leaf paths,
+  duplicate-feature slot merging, and the data-cover zero-fractions
+  (from ``internal_count``/``leaf_count``, stacked behind
+  ``stack_forest(with_counts=True)``);
+- ``kernel``: the EXTEND/UNWIND recurrence as a ``lax.scan`` over trees
+  x fixed-depth scans over path slots, emitting ``[N, K, F+1]``
+  contributions (last column = expected value, matching
+  ``predict_contrib``).
+
+Serving exposure lives in ``serve/`` (``PredictorSession.explain``,
+``POST /explain``); the analytical cost model in ``ops/treeshap.py``.
+"""
+from .kernel import forest_shap_fn
+from .paths import ExplainArrays, stack_explain, tree_path_arrays
+
+__all__ = ["ExplainArrays", "forest_shap_fn", "stack_explain",
+           "tree_path_arrays"]

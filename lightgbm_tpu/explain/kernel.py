@@ -1,0 +1,147 @@
+"""Batched device TreeSHAP: one jitted scan over the stacked forest.
+
+The reference recurses per row per tree (tree.cpp:609-716).  Here the
+recursion is flattened into its path decomposition: every (row, leaf)
+pair is independent, so one ``lax.scan`` over trees evaluates all rows x
+all leaves in parallel, with two fixed-depth inner scans replacing the
+recursion's stack:
+
+1. **decisions** — every internal node's go-left bit for every row, one
+   vectorized ``split_decision`` pass ([N, M], the same bin-space
+   semantics as ``predict_leaf_bins``);
+2. **one-fraction merge** — per (leaf, edge) hot indicators AND-folded
+   into the merged slots (host precomputes the slot map, explain/paths);
+3. **EXTEND** — the reference's ExtendPath loop body, rewritten as its
+   closed-form parallel update: extending feature k maps the weight
+   vector ``w`` to ``(z*w*(k-j) + o*shift(w)*j) / (k+1)`` in one
+   elementwise op, so the whole extend is a scan of P steps over
+   [N, L, P+1];
+4. **UNWIND** — UnwoundPathSum for ALL slots at once: the ``i``-downward
+   recurrence keeps one running ``next_one_portion`` per slot, a scan of
+   P steps over [N, L, P].  One fractions here are 0/1 indicators, which
+   collapses the reference's ``one_fraction != 0`` branch to a select;
+5. **scatter** — ``W * (O - Z) * leaf_value`` accumulated into the
+   contribution columns (pad slots carry exactly 0 and land in the
+   expected-value column), plus the per-tree expected value in column F.
+
+Accumulation over trees is Kahan-compensated f32, like the forest
+predictor — parity with the f64 host oracle stays ~1e-6 independent of
+tree count (the serve tests pin 1e-5).
+"""
+from __future__ import annotations
+
+from ..core.meta import DeviceMeta
+
+
+def forest_shap_fn(meta: DeviceMeta, K: int, F: int):
+    """Build ``contribs(forest, explain, bins) -> [N, K, F+1] f32``.
+
+    ``forest`` is a ``ForestArrays`` (decision arrays; counts optional —
+    the zero fractions were folded into ``explain`` at pack time),
+    ``explain`` the matching ``ExplainArrays``, ``bins`` the [N, F] i32
+    matrix from the same bin space the forest was packed in."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..core.splitter import split_decision
+
+    @jax.named_scope("lgbm/forest_shap")
+    def contribs(forest, explain, bins):
+        N = bins.shape[0]
+        phi0 = jnp.zeros((N, K, F + 1), jnp.float32)
+        comp0 = jnp.zeros((N, K, F + 1), jnp.float32)
+
+        def body(carry, tree):
+            phi, comp = carry
+            fa, ea = tree
+            M = fa.split_feature.shape[0]
+            L, P = ea.path_node.shape
+
+            # 1. per-node decisions for every row: [N, M]
+            f = jnp.maximum(fa.split_feature, 0)
+            col = jnp.take(bins, f, axis=1).astype(jnp.int32)
+            word = fa.cat_bitset[jnp.arange(M)[None, :], col // 32]
+            go_left = split_decision(
+                col, fa.threshold_bin[None, :], fa.default_left[None, :],
+                meta.is_categorical[f][None, :], word,
+                meta.missing_types[f][None, :], meta.num_bins[f][None, :],
+                meta.default_bins[f][None, :])
+
+            # 2. hot indicators per (row, leaf, edge), pads forced hot,
+            # then AND-folded into the merged slots
+            node = jnp.maximum(ea.path_node, 0)
+            valid = ea.path_node >= 0
+            hot = jnp.where(valid[None, :, :],
+                            go_left[:, node] == ea.path_left[None, :, :],
+                            True)
+            slot_ids = jnp.arange(P, dtype=jnp.int32)
+
+            def merge(O, xs):
+                slot_p, hot_p = xs            # [L], [N, L]
+                oh = slot_p[:, None] == slot_ids[None, :]      # [L, P]
+                return O & (~oh[None] | hot_p[:, :, None]), None
+
+            O, _ = jax.lax.scan(
+                merge, jnp.ones((N, L, P), bool),
+                (ea.path_slot.T, jnp.moveaxis(hot, 2, 0)))
+            Of = O.astype(jnp.float32)
+            Z = ea.slot_zero[None, :, :]                        # [1, L, P]
+
+            # 3. EXTEND all P slots (identity pads included — null
+            # players leave the other features' Shapley values intact)
+            j = jnp.arange(P + 1, dtype=jnp.float32)
+
+            def extend(w, xs):
+                k, z, o = xs                  # f32, [L], [N, L]
+                shifted = jnp.concatenate(
+                    [jnp.zeros_like(w[..., :1]), w[..., :-1]], axis=-1)
+                w = (z[None, :, None] * w * (k - j)
+                     + o[..., None] * shifted * j) / (k + 1.0)
+                return w, None
+
+            w0 = jnp.zeros((N, L, P + 1)).at[..., 0].set(1.0)
+            w, _ = jax.lax.scan(
+                extend, w0,
+                (jnp.arange(1, P + 1, dtype=jnp.float32),
+                 ea.slot_zero.T, jnp.moveaxis(Of, 2, 0)))
+
+            # 4. UNWIND every slot in parallel (one fractions are 0/1:
+            # the o != 0 branch keeps the next_one_portion recurrence,
+            # the o == 0 branch is a pure sum)
+            Dp1 = jnp.float32(P + 1)
+
+            def unwind(carry, i):
+                nxt, total = carry
+                fi = i.astype(jnp.float32)
+                wi = w[..., i][..., None]                       # [N, L, 1]
+                # o == 0 slots poison ONLY their own (discarded) hot lane
+                # — the division guard keeps it finite-free of traps, the
+                # where() below picks the cold sum for them
+                tmp = nxt * Dp1 / ((fi + 1.0) * jnp.maximum(Of, 1e-30))
+                t_hot = total + tmp
+                nxt = wi - tmp * Z * (P - fi) / Dp1
+                t_cold = total + (wi / Z) * (Dp1 / (P - fi))
+                return (nxt, jnp.where(O, t_hot, t_cold)), None
+
+            nxt0 = jnp.broadcast_to(w[..., P:], (N, L, P))
+            (_, W), _ = jax.lax.scan(
+                unwind, (nxt0, jnp.zeros((N, L, P))),
+                jnp.arange(P - 1, -1, -1, dtype=jnp.int32))
+
+            # 5. contributions + expected value, Kahan-accumulated into
+            # the tree's class column
+            contrib = W * (Of - Z) * ea.leaf_value[None, :, None]
+            add = jnp.zeros((N, F + 1), jnp.float32)
+            add = add.at[:, ea.slot_feature].add(contrib)
+            add = add.at[:, F].add(ea.expected)
+            k = fa.class_id
+            y = add - comp[:, k]
+            t_sum = phi[:, k] + y
+            comp = comp.at[:, k].set((t_sum - phi[:, k]) - y)
+            phi = phi.at[:, k].set(t_sum)
+            return (phi, comp), None
+
+        (phi, _), _ = jax.lax.scan(body, (phi0, comp0), (forest, explain))
+        return phi
+
+    return jax.jit(contribs)

@@ -340,6 +340,25 @@ def serve_summary(events: List[dict]) -> dict:
     }
     if degraded:
         out["degraded_error"] = degraded[0].get("error")
+    xreqs = [e for e in events if e.get("event") == "explain_request"]
+    xbatches = [e for e in events if e.get("event") == "explain_batch"]
+    if xreqs or xbatches:
+        xlat = sorted(float(e.get("total_ms", 0.0) or 0.0)
+                      for e in xreqs if e.get("ok", True))
+        xrows = sum(int(e.get("rows", 0) or 0) for e in xbatches)
+        xpad = sum(int(e.get("padded", 0) or 0) for e in xbatches)
+        out["explain"] = {
+            "requests": len(xreqs),
+            "ok": sum(1 for e in xreqs if e.get("ok", True)),
+            "deadline_missed": sum(1 for e in xreqs
+                                   if e.get("reason") == "deadline"),
+            "batches": len(xbatches),
+            "rows": xrows,
+            "padded_rows": xpad,
+            "occupancy": round(xrows / xpad, 4) if xpad else None,
+            "p50_ms": percentile(xlat, 0.50),
+            "p99_ms": percentile(xlat, 0.99),
+        }
     return out
 
 
@@ -499,6 +518,22 @@ EVENT_SCHEMAS = {
     },
     "serve_degraded": {
         "error": (str, True),
+        "plane": (str, False),   # absent = predict, "explain" = TreeSHAP
+    },
+    # explanation serving (serve/session.py explain path + explain/)
+    "explain_request": {
+        "rows": (int, True),
+        "total_ms": (_NUM, True),
+        "ok": (bool, True),
+        "reason": (str, False),
+    },
+    "explain_batch": {
+        "rows": (int, True),
+        "padded": (int, True),
+        "requests": (int, True),
+        "queue_rows": (int, True),
+        "exec_ms": (_NUM, True),
+        "degraded": (bool, True),
     },
     "serve_overload": {
         "rows": (int, True),
@@ -557,8 +592,11 @@ EVENT_SCHEMAS = {
     "serve_probe": {
         "ok": (bool, True),
         "error": (str, False),
+        "plane": (str, False),
     },
-    "serve_recovered": {},
+    "serve_recovered": {
+        "plane": (str, False),
+    },
 }
 
 
@@ -701,6 +739,15 @@ def render(digest: dict) -> str:
         if s.get("overloads") or s.get("deadline_missed"):
             out.append(f"  overloads {s.get('overloads', 0)}, deadline "
                        f"misses {s.get('deadline_missed', 0)}")
+        if s.get("explain"):
+            x = s["explain"]
+            occ = x.get("occupancy")
+            out.append(f"  explain: {x['requests']} request(s), "
+                       f"{x['batches']} batch(es), "
+                       f"p50 {x.get('p50_ms')}ms p99 {x.get('p99_ms')}ms"
+                       + (f", occupancy {occ:.1%}" if occ else "")
+                       + (f", deadline misses {x['deadline_missed']}"
+                          if x.get("deadline_missed") else ""))
     if digest.get("robust"):
         r = digest["robust"]
         out.append("")

@@ -62,6 +62,10 @@ _FLIGHT_EVENTS = frozenset((
     "health", "divergence", "fingerprint", "train_stop", "iteration",
     "serve_degraded", "serve_overload", "serve_batch", "serve_request",
     "serve_access", "serve_start", "serve_stop",
+    # explanation serving (serve/session.py explain path): the TreeSHAP
+    # batch history belongs in a serving post-mortem exactly like the
+    # predict batches beside it
+    "explain_request", "explain_batch",
     # fault tolerance (robust/): the recovery record is exactly what a
     # wedge post-mortem needs in the ring
     "checkpoint", "restore", "retry", "fault_injected", "device_stall",

@@ -9,7 +9,9 @@ microbatcher behind a threaded HTTP front end.
 
 - ``packing``  — model-derived bin space + stacked forest (no train_ds)
 - ``session``  — ``PredictorSession``: sync ``predict`` + async
-  ``submit``/``result`` over the microbatcher
+  ``submit``/``result`` over the microbatcher, plus ``explain`` /
+  ``submit_explain`` — batched device TreeSHAP (explain/) behind its
+  own microbatch queue and pow2 bucket family (``POST /explain``)
 - ``batcher``  — request coalescing, power-of-two padding, backpressure
 - ``server``   — JSON-over-HTTP front end with deadlines + /health,
   /metrics (Prometheus), /stats, /debug/flight

@@ -40,6 +40,15 @@ class ServeMetrics:
         self._lat_count = 0
         self._ok = 0
         self._failed = 0
+        # explanation requests get their own histogram + outcome
+        # counters: a TreeSHAP row costs O(leaves x depth^2) vs the
+        # predictor's O(depth), so folding both into one latency
+        # distribution would make either signal unreadable
+        self._x_buckets = [0] * (len(LATENCY_BUCKETS_MS) + 1)
+        self._x_lat_sum = 0.0
+        self._x_lat_count = 0
+        self._x_ok = 0
+        self._x_failed = 0
         self._status: Dict[int, int] = {}
         self._recent = deque(maxlen=_SLO_WINDOW)
         # degradation is a recoverable state (serve/session.py re-probes
@@ -51,14 +60,22 @@ class ServeMetrics:
         self._recoveries = 0
 
     # ---- hot path ----------------------------------------------------
-    def observe(self, latency_ms: float, ok: bool = True) -> None:
-        """Account one finished request (any outcome)."""
-        ms = float(latency_ms)
+    @staticmethod
+    def _bucket_index(ms: float) -> int:
+        """Index into LATENCY_BUCKETS_MS (+1 overflow slot) — the ONE
+        copy of the histogram bucketing rule, shared by the predict and
+        explain observers so the two histograms cannot drift."""
         i = 0
         for b in LATENCY_BUCKETS_MS:
             if ms <= b:
                 break
             i += 1
+        return i
+
+    def observe(self, latency_ms: float, ok: bool = True) -> None:
+        """Account one finished request (any outcome)."""
+        ms = float(latency_ms)
+        i = self._bucket_index(ms)
         with self._lock:
             self._buckets[i] += 1
             self._lat_sum += ms
@@ -68,6 +85,19 @@ class ServeMetrics:
             else:
                 self._failed += 1
             self._recent.append(ms)
+
+    def observe_explain(self, latency_ms: float, ok: bool = True) -> None:
+        """Account one finished explanation request (any outcome)."""
+        ms = float(latency_ms)
+        i = self._bucket_index(ms)
+        with self._lock:
+            self._x_buckets[i] += 1
+            self._x_lat_sum += ms
+            self._x_lat_count += 1
+            if ok:
+                self._x_ok += 1
+            else:
+                self._x_failed += 1
 
     def count_status(self, code: int) -> None:
         """Bump the HTTP-status counter (server front end only)."""
@@ -116,6 +146,10 @@ class ServeMetrics:
             for c in self._buckets:
                 total += c
                 cum.append(total)
+            x_cum, x_total = [], 0
+            for c in self._x_buckets:
+                x_total += c
+                x_cum.append(x_total)
             return {
                 "latency_buckets_ms": list(LATENCY_BUCKETS_MS),
                 "latency_cumulative": cum,
@@ -123,6 +157,11 @@ class ServeMetrics:
                 "latency_count": self._lat_count,
                 "ok": self._ok,
                 "failed": self._failed,
+                "explain_latency_cumulative": x_cum,
+                "explain_latency_sum_ms": round(self._x_lat_sum, 3),
+                "explain_latency_count": self._x_lat_count,
+                "explain_ok": self._x_ok,
+                "explain_failed": self._x_failed,
                 "status": dict(sorted(self._status.items())),
                 "slo_p99_ms": self.slo_p99_ms or None,
                 "slo_burn": burn,
@@ -175,6 +214,23 @@ def render_prometheus(session) -> str:
                % _fmt(snap["latency_sum_ms"]))
     out.append("tpu_serve_request_latency_ms_count %d"
                % snap["latency_count"])
+    head("tpu_serve_explain_requests_total", "counter",
+         "Explanation requests by outcome (POST /explain).")
+    out.append('tpu_serve_explain_requests_total{outcome="ok"} %d'
+               % snap["explain_ok"])
+    out.append('tpu_serve_explain_requests_total{outcome="failed"} %d'
+               % snap["explain_failed"])
+    head("tpu_serve_explain_latency_ms", "histogram",
+         "Explanation request latency (submit to result), milliseconds.")
+    for b, c in zip(LATENCY_BUCKETS_MS, snap["explain_latency_cumulative"]):
+        out.append('tpu_serve_explain_latency_ms_bucket{le="%g"} %d'
+                   % (b, c))
+    out.append('tpu_serve_explain_latency_ms_bucket{le="+Inf"} %d'
+               % snap["explain_latency_count"])
+    out.append("tpu_serve_explain_latency_ms_sum %s"
+               % _fmt(snap["explain_latency_sum_ms"]))
+    out.append("tpu_serve_explain_latency_ms_count %d"
+               % snap["explain_latency_count"])
 
     gauges = (
         ("tpu_serve_queue_rows", "gauge", "Rows waiting in the batcher "
@@ -188,6 +244,10 @@ def render_prometheus(session) -> str:
          "executed.", st.get("batches")),
         ("tpu_serve_rows_total", "counter", "Real rows scored.",
          st.get("rows")),
+        ("tpu_serve_explain_batches_total", "counter", "Device/host "
+         "TreeSHAP batches executed.", st.get("explain_batches")),
+        ("tpu_serve_explain_rows_total", "counter", "Real rows "
+         "explained.", st.get("explain_rows")),
         ("tpu_serve_overloads_total", "counter", "Submits rejected by "
          "backpressure.", st.get("overloads")),
         ("tpu_serve_deadline_missed_total", "counter", "Requests expired "

@@ -125,10 +125,15 @@ class ServeBinSpace:
         return out
 
     # ------------------------------------------------------------------
-    def tree_arrays_np(self, tree) -> dict:
+    def tree_arrays_np(self, tree, with_counts: bool = False) -> dict:
         """Bin-space numpy arrays for one value-space host ``Tree`` — the
         unit ``core.forest.stack_forest`` batches (the serving analog of
-        ``GBDT._tree_arrays_np``, which needs a live train_ds)."""
+        ``GBDT._tree_arrays_np``, which needs a live train_ds).
+
+        ``with_counts`` adds the per-node data-cover counts the explain/
+        TreeSHAP path needs — model.txt carries them
+        (``internal_count=``/``leaf_count=`` lines), so file-loaded
+        serving sessions can explain without training state."""
         nl = tree.num_leaves
         nn = max(nl - 1, 0)
         sf = np.asarray(tree.split_feature[:nn], np.int32)
@@ -145,7 +150,7 @@ class ServeBinSpace:
                 m = self.mappers[int(sf[i])]
                 thr_bin[i] = int(m.value_to_bin(float(tree.threshold[i])))
                 dl[i] = tree.default_left(i)
-        return dict(
+        out = dict(
             split_feature=sf,
             threshold_bin=thr_bin,
             default_left=dl,
@@ -155,10 +160,18 @@ class ServeBinSpace:
             num_leaves=np.int32(nl),
             cat_bitset=cat_bits[:nn] if nn else cat_bits[:0],
         )
+        if with_counts:
+            out["internal_count"] = \
+                np.asarray(tree.internal_count[:nn], np.int32)
+            out["leaf_count"] = np.asarray(tree.leaf_count[:nl], np.int32)
+        return out
 
-    def pack(self, trees, class_ids: np.ndarray):
+    def pack(self, trees, class_ids: np.ndarray,
+             with_counts: bool = False):
         """Stack a tree window into one device-ready ``ForestArrays``."""
         from ..core.forest import stack_forest
-        return stack_forest([self.tree_arrays_np(t) for t in trees],
+        return stack_forest([self.tree_arrays_np(t, with_counts=with_counts)
+                             for t in trees],
                             np.asarray(class_ids, np.int32),
-                            min_words=self.min_words)
+                            min_words=self.min_words,
+                            with_counts=with_counts)

@@ -6,6 +6,14 @@ Stdlib ``http.server`` only — no new dependencies.  Protocol:
                           "deadline_ms": 250}
                  -> 200 {"predictions": [...], "rows": N,
                          "latency_ms": ..., "trace_id": ...}
+    POST /explain   body {"rows": [[...], ...], "deadline_ms": 250}
+                 -> 200 {"contributions": [[...]], "rows": N,
+                         "num_features": F, "num_class": K, ...}
+                    — per-row SHAP contributions ([F+1] per class, last
+                    column = expected value), computed by the batched
+                    device TreeSHAP kernel (explain/) through its OWN
+                    microbatch queue and pow2 bucket family; 404 when
+                    ``tpu_explain=false``
     GET  /health       -> 200 {"status": "ok"|"degraded", queue_rows,
                                uptime_s, compile_count, slo_burn,
                                ...session stats...}
@@ -161,13 +169,23 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_POST(self):  # noqa: N802 — http.server API
         self._begin()
-        if self.path.split("?")[0].rstrip("/") != "/predict":
+        path = self.path.split("?")[0].rstrip("/")
+        if path not in ("/predict", "/explain"):
             try:
                 self._reply(404, {"error": "not_found", "path": self.path})
             finally:
                 self._end()
             return
+        explain = path == "/explain"
         sess = self.server.session
+        if explain and not getattr(sess, "explain_enabled", False):
+            try:
+                self._reply(404, {"error": "explain_disabled",
+                                  "detail": "explanation serving is off "
+                                  "(tpu_explain=false)"})
+            finally:
+                self._end()
+            return
         t0 = self._t0
         root_id = (obs.new_span_id() if obs.span_record_enabled()
                    else None)
@@ -179,20 +197,34 @@ class _Handler(BaseHTTPRequestHandler):
                 raise ValueError("body needs a 'rows' matrix")
             X = np.asarray(rows, dtype=np.float64)
             deadline_ms = payload.get("deadline_ms")
-            ticket = sess.submit(X, deadline_ms=deadline_ms,
-                                 raw_score=bool(payload.get("raw_score")),
-                                 trace_id=self._trace_id,
-                                 parent_id=root_id)
+            if explain:
+                ticket = sess.submit_explain(X, deadline_ms=deadline_ms,
+                                             trace_id=self._trace_id,
+                                             parent_id=root_id)
+            else:
+                ticket = sess.submit(
+                    X, deadline_ms=deadline_ms,
+                    raw_score=bool(payload.get("raw_score")),
+                    trace_id=self._trace_id, parent_id=root_id)
             wait_s = (float(deadline_ms) / 1e3 + _REPLY_GRACE_S
                       if deadline_ms is not None
                       else _DEFAULT_REPLY_TIMEOUT_S)
             pred = sess.result(ticket, timeout=wait_s)
-            self._reply(200, {
-                "predictions": np.asarray(pred).tolist(),
+            body = {
                 "rows": int(ticket.rows),
                 "latency_ms": round((time.perf_counter() - t0) * 1e3, 3),
                 "trace_id": self._trace_id,
-            })
+            }
+            if explain:
+                # [n, F+1] (or [n, K*(F+1)] multiclass); the last column
+                # per class block is the expected value, like
+                # predict_contrib
+                body["contributions"] = np.asarray(pred).tolist()
+                body["num_features"] = int(sess.num_features)
+                body["num_class"] = int(sess.num_tpi)
+            else:
+                body["predictions"] = np.asarray(pred).tolist()
+            self._reply(200, body)
         except ServeOverloadError as exc:
             self._reply(503, {"error": "overloaded", "detail": str(exc)})
         except (DeadlineExceeded, _FutureTimeout) as exc:
@@ -208,10 +240,11 @@ class _Handler(BaseHTTPRequestHandler):
                 # the request's root span: the whole HTTP handling wall
                 # time, parent of the queue/coalesce/pad/execute chain
                 obs.emit_span(
-                    "serve/request", self._t0_wall,
+                    "explain/request" if explain else "serve/request",
+                    self._t0_wall,
                     (time.perf_counter() - t0) * 1e3, self._trace_id,
                     span_id=root_id,
-                    attrs={"status": self._status, "path": "/predict"})
+                    attrs={"status": self._status, "path": path})
             self._end()
 
 
@@ -243,9 +276,11 @@ class PredictServer:
             target=self._httpd.serve_forever, name="lgbm-serve-http",
             daemon=True)
         self._thread.start()
-        log.info("serving %d trees on %s (POST /predict, GET /health "
+        log.info("serving %d trees on %s (POST /predict%s, GET /health "
                  "/metrics /stats /debug/flight)",
-                 self.session.num_trees, self.url)
+                 self.session.num_trees, self.url,
+                 " /explain" if getattr(self.session, "explain_enabled",
+                                        False) else "")
         return self
 
     def stop(self, close_session: bool = False) -> None:

@@ -11,6 +11,11 @@ rebuilt for TPU batch traversal:
 - ``predict(X)`` is the synchronous path (chunks internally to the
   batch cap); ``submit(X) -> ticket`` / ``result(ticket)`` the async
   one, coalesced by the dynamic microbatcher (serve/batcher.py);
+- ``explain(X)`` / ``submit_explain(X)`` are the SHAP-contribution
+  twins: the batched device TreeSHAP kernel (explain/) behind its own
+  microbatcher and pow2 bucket family (``tpu_explain_max_batch`` /
+  ``tpu_explain_max_wait_ms``), packed lazily on first use so
+  predict-only sessions never pay the path-metadata HBM cost;
 - every device call pads its rows to the next power-of-two bucket, so
   the jitted forest scan compiles at most ``ceil(log2(max_batch)) + 1``
   shapes — the obs recompile counter (obs/trace.py) verifies the bound;
@@ -82,16 +87,21 @@ def _env_num(name: str, cast, fallback):
 
 class Ticket:
     """Handle for an async submission (one or more batcher requests —
-    oversize submissions are chunked to the batch cap)."""
+    oversize submissions are chunked to the batch cap).  ``kind`` is
+    ``"predict"`` or ``"explain"`` — it picks the result conversion and
+    which accounting stream (latency histogram, events) the ticket's
+    outcome lands in."""
 
-    __slots__ = ("parts", "rows", "raw_score", "t0", "counted")
+    __slots__ = ("parts", "rows", "raw_score", "t0", "counted", "kind")
 
-    def __init__(self, parts, rows: int, raw_score: bool):
+    def __init__(self, parts, rows: int, raw_score: bool,
+                 kind: str = "predict"):
         self.parts = parts          # [(future, n_rows), ...]
         self.rows = rows
         self.raw_score = raw_score
         self.t0 = time.perf_counter()
         self.counted = False        # request-level stats recorded once
+        self.kind = kind
 
 
 class PredictorSession:
@@ -153,6 +163,38 @@ class PredictorSession:
             queue_depth if queue_depth is not None else _env_num(
                 "LGBM_TPU_SERVE_QUEUE_DEPTH", int,
                 getattr(config, "tpu_serve_queue_depth", 8192)))
+        # ---- explanation serving (explain/ TreeSHAP) -----------------
+        env_x = os.environ.get("LGBM_TPU_EXPLAIN", "").strip().lower()
+        self.explain_enabled = (env_x not in ("0", "false", "off")
+                                if env_x
+                                else bool(getattr(config, "tpu_explain",
+                                                  True)))
+        self.explain_max_batch = max(int(_env_num(
+            "LGBM_TPU_EXPLAIN_MAX_BATCH", int,
+            getattr(config, "tpu_explain_max_batch", 256))), 1)
+        self.explain_max_wait_ms = max(float(_env_num(
+            "LGBM_TPU_EXPLAIN_MAX_WAIT_MS", float,
+            getattr(config, "tpu_explain_max_wait_ms", 5.0))), 0.0)
+        # packed lazily on first explain()/submit_explain(): the path
+        # metadata + its batcher cost host time and HBM a predict-only
+        # session must not pay
+        self._explain = None
+        self._explain_lock = threading.Lock()
+        self._explain_buckets: set = set()
+        self._explain_batches = 0
+        self._explain_rows = 0
+        self._explain_padded = 0
+        self._n_explain = 0
+        self._n_explain_ok = 0
+        self._n_explain_deadline = 0
+        self._xlat_ms: list = []
+        # the explain plane degrades apart from predict's: the TreeSHAP
+        # kernel's [N, L, P] working set can fail (HBM OOM) while 1-row
+        # predicts still succeed, so a shared flag would let the predict
+        # reprobe re-arm a kernel that is still broken — a sustained
+        # degrade/recover oscillation routing predict to the host path
+        self._explain_degraded = False
+        self._last_explain_probe = 0.0
 
         # ---- pack once: bin space + stacked forest + jitted scan ------
         self.space = ServeBinSpace(trees, F)
@@ -328,6 +370,59 @@ class PredictorSession:
                  "device predictions resume")
         return True
 
+    def _note_degraded_explain(self, exc: BaseException) -> None:
+        if not self._explain_degraded:
+            self._explain_degraded = True
+            self._last_explain_probe = time.monotonic()
+            log.warning("serve: device TreeSHAP kernel failed (%s: %s); "
+                        "degrading /explain to the host oracle"
+                        + (" (re-probing every %.3gs)" % self.reprobe_s
+                           if self.reprobe_s > 0 else ""),
+                        type(exc).__name__, exc)
+            obs.event("serve_degraded", plane="explain",
+                      error=f"{type(exc).__name__}: {exc}")
+            self._flight_dump("serve_degraded", force=True)
+
+    def _maybe_reprobe_explain(self) -> bool:
+        """Explain-plane twin of ``_maybe_reprobe`` — the probe runs the
+        TreeSHAP kernel itself (a 1-row predict proving nothing about
+        the much larger explain working set)."""
+        if not self._explain_degraded or self.reprobe_s <= 0:
+            return False
+        now = time.monotonic()
+        with self._lock:
+            if now - self._last_explain_probe < self.reprobe_s:
+                return False
+            self._last_explain_probe = now
+        try:
+            self._run_device_explain(
+                np.zeros((1, self.num_features), np.int32))
+        except Exception as exc:  # noqa: BLE001 — stay degraded
+            obs.event("serve_probe", plane="explain", ok=False,
+                      error=f"{type(exc).__name__}: {exc}")
+            return False
+        self._explain_degraded = False
+        obs.event("serve_probe", plane="explain", ok=True)
+        obs.event("serve_recovered", plane="explain")
+        log.info("serve: TreeSHAP probe succeeded — leaving explain "
+                 "degraded mode, device explanations resume")
+        return True
+
+    def _note_overload(self, rows: int, queue_rows: int) -> None:
+        """Shared overload accounting for both submit paths: counter,
+        event, and the storm check (>= _STORM_N rejects inside
+        _STORM_WINDOW_S dumps the flight ring once per cooldown)."""
+        storm = False
+        now = time.monotonic()
+        with self._lock:
+            self._n_overload += 1
+            self._overload_times.append(now)
+            storm = (len(self._overload_times) == _STORM_N
+                     and now - self._overload_times[0] <= _STORM_WINDOW_S)
+        obs.event("serve_overload", rows=int(rows), queue_rows=queue_rows)
+        if storm:
+            self._flight_dump("overload_storm")
+
     def _flight_dump(self, reason: str, force: bool = False) -> None:
         """Rate-limited flight-ring dump (no-op when the ring is off).
         ``force`` bypasses the cooldown for one-shot events whose dump
@@ -371,6 +466,278 @@ class PredictorSession:
         return self._run_host(X)
 
     # ------------------------------------------------------------------
+    # explanation serving: batched device TreeSHAP (explain/)
+    # ------------------------------------------------------------------
+    def _ensure_explain(self):
+        """Pack the TreeSHAP state on first use: per-leaf path metadata
+        (zero fractions from the trees' cover counts), the jitted
+        EXTEND/UNWIND kernel, and a second microbatcher with its OWN
+        pow2 bucket family — explain rows cost O(leaves x depth^2), so
+        they must not share predict's row buckets or its queue budget
+        accounting would lie.  Raises on a model without cover counts
+        (TreeSHAP cannot be computed) or when explaining is disabled."""
+        if not self.explain_enabled:
+            raise RuntimeError(
+                "explanation serving is disabled (tpu_explain=false)")
+        got = self._explain
+        if got is not None:
+            return got
+        with self._explain_lock:
+            if self._explain is None:
+                from ..explain import forest_shap_fn, stack_explain
+                K, F = self.num_tpi, self.num_features
+                trees_np = [self.space.tree_arrays_np(t, with_counts=True)
+                            for t in self._trees]
+                arrays = stack_explain(trees_np, F)
+                # the kernel reads only the decision arrays — the counts
+                # were folded into the path metadata host-side, so the
+                # stacked forest stays count-free (no HBM growth over
+                # the predict forest; it IS the predict forest)
+                forest = self.forest
+                fn = forest_shap_fn(self.space.meta, K, F)
+                if obs.profile_enabled():
+                    fn = obs.profile_wrap("lgbm/forest_shap", fn)
+                batcher = MicroBatcher(
+                    self._execute_explain_batch,
+                    max_batch=self.explain_max_batch,
+                    max_wait_s=self.explain_max_wait_ms / 1e3,
+                    max_queue_rows=self.queue_depth,
+                    name="lgbm-serve-explain")
+                self._explain = (forest, arrays, fn, batcher)
+        return self._explain
+
+    def warmup_explain(self) -> int:
+        """Pre-compile every explain bucket shape (the analog of
+        ``warmup`` for the TreeSHAP kernel's own bucket family).
+        Returns the bucket count."""
+        self._ensure_explain()
+        b, n = 1, 0
+        while True:
+            size = min(b, self.explain_max_batch)
+            self._run_device_explain(
+                np.zeros((size, self.num_features), np.int32))
+            n += 1
+            if size >= self.explain_max_batch:
+                return n
+            b *= 2
+
+    def _bucket_explain(self, n: int) -> int:
+        b = 1
+        while b < n:
+            b *= 2
+        return min(b, self.explain_max_batch)
+
+    def _run_device_explain(self, bins: np.ndarray, span_ctx=None):
+        """Pad to the explain pow2 bucket, run the jitted TreeSHAP scan,
+        slice the pad off.  Returns ([n, K, F+1] f64 contributions,
+        bucket)."""
+        import jax.numpy as jnp
+        forest, arrays, fn, _ = self._ensure_explain()
+        n = bins.shape[0]
+        t_pad0 = time.time()
+        b = self._bucket_explain(n)
+        if b > n:
+            bins = np.concatenate(
+                [bins, np.zeros((b - n, bins.shape[1]), bins.dtype)])
+        with self._lock:
+            self._explain_buckets.add(b)
+        arr = jnp.asarray(bins)
+        t_exec0 = time.time()
+        faults.check("serve_device")
+        out = fn(forest, arrays, arr)
+        contrib = np.asarray(out, dtype=np.float64)[:n]
+        if span_ctx:
+            t_end = time.time()
+            for tid, pid in span_ctx:
+                obs.emit_span("explain/pad", t_pad0,
+                              (t_exec0 - t_pad0) * 1e3, tid, parent_id=pid,
+                              attrs={"rows": n, "bucket": b})
+                obs.emit_span("explain/device_execute", t_exec0,
+                              (t_end - t_exec0) * 1e3, tid, parent_id=pid,
+                              attrs={"bucket": b})
+        return contrib, b
+
+    def _run_host_explain(self, X: np.ndarray, span_ctx=None) -> np.ndarray:
+        """Degraded path: the host TreeSHAP recursion (core/shap.py) —
+        per-row Python, slow, but requests keep succeeding."""
+        from ..core.shap import _expected_value, _tree_shap
+        t0 = time.time()
+        K, F = self.num_tpi, self.num_features
+        out = np.zeros((X.shape[0], K, F + 1))
+        for i, tree in enumerate(self._trees):
+            k = i % K
+            out[:, k, F] += _expected_value(tree)
+            if tree.num_leaves > 1:
+                for r in range(X.shape[0]):
+                    _tree_shap(tree, X[r], out[r, k, :F], 0, 0, [],
+                               1.0, 1.0, -1)
+        if span_ctx:
+            dur = (time.time() - t0) * 1e3
+            for tid, pid in span_ctx:
+                obs.emit_span("explain/host_fallback", t0, dur, tid,
+                              parent_id=pid,
+                              attrs={"rows": int(X.shape[0])})
+        return out
+
+    def _convert_explain(self, contrib: np.ndarray) -> np.ndarray:
+        """[n, K, F+1] -> the ``predict_contrib`` surface: [n, F+1], or
+        [n, K*(F+1)] for multiclass (last column per class = expected
+        value).  Contributions live in raw-score space — no objective
+        conversion, matching the host oracle."""
+        n, K = contrib.shape[0], self.num_tpi
+        return (contrib.reshape(n, K * (self.num_features + 1))
+                if K > 1 else contrib[:, 0, :])
+
+    def explain(self, X) -> np.ndarray:
+        """Synchronous SHAP contributions, bypassing the queue (still
+        bucketed, so it shares the bounded explain compile set with the
+        async path)."""
+        X = self._check_input(X)
+        self._ensure_explain()
+        t0 = time.perf_counter()
+        K, F = self.num_tpi, self.num_features
+        out = np.zeros((X.shape[0], K, F + 1))
+        for lo in range(0, X.shape[0], self.explain_max_batch):
+            chunk = X[lo:lo + self.explain_max_batch]
+            out[lo:lo + chunk.shape[0]] = self._explain_chunk(chunk)
+        self._note_explain_request(X.shape[0],
+                                   (time.perf_counter() - t0) * 1e3)
+        return self._convert_explain(out)
+
+    def _explain_chunk(self, X: np.ndarray) -> np.ndarray:
+        if self._degraded:
+            self._maybe_reprobe()
+        if self._explain_degraded:
+            self._maybe_reprobe_explain()
+        if not (self._degraded or self._explain_degraded):
+            try:
+                return self._run_device_explain(
+                    self.space.bin_matrix(X))[0]
+            except Exception as exc:  # noqa: BLE001 — degrade, don't fail
+                self._note_degraded_explain(exc)
+        return self._run_host_explain(X)
+
+    def submit_explain(self, X, deadline_ms: Optional[float] = None,
+                       trace_id: Optional[str] = None,
+                       parent_id: Optional[str] = None) -> Ticket:
+        """Queue rows for the next coalesced TreeSHAP batch — the
+        explain analog of ``submit`` (same chunking, deadline and
+        backpressure semantics, its own queue + bucket family)."""
+        X = self._check_input(X)
+        if self._closed:
+            raise RuntimeError("session is closed")
+        _, _, _, batcher = self._ensure_explain()
+        if trace_id is None and obs.span_record_enabled():
+            trace_id = obs.new_trace_id()
+        deadline = (time.monotonic() + float(deadline_ms) / 1e3
+                    if deadline_ms is not None else None)
+        parts = []
+        try:
+            for lo in range(0, max(X.shape[0], 1),
+                            self.explain_max_batch):
+                chunk = X[lo:lo + self.explain_max_batch]
+                req = Request(self.space.bin_matrix(chunk), chunk,
+                              deadline=deadline, trace_id=trace_id,
+                              parent_id=parent_id)
+                parts.append((batcher.submit(req), chunk.shape[0]))
+        except ServeOverloadError:
+            self._note_overload(X.shape[0], batcher.queue_rows)
+            for fut, _ in parts:  # a partially queued ticket must not leak
+                fut.cancel()
+            raise
+        return Ticket(parts, int(X.shape[0]), False, kind="explain")
+
+    def _execute_explain_batch(self, reqs) -> None:
+        """Explain batcher callback: expire, coalesce, pad, dispatch the
+        TreeSHAP kernel, split — ``_execute_batch`` semantics with the
+        explain bucket family, ``explain/*`` spans and the
+        ``explain_batch`` event."""
+        now = time.monotonic()
+        live = []
+        for r in reqs:
+            if r.future.cancelled():
+                continue
+            if r.deadline is not None and now > r.deadline:
+                waited = (now - r.t_submit) * 1e3
+                _safe_resolve(r.future, error=DeadlineExceeded(
+                    f"request expired after {waited:.1f}ms in queue"))
+            else:
+                live.append(r)
+        if not live:
+            return
+        rows = sum(r.n for r in live)
+        span_ctx = None
+        if obs.span_record_enabled():
+            t_dispatch = time.time()
+            span_ctx = []
+            for r in live:
+                tid = r.trace_id or obs.new_trace_id()
+                obs.emit_span("explain/queue_wait", r.t_submit_wall,
+                              (now - r.t_submit) * 1e3, tid,
+                              parent_id=r.parent_id, attrs={"rows": r.n})
+                obs.emit_span("explain/coalesce", r.t_submit_wall,
+                              max(t_dispatch - r.t_submit_wall, 0.0)
+                              * 1e3, tid, parent_id=r.parent_id,
+                              attrs={"requests": len(live), "rows": rows})
+                span_ctx.append((tid, r.parent_id))
+        t0 = time.perf_counter()
+        if self._degraded:
+            self._maybe_reprobe()
+        if self._explain_degraded:
+            self._maybe_reprobe_explain()
+        degraded = self._degraded or self._explain_degraded
+        contrib, bucket = None, rows
+        if not degraded:
+            try:
+                bins = (live[0].bins if len(live) == 1
+                        else np.concatenate([r.bins for r in live]))
+                contrib, bucket = self._run_device_explain(
+                    bins, span_ctx=span_ctx)
+            except Exception as exc:  # noqa: BLE001 — degrade, don't fail
+                self._note_degraded_explain(exc)
+                degraded = True
+        if degraded:
+            contrib = (np.concatenate([self._run_host_explain(r.raw)
+                                       for r in live])
+                       if len(live) > 1
+                       else self._run_host_explain(live[0].raw,
+                                                   span_ctx=span_ctx))
+            if span_ctx and len(live) > 1:
+                # chunk-level spans would mis-attribute across requests;
+                # one fallback span per request trace instead (the
+                # predict twin's convention)
+                t_end = time.time()
+                for tid, pid in span_ctx:
+                    obs.emit_span("explain/host_fallback", t_dispatch,
+                                  (t_end - t_dispatch) * 1e3, tid,
+                                  parent_id=pid, attrs={"rows": rows})
+        exec_ms = (time.perf_counter() - t0) * 1e3
+        off = 0
+        for r in live:
+            _safe_resolve(r.future, result=contrib[off:off + r.n])
+            off += r.n
+        with self._lock:
+            self._explain_batches += 1
+            self._explain_rows += rows
+            self._explain_padded += bucket
+        batcher = self._explain[3] if self._explain else None
+        obs.event("explain_batch", rows=rows, padded=int(bucket),
+                  requests=len(live),
+                  queue_rows=batcher.queue_rows if batcher else 0,
+                  exec_ms=round(exec_ms, 3), degraded=degraded)
+
+    def _note_explain_request(self, rows: int, total_ms: float) -> None:
+        with self._lock:
+            self._n_explain += 1
+            self._n_explain_ok += 1
+            self._xlat_ms.append(total_ms)
+            if len(self._xlat_ms) > _LAT_RESERVOIR:
+                del self._xlat_ms[:_LAT_RESERVOIR // 2]
+        self.metrics.observe_explain(total_ms, ok=True)
+        obs.event("explain_request", rows=int(rows),
+                  total_ms=round(total_ms, 3), ok=True)
+
+    # ------------------------------------------------------------------
     def submit(self, X, deadline_ms: Optional[float] = None,
                raw_score: bool = False, trace_id: Optional[str] = None,
                parent_id: Optional[str] = None) -> Ticket:
@@ -397,20 +764,9 @@ class PredictorSession:
                               parent_id=parent_id)
                 parts.append((self._batcher.submit(req), chunk.shape[0]))
         except ServeOverloadError:
-            storm = False
-            now = time.monotonic()
-            with self._lock:
-                self._n_overload += 1
-                self._overload_times.append(now)
-                storm = (len(self._overload_times) == _STORM_N
-                         and now - self._overload_times[0]
-                         <= _STORM_WINDOW_S)
-            obs.event("serve_overload", rows=int(X.shape[0]),
-                      queue_rows=self._batcher.queue_rows)
+            self._note_overload(X.shape[0], self._batcher.queue_rows)
             for fut, _ in parts:  # a partially queued ticket must not leak
                 fut.cancel()
-            if storm:
-                self._flight_dump("overload_storm")
             raise
         return Ticket(parts, int(X.shape[0]), raw_score)
 
@@ -434,10 +790,15 @@ class PredictorSession:
             self._note_failure(ticket, exc)
             raise
         raw = chunks[0] if len(chunks) == 1 else np.concatenate(chunks)
+        total_ms = (time.perf_counter() - ticket.t0) * 1e3
+        if ticket.kind == "explain":
+            if not ticket.counted:
+                ticket.counted = True
+                self._note_explain_request(ticket.rows, total_ms)
+            return self._convert_explain(raw)
         if not ticket.counted:
             ticket.counted = True
-            self._note_request(ticket.rows,
-                               (time.perf_counter() - ticket.t0) * 1e3)
+            self._note_request(ticket.rows, total_ms)
         return self._convert(raw, ticket.raw_score)
 
     def _note_failure(self, ticket: Ticket, exc: BaseException) -> None:
@@ -447,6 +808,15 @@ class PredictorSession:
         reason = ("deadline" if isinstance(exc, DeadlineExceeded)
                   else type(exc).__name__)
         total_ms = (time.perf_counter() - ticket.t0) * 1e3
+        if ticket.kind == "explain":
+            with self._lock:
+                self._n_explain += 1
+                if reason == "deadline":
+                    self._n_explain_deadline += 1
+            self.metrics.observe_explain(total_ms, ok=False)
+            obs.event("explain_request", rows=int(ticket.rows),
+                      total_ms=round(total_ms, 3), ok=False, reason=reason)
+            return
         with self._lock:
             self._n_req += 1
             if reason == "deadline":
@@ -567,12 +937,32 @@ class PredictorSession:
         from ..obs.report import percentile
         with self._lock:
             lat = sorted(self._lat_ms)
+            xlat = sorted(self._xlat_ms)
 
             def pct(p):
                 return percentile(lat, p)
 
             padded = self._padded_rows
+            explain = {
+                "explain_enabled": self.explain_enabled,
+                "explain_armed": self._explain is not None,
+                "explain_requests": self._n_explain,
+                "explain_ok": self._n_explain_ok,
+                "explain_batches": self._explain_batches,
+                "explain_rows": self._explain_rows,
+                "explain_padded_rows": self._explain_padded,
+                "explain_occupancy": (
+                    round(self._explain_rows / self._explain_padded, 4)
+                    if self._explain_padded else None),
+                "explain_p50_ms": percentile(xlat, 0.50),
+                "explain_p99_ms": percentile(xlat, 0.99),
+                "explain_buckets": sorted(self._explain_buckets),
+                "explain_max_batch": self.explain_max_batch,
+                "explain_deadline_missed": self._n_explain_deadline,
+                "explain_degraded": self._explain_degraded,
+            }
             return {
+                **explain,
                 "requests": self._n_req,
                 "ok": self._n_ok,
                 "deadline_missed": self._n_deadline,
@@ -611,6 +1001,8 @@ class PredictorSession:
         if not self._closed:
             self._closed = True
             self._batcher.close()
+            if self._explain is not None:
+                self._explain[3].close()
             if obs.enabled():
                 obs.event("serve_stop", **{k: v for k, v in
                                            self.stats().items()

@@ -107,6 +107,7 @@ _SLOW = {
     "test_hist_fused.py::test_fused_packed_differential[categorical_bitset-23]",
     "test_hist_fused.py::test_mesh_data_parallel_packed_matches_single",
     "test_hist_fused.py::test_packed_capacity_cuts_waves",
+    "test_explain.py::test_oracle_matches_brute_force_categorical_nan",
     "test_robust.py::test_resume_bit_identical_dart",
     "test_robust.py::test_resume_bit_identical_two_device_mesh",
     "test_robust.py::test_sigterm_checkpoints_and_resumes",

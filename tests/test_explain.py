@@ -1,0 +1,579 @@
+"""explain/ — batched device TreeSHAP + explanation serving.
+
+Three layers of pinning:
+
+1. the host oracle (``core/shap.py``) against brute-force Shapley values
+   computed from the path-dependent conditional expectation (the
+   reference's semantics, tree.cpp:609-716) — categorical-bitset splits,
+   NaN/default-left routing and single-leaf stumps included;
+2. the device kernel (``explain/kernel.py``) against that oracle to 1e-5
+   on dense, NaN, categorical, multiclass and file-loaded fixtures, plus
+   the SHAP local-accuracy identity (contributions sum to the raw
+   score);
+3. the serving surface: ``PredictorSession.explain``/``submit_explain``
+   and ``POST /explain`` under a concurrent mixed ``/predict`` load,
+   with the explain bucket family's compile count bounded by
+   ceil(log2(explain_max_batch)) + 1.
+"""
+import itertools
+import json
+import math
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import obs
+from lightgbm_tpu.core.shap import _expected_value, predict_contrib
+from lightgbm_tpu.serve import PredictorSession, PredictServer
+
+
+def _nan_matrix(rng, n, f_num, f_cat=0, cat_lo=-1, cat_hi=15):
+    X = rng.normal(size=(n, f_num))
+    X[rng.random((n, f_num)) < 0.08] = np.nan
+    if f_cat:
+        X = np.hstack([X, rng.integers(cat_lo, cat_hi, size=(n, f_cat)
+                                       ).astype(np.float64)])
+    return X
+
+
+# ---------------------------------------------------------------------------
+# brute-force Shapley reference (exponential, tiny trees only)
+# ---------------------------------------------------------------------------
+
+def _cond_exp(tree, x, S, node=0):
+    """Path-dependent conditional expectation: features in S follow x's
+    decision, the rest average children by training data counts —
+    exactly the expectation TreeSHAP decomposes."""
+    if tree.num_leaves == 1:
+        return float(tree.leaf_value[0])
+    if node < 0:
+        return float(tree.leaf_value[~node])
+    f = int(tree.split_feature[node])
+    lc, rc = int(tree.left_child[node]), int(tree.right_child[node])
+    if f in S:
+        gl = bool(tree._decide(np.asarray([x[f]]), np.asarray([node]))[0])
+        return _cond_exp(tree, x, S, lc if gl else rc)
+
+    def cnt(n):
+        return float(tree.leaf_count[~n] if n < 0
+                     else tree.internal_count[n])
+    return (cnt(lc) * _cond_exp(tree, x, S, lc)
+            + cnt(rc) * _cond_exp(tree, x, S, rc)) / cnt(node)
+
+
+def _brute_shap(tree, x, F):
+    used = sorted({int(tree.split_feature[i])
+                   for i in range(max(tree.num_leaves - 1, 0))})
+    phi = np.zeros(F + 1)
+    phi[F] = _expected_value(tree)
+    U = len(used)
+    for i in used:
+        others = [f for f in used if f != i]
+        for r in range(len(others) + 1):
+            for S in itertools.combinations(others, r):
+                w = (math.factorial(len(S))
+                     * math.factorial(U - len(S) - 1) / math.factorial(U))
+                phi[i] += w * (_cond_exp(tree, x, set(S) | {i})
+                               - _cond_exp(tree, x, set(S)))
+    return phi
+
+
+def _brute_contrib(gbdt, X):
+    F = X.shape[1]
+    K = gbdt.num_tpi
+    out = np.zeros((X.shape[0], K, F + 1))
+    for i, t in enumerate(gbdt.models):
+        for r in range(X.shape[0]):
+            out[r, i % K] += _brute_shap(t, X[r], F)
+    return out.reshape(X.shape[0], K * (F + 1)) if K > 1 else out[:, 0, :]
+
+
+# ---------------------------------------------------------------------------
+# fixtures
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def binary_model(tmp_path_factory):
+    """Binary model over NaN-heavy numericals, saved + file-loaded."""
+    rng = np.random.default_rng(0)
+    X = _nan_matrix(rng, 600, 6)
+    y = (np.nan_to_num(X[:, 0]) + np.nan_to_num(X[:, 1]) > 0
+         ).astype(np.float64)
+    params = {"objective": "binary", "num_leaves": 15, "verbose": -1,
+              "min_data_in_leaf": 5}
+    bst = lgb.train(params, lgb.Dataset(X, label=y, params=params),
+                    num_boost_round=10)
+    path = str(tmp_path_factory.mktemp("explain") / "binary.txt")
+    bst.save_model(path)
+    return bst, path
+
+
+@pytest.fixture(scope="module")
+def multiclass_model(tmp_path_factory):
+    """Multiclass model with categorical features, saved + file-loaded."""
+    rng = np.random.default_rng(1)
+    X = _nan_matrix(rng, 600, 4, f_cat=2, cat_lo=0, cat_hi=12)
+    y = ((np.nan_to_num(X[:, 0]) > 0).astype(int)
+         + (X[:, 4] > 5).astype(int)).astype(np.float64)
+    params = {"objective": "multiclass", "num_class": 3, "num_leaves": 15,
+              "verbose": -1, "min_data_in_leaf": 5}
+    ds = lgb.Dataset(X, label=y, categorical_feature=[4, 5], params=params)
+    bst = lgb.train(params, ds, num_boost_round=6)
+    path = str(tmp_path_factory.mktemp("explain") / "multi.txt")
+    bst.save_model(path)
+    return bst, path
+
+
+def _device_contrib(gbdt, X, num_iteration=None, start_iteration=0):
+    """The device path, unconditionally (bypasses the work heuristic)."""
+    start, stop = gbdt._iter_window(num_iteration, start_iteration)
+    return gbdt._predict_contrib_device(
+        np.ascontiguousarray(X, np.float64), start, stop)
+
+
+# ---------------------------------------------------------------------------
+# 1. host-oracle hardening: brute-force Shapley on the reference
+#    semantics (categorical bitsets, NaN routing, stumps)
+# ---------------------------------------------------------------------------
+
+def test_oracle_matches_brute_force_categorical_nan():
+    rng = np.random.default_rng(7)
+    X = rng.normal(size=(500, 3))
+    X[rng.random(X.shape) < 0.15] = np.nan
+    X = np.hstack([X, rng.integers(0, 9, size=(500, 1)).astype(float)])
+    y = (np.nan_to_num(X[:, 0]) + (X[:, 3] % 2) > 0.5).astype(float)
+    p = {"objective": "binary", "num_leaves": 8, "verbose": -1,
+         "min_data_in_leaf": 10}
+    bst = lgb.train(p, lgb.Dataset(X, label=y, categorical_feature=[3],
+                                   params=p), num_boost_round=4)
+    Xt = rng.normal(size=(8, 4))
+    Xt[:, 3] = rng.integers(-1, 12, size=8)  # unseen + negative cats
+    Xt[0, 0] = np.nan
+    Xt[1, 1] = np.nan
+    got = predict_contrib(bst._gbdt, Xt)
+    want = _brute_contrib(bst._gbdt, Xt)
+    np.testing.assert_allclose(got, want, rtol=0, atol=1e-9)
+
+
+def test_oracle_matches_brute_force_nan_default_left():
+    rng = np.random.default_rng(8)
+    X = rng.normal(size=(800, 3))
+    X[rng.random(X.shape) < 0.3] = np.nan
+    # NaN predictive of the label forces default-left AND default-right
+    # nodes into the same forest
+    y = np.where(np.isnan(X[:, 0]), 1.0, (X[:, 0] > 0).astype(float))
+    p = {"objective": "regression", "num_leaves": 8, "verbose": -1}
+    bst = lgb.train(p, lgb.Dataset(X, label=y, params=p),
+                    num_boost_round=3)
+    Xt = rng.normal(size=(6, 3))
+    Xt[rng.random(Xt.shape) < 0.4] = np.nan
+    got = predict_contrib(bst._gbdt, Xt)
+    want = _brute_contrib(bst._gbdt, Xt)
+    np.testing.assert_allclose(got, want, rtol=0, atol=1e-9)
+
+
+def test_oracle_stump_expected_value_only():
+    """A single-leaf tree contributes ONLY to the expected-value column
+    (reference: PredictContrib skips trees with one leaf)."""
+    rng = np.random.default_rng(9)
+    X = rng.normal(size=(100, 2))
+    p = {"objective": "regression", "num_leaves": 2, "verbose": -1,
+         "min_gain_to_split": 1e9}  # no split ever clears the bar
+    bst = lgb.train(p, lgb.Dataset(X, label=np.full(100, 1.5), params=p),
+                    num_boost_round=2)
+    assert all(t.num_leaves == 1 for t in bst._gbdt.models)
+    got = predict_contrib(bst._gbdt, X[:5])
+    want = _brute_contrib(bst._gbdt, X[:5])
+    np.testing.assert_allclose(got, want, rtol=0, atol=1e-12)
+    assert np.all(got[:, :2] == 0.0)
+    np.testing.assert_allclose(got[:, 2], bst.predict(X[:5]),
+                               rtol=0, atol=1e-12)
+
+
+# ---------------------------------------------------------------------------
+# 2. device kernel vs host oracle + local accuracy
+# ---------------------------------------------------------------------------
+
+def _check_parity_and_local_accuracy(bst, gbdt, Xt, atol=1e-5):
+    want = predict_contrib(gbdt, Xt)
+    got = _device_contrib(gbdt, Xt)
+    np.testing.assert_allclose(got, want, rtol=0, atol=atol)
+    # SHAP local accuracy: per-class contributions sum to the raw score
+    K = gbdt.num_tpi
+    raw = bst.predict(Xt, raw_score=True)
+    s = np.asarray(got).reshape(Xt.shape[0], K, -1).sum(axis=2)
+    np.testing.assert_allclose(s[:, 0] if K == 1 else s, raw,
+                               rtol=0, atol=atol)
+
+
+def test_device_matches_oracle_binary_nan(binary_model):
+    bst, _ = binary_model
+    rng = np.random.default_rng(2)
+    _check_parity_and_local_accuracy(bst, bst._gbdt,
+                                     _nan_matrix(rng, 80, 6))
+
+
+def test_device_matches_oracle_multiclass_categorical(multiclass_model):
+    bst, _ = multiclass_model
+    rng = np.random.default_rng(3)
+    # unseen + negative categories exercise the sentinel routing
+    Xt = _nan_matrix(rng, 60, 4, f_cat=2, cat_lo=-2, cat_hi=20)
+    _check_parity_and_local_accuracy(bst, bst._gbdt, Xt)
+    got = _device_contrib(bst._gbdt, Xt)
+    assert got.shape == (60, 3 * 7)  # [n, K*(F+1)]
+
+
+def test_device_matches_oracle_deep_duplicate_features():
+    """Few features + deep trees: every path revisits features, so the
+    pack-time slot merging is load-bearing."""
+    rng = np.random.default_rng(4)
+    X = rng.normal(size=(1500, 3))
+    y = np.sin(X[:, 0] * 3) + np.cos(X[:, 1] * 2) * X[:, 2]
+    p = {"objective": "regression", "num_leaves": 63, "verbose": -1,
+         "min_data_in_leaf": 3}
+    bst = lgb.train(p, lgb.Dataset(X, label=y, params=p),
+                    num_boost_round=8)
+    _check_parity_and_local_accuracy(bst, bst._gbdt,
+                                     rng.normal(size=(30, 3)))
+
+
+def test_device_matches_oracle_file_loaded_no_train_ds(multiclass_model):
+    """Counts come from model.txt (internal_count=/leaf_count= lines),
+    no training state at all."""
+    _, path = multiclass_model
+    rng = np.random.default_rng(5)
+    Xt = _nan_matrix(rng, 40, 4, f_cat=2, cat_lo=-1, cat_hi=16)
+    b2 = lgb.Booster(model_file=path)
+    assert b2._gbdt.train_ds is None
+    _check_parity_and_local_accuracy(b2, b2._gbdt, Xt)
+
+
+def test_device_iteration_windows(binary_model):
+    bst, _ = binary_model
+    g = bst._gbdt
+    rng = np.random.default_rng(6)
+    Xt = _nan_matrix(rng, 12, 6)
+    for ni, si in ((4, 0), (5, 3), (None, 7)):
+        want = predict_contrib(g, Xt, ni, si)
+        got = _device_contrib(g, Xt, ni, si)
+        np.testing.assert_allclose(got, want, rtol=0, atol=1e-5)
+
+
+def test_predict_contrib_surface_routes_device(binary_model, monkeypatch):
+    """Booster.predict(pred_contrib=True) rides the device kernel when
+    the work heuristic says so (forced here), host oracle otherwise."""
+    bst, _ = binary_model
+    rng = np.random.default_rng(10)
+    Xt = _nan_matrix(rng, 25, 6)
+    want = predict_contrib(bst._gbdt, Xt)
+    monkeypatch.setenv("LGBM_TPU_CONTRIB_MIN_WORK", "0")
+    got = bst.predict(Xt, pred_contrib=True)
+    np.testing.assert_allclose(got, want, rtol=0, atol=1e-5)
+    # a sky-high threshold keeps small inputs on the host oracle exactly
+    monkeypatch.setenv("LGBM_TPU_CONTRIB_MIN_WORK", str(10**12))
+    host = bst.predict(Xt, pred_contrib=True)
+    np.testing.assert_allclose(host, want, rtol=0, atol=0)
+
+
+def test_explain_requires_cover_counts():
+    """A tree dict without counts cannot be packed for TreeSHAP — the
+    pack raises instead of emitting garbage fractions."""
+    from lightgbm_tpu.explain import tree_path_arrays
+    t = dict(num_leaves=2, split_feature=np.zeros(1, np.int32),
+             left_child=np.asarray([-1], np.int32),
+             right_child=np.asarray([-2], np.int32),
+             leaf_value=np.asarray([0.5, -0.5], np.float32),
+             internal_count=np.zeros(1, np.int32),
+             leaf_count=np.zeros(2, np.int32))
+    with pytest.raises(ValueError, match="cover counts"):
+        tree_path_arrays(t, 3)
+
+
+# ---------------------------------------------------------------------------
+# 3. serving: session explain, HTTP /explain, buckets, metrics
+# ---------------------------------------------------------------------------
+
+def test_session_explain_sync_async_parity(binary_model):
+    _, path = binary_model
+    rng = np.random.default_rng(11)
+    Xt = _nan_matrix(rng, 37, 6)
+    want = predict_contrib(lgb.Booster(model_file=path)._gbdt, Xt)
+    with PredictorSession(path, max_batch=64) as sess:
+        got = sess.explain(Xt)
+        np.testing.assert_allclose(got, want, rtol=0, atol=1e-5)
+        ticket = sess.submit_explain(Xt)
+        got2 = sess.result(ticket, timeout=60)
+        np.testing.assert_allclose(got2, want, rtol=0, atol=1e-5)
+        # local accuracy against the session's own raw predictions
+        raw = sess.predict(Xt, raw_score=True)
+        np.testing.assert_allclose(got.sum(axis=1), raw, rtol=0,
+                                   atol=1e-5)
+        st = sess.stats()
+    assert st["explain_armed"] is True
+    assert st["explain_requests"] == 2
+    assert st["explain_p50_ms"] is not None
+    # every explain batch padded to a pow2 bucket of ITS OWN family
+    assert all(b & (b - 1) == 0 for b in st["explain_buckets"])
+
+
+def test_explain_lazy_packing(binary_model):
+    """A predict-only session never packs the path metadata (the HBM
+    cost gate); the first explain arms it."""
+    _, path = binary_model
+    rng = np.random.default_rng(12)
+    Xt = _nan_matrix(rng, 10, 6)
+    with PredictorSession(path, max_batch=32) as sess:
+        sess.predict(Xt)
+        assert sess.stats()["explain_armed"] is False
+        sess.explain(Xt)
+        assert sess.stats()["explain_armed"] is True
+
+
+def test_explain_disabled(binary_model):
+    _, path = binary_model
+    cfg = {"tpu_explain": False, "objective": "binary"}
+    with PredictorSession(path, config=cfg) as sess:
+        with pytest.raises(RuntimeError, match="disabled"):
+            sess.explain(np.zeros((2, 6)))
+        with PredictServer(sess) as server:
+            code, body = _post(server.url + "/explain",
+                               {"rows": [[0.0] * 6]})
+            assert code == 404 and body["error"] == "explain_disabled"
+
+
+def _post(url, payload, timeout=60):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as exc:
+        return exc.code, json.loads(exc.read())
+
+
+def test_http_explain_concurrent_mixed_load_bounded_compiles(
+        multiclass_model, tmp_path):
+    """Concurrent /explain + /predict traffic: parity end to end, the
+    explain bucket family bounded by ceil(log2(explain_max_batch))+1
+    compiles, and both planes visible in /metrics + the digest."""
+    _, path = multiclass_model
+    obs.enable(str(tmp_path / "telem"))
+    try:
+        x_max = 16
+        cfg = {"objective": "multiclass", "num_class": 3,
+               "tpu_explain_max_batch": x_max,
+               "tpu_explain_max_wait_ms": 1.0}
+        sess = PredictorSession(path, config=cfg, max_batch=32,
+                                max_wait_ms=1.0)
+        host = lgb.Booster(model_file=path)
+        compiles0 = obs.counter_value("jax/compiles")
+        errs = []
+
+        def client(seed):
+            rng = np.random.default_rng(seed)
+            with_explain = seed % 2 == 0
+            for i in range(3):
+                n = int(rng.integers(1, 24))
+                Xi = _nan_matrix(rng, n, 4, f_cat=2, cat_lo=-1, cat_hi=16)
+                path_ = ("/explain" if with_explain and i % 2 == 0
+                         else "/predict")
+                code, body = _post(server.url + path_, {"rows": Xi.tolist()})
+                if code != 200:
+                    errs.append((path_, code, body))
+                    continue
+                if path_ == "/explain":
+                    got = np.asarray(body["contributions"])
+                    want = predict_contrib(host._gbdt, Xi)
+                    if body["num_features"] != 6 or body["num_class"] != 3:
+                        errs.append(("shape-meta", body))
+                else:
+                    got = np.asarray(body["predictions"])
+                    want = host.predict(Xi)
+                d = float(np.abs(got - want).max())
+                if d > 1e-5:
+                    errs.append((path_, d))
+
+        with PredictServer(sess) as server:
+            threads = [threading.Thread(target=client, args=(s,))
+                       for s in range(4)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            with urllib.request.urlopen(server.url + "/metrics",
+                                        timeout=30) as resp:
+                metrics = resp.read().decode()
+            with urllib.request.urlopen(server.url + "/health",
+                                        timeout=30) as resp:
+                health = json.loads(resp.read())
+        st = sess.stats()
+        sess.close()
+        compiles = obs.counter_value("jax/compiles") - compiles0
+        assert not errs, errs
+        # both bucket families stay inside their own pow2 budgets, and
+        # the total compile count inside the summed bound
+        x_bound = math.ceil(math.log2(x_max)) + 1
+        p_bound = math.ceil(math.log2(32)) + 1
+        assert len(st["explain_buckets"]) <= x_bound, st["explain_buckets"]
+        assert len(st["buckets"]) <= p_bound
+        assert compiles <= x_bound + p_bound
+        assert st["explain_requests"] >= 2 and st["explain_ok"] >= 2
+        assert st["explain_occupancy"] is None or \
+            0 < st["explain_occupancy"] <= 1
+        # the explain plane is on the wire: Prometheus + health
+        assert 'tpu_serve_explain_requests_total{outcome="ok"}' in metrics
+        assert "tpu_serve_explain_latency_ms_bucket" in metrics
+        assert health["explain_armed"] is True
+        # and in the telemetry digest
+        from lightgbm_tpu.obs.report import (load_events, render,
+                                             serve_summary, summarize,
+                                             validate_events)
+        events = load_events(str(tmp_path / "telem"))
+        assert not validate_events(events)
+        digest = serve_summary(events)
+        assert digest["explain"]["requests"] >= 2
+        assert digest["explain"]["p99_ms"] is not None
+        assert "explain:" in render(summarize(events))
+    finally:
+        obs.disable()
+
+
+def test_explain_warmup_precompiles_bucket_family(binary_model):
+    _, path = binary_model
+    cfg = {"objective": "binary", "tpu_explain_max_batch": 8}
+    with PredictorSession(path, config=cfg, max_batch=16) as sess:
+        n = sess.warmup_explain()
+        st = sess.stats()
+    assert n == math.ceil(math.log2(8)) + 1
+    assert st["explain_buckets"] == [1, 2, 4, 8]
+
+
+def test_explain_degrades_to_host_oracle(binary_model, monkeypatch,
+                                         tmp_path):
+    """A device fault mid-explain falls back to the host recursion —
+    requests keep succeeding with identical results."""
+    _, path = binary_model
+    monkeypatch.setenv("LGBM_TPU_FLIGHT_DIR", str(tmp_path))
+    rng = np.random.default_rng(13)
+    Xt = _nan_matrix(rng, 20, 6)
+    want = predict_contrib(lgb.Booster(model_file=path)._gbdt, Xt)
+    sess = PredictorSession(path, max_batch=32)
+
+    def boom(bins, span_ctx=None):
+        raise RuntimeError("device backend died mid-flight")
+
+    monkeypatch.setattr(sess, "_run_device_explain", boom)
+    got = sess.explain(Xt)                       # sync path degrades
+    np.testing.assert_allclose(got, want, rtol=0, atol=1e-10)
+    ticket = sess.submit_explain(Xt)             # async path follows
+    got2 = sess.result(ticket, timeout=60)
+    np.testing.assert_allclose(got2, want, rtol=0, atol=1e-10)
+    # predict stays on the device: an explain-kernel failure must not
+    # degrade the predict plane (its working set is much smaller)
+    ref_pred = lgb.Booster(model_file=path).predict(Xt)
+    np.testing.assert_allclose(sess.predict(Xt), ref_pred, atol=1e-6)
+    st = sess.stats()
+    sess.close()
+    assert st["explain_degraded"] is True
+    assert st["degraded"] is False
+
+
+def test_explain_reprobe_recovers_explain_plane_only(binary_model,
+                                                     monkeypatch,
+                                                     tmp_path):
+    """The explain reprobe runs the TreeSHAP kernel itself — a healthy
+    predict path never re-arms a still-broken explain kernel, and a
+    recovered kernel resumes device explanations."""
+    _, path = binary_model
+    monkeypatch.setenv("LGBM_TPU_FLIGHT_DIR", str(tmp_path))
+    rng = np.random.default_rng(14)
+    Xt = _nan_matrix(rng, 12, 6)
+    want = predict_contrib(lgb.Booster(model_file=path)._gbdt, Xt)
+    sess = PredictorSession(path, config={"objective": "binary",
+                                          "tpu_serve_reprobe_s": 0.05},
+                            max_batch=32)
+    real = sess._run_device_explain
+    boom = {"left": 2}
+
+    def flaky(bins, span_ctx=None):
+        if boom["left"] > 0:
+            boom["left"] -= 1
+            raise RuntimeError("treeshap kernel OOM")
+        return real(bins, span_ctx=span_ctx)
+
+    monkeypatch.setattr(sess, "_run_device_explain", flaky)
+    np.testing.assert_allclose(sess.explain(Xt), want, atol=1e-5)
+    assert sess.stats()["explain_degraded"] is True
+    time.sleep(0.06)
+    # first call after the interval probes (fails: boom still armed),
+    # stays on the host oracle, and does NOT flip the predict plane
+    np.testing.assert_allclose(sess.explain(Xt), want, atol=1e-5)
+    assert sess.stats()["explain_degraded"] is True
+    assert sess.stats()["degraded"] is False
+    time.sleep(0.06)
+    np.testing.assert_allclose(sess.explain(Xt), want, atol=1e-5)
+    st = sess.stats()
+    sess.close()
+    assert st["explain_degraded"] is False
+
+
+# ---------------------------------------------------------------------------
+# 4. event schemas + cost model
+# ---------------------------------------------------------------------------
+
+def test_explain_event_schemas():
+    from lightgbm_tpu.obs.report import validate_events
+    good = [{"event": "explain_request", "rows": 3, "total_ms": 1.2,
+             "ok": True},
+            {"event": "explain_batch", "rows": 3, "padded": 4,
+             "requests": 1, "queue_rows": 0, "exec_ms": 0.9,
+             "degraded": False}]
+    assert validate_events(good) == []
+    bad = [{"event": "explain_request", "rows": "three", "ok": True}]
+    problems = validate_events(bad)
+    assert any("rows" in p for p in problems)
+
+
+def test_stack_forest_with_counts_roundtrip(binary_model):
+    """The flag-gated count plumbing (`stack_forest(with_counts=True)` /
+    `ServeBinSpace.pack(with_counts=True)`): cover counts ride
+    `ForestArrays` only when asked — the serve/contrib paths fold them
+    into `ExplainArrays` host-side and stack count-free, so this is the
+    API for future device-side cover consumers (e.g. interaction
+    values), and predict-only forests never pay the [T, M] HBM cost."""
+    from lightgbm_tpu.core.forest import stack_forest
+    _, path = binary_model
+    with PredictorSession(path) as sess:
+        space, trees = sess.space, sess._trees
+        dicts = [space.tree_arrays_np(t, with_counts=True) for t in trees]
+        cls = np.zeros(len(trees), np.int32)
+        fa = stack_forest(dicts, cls, min_words=space.min_words,
+                          with_counts=True)
+        assert fa.internal_count is not None and fa.leaf_count is not None
+        for i, d in enumerate(dicts):
+            m = d["internal_count"].shape[0]
+            np.testing.assert_array_equal(
+                np.asarray(fa.internal_count)[i, :m], d["internal_count"])
+            n = d["leaf_count"].shape[0]
+            np.testing.assert_array_equal(
+                np.asarray(fa.leaf_count)[i, :n], d["leaf_count"])
+        packed = space.pack(trees, cls, with_counts=True)
+        assert packed.internal_count is not None
+        # the predict forest stays count-free by default
+        assert sess.forest.internal_count is None
+        assert sess.forest.leaf_count is None
+
+
+def test_shap_cost_model_scales():
+    from lightgbm_tpu.ops.treeshap import shap_cost
+    f1, b1 = shap_cost(N=64, T=10, L=31, P=8, F=12)
+    f2, b2 = shap_cost(N=128, T=10, L=31, P=8, F=12)
+    assert f2 == pytest.approx(2 * f1, rel=0.05)  # linear in rows
+    f4, _ = shap_cost(N=64, T=10, L=31, P=16, F=12)
+    assert f4 > 3.5 * f1                          # ~quadratic in depth
+    assert f1 > 0 and b1 > 0 and b2 > b1

@@ -538,7 +538,9 @@ def test_tpu_window_checklist_stubbed(tmp_path):
                              "trees": 20, "max_batch": 128,
                              "closed": {"rows_per_s": 9000.0,
                                         "p99_ms": 12.0},
-                             "open": {"p99_ms": 15.0},
+                             "open": {"p99_ms": 15.0,
+                                      "explain_frac": 0.5,
+                                      "explain_p99_ms": 48.0},
                              "occupancy": 0.7, "compiles": 8,
                              "degraded": False})
     fake = _FakeRun({
@@ -556,7 +558,8 @@ def test_tpu_window_checklist_stubbed(tmp_path):
     assert rec["parsed"]["health_failures"] == 0
     assert set(rec["legs"]) == {"bench", "bench_profile",
                                 "bench_maxbin63", "bench_unfused",
-                                "prof_kernels", "bench_serve", "trace"}
+                                "prof_kernels", "bench_serve",
+                                "bench_explain", "trace"}
     assert all(leg["rc"] == 0 for leg in rec["legs"].values())
     # bench legs ran four times (clean, profile, maxbin63, unfused)
     bench_calls = [c for c in fake.calls if any("bench.py" in a
@@ -575,6 +578,11 @@ def test_tpu_window_checklist_stubbed(tmp_path):
     assert srows[0]["context"][0] == "serve"
     assert srows[0]["metrics"]["serve_rows_per_s"] == 9000.0
     assert srows[0]["metrics"]["serve_p99_ms"] == 12.0
+    # the explain-heavy leg landed as its own artifact, and the mixed
+    # leg's TreeSHAP p99 trends through bench_history
+    assert (tmp_path / "SERVE_explain_manual_r07.json").exists()
+    xrows = bh.collect([str(tmp_path / "SERVE_explain_manual_r07.json")])
+    assert xrows[0]["metrics"]["serve_explain_p99_ms"] == 48.0
 
 
 def test_tpu_window_dry_run_end_to_end(tmp_path):

@@ -77,6 +77,7 @@ _DIRECTIONS = [
     ("serve_p50_ms", False),
     ("serve_p99_ms", False),
     ("serve_open_p99_ms", False),
+    ("serve_explain_p99_ms", False),
     ("serve_occupancy", True),
     ("serve_server_p99_ms", False),
     ("serve_slo_burn", False),
@@ -141,6 +142,10 @@ def load_round(path: str) -> dict:
                         ("serve_p50_ms", closed.get("p50_ms")),
                         ("serve_p99_ms", closed.get("p99_ms")),
                         ("serve_open_p99_ms", opened.get("p99_ms")),
+                        # mixed-load TreeSHAP leg (bench_serve.py
+                        # --explain-frac): client-observed explain p99
+                        ("serve_explain_p99_ms",
+                         opened.get("explain_p99_ms")),
                         ("serve_occupancy", parsed.get("occupancy")),
                         ("serve_server_p99_ms", server.get("p99_ms")),
                         ("serve_slo_burn", server.get("slo_burn")),

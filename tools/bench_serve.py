@@ -8,7 +8,11 @@ answers "how does it serve" — the serve/ subsystem's round artifact:
    fixed duration — the saturation number (rows/s, request p50/p99).
 2. **open-loop**: requests arrive on a Poisson clock at a fixed rate
    with mixed sizes, so latency includes real queueing delay instead of
-   the closed-loop's self-throttling — the SLO number.
+   the closed-loop's self-throttling — the SLO number.  With
+   ``--explain-frac p`` (or SERVE_EXPLAIN_FRAC) a fraction ``p`` of the
+   Poisson arrivals are ``submit_explain`` TreeSHAP requests riding
+   their own microbatch queue — the mixed-load leg that writes
+   ``explain_p99`` into the artifact.
 3. **HTTP smoke** (``--smoke``): starts ``PredictServer`` in-process,
    fires concurrent mixed-size POST /predict + GET /health, then
    asserts p99 recorded, the compile count bounded by the pow2 bucket
@@ -24,8 +28,10 @@ trajectory table.  CPU-runnable end to end; on a TPU window
 Env knobs (smoke sizes in parens): SERVE_ROWS train rows (2000),
 SERVE_TREES boosting rounds (20), SERVE_FEATURES (8), SERVE_MAX_BATCH
 (256), SERVE_CLIENTS closed-loop threads (4), SERVE_DURATION_S per-loop
-seconds (2), SERVE_RATE open-loop req/s (50), SERVE_MODEL serve an
-existing model file instead of training one.
+seconds (2), SERVE_RATE open-loop req/s (50), SERVE_EXPLAIN_FRAC
+fraction of open-loop arrivals that are /explain requests (0.2 smoke,
+0.1 full), SERVE_MODEL serve an existing model file instead of training
+one.
 """
 from __future__ import annotations
 
@@ -44,9 +50,10 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 _DEFAULTS = dict(rows=20000, trees=60, features=12, max_batch=1024,
-                 clients=8, duration_s=5.0, rate=200.0)
+                 clients=8, duration_s=5.0, rate=200.0,
+                 explain_frac=0.1)
 _SMOKE = dict(rows=2000, trees=20, features=8, max_batch=256,
-              clients=4, duration_s=2.0, rate=50.0)
+              clients=4, duration_s=2.0, rate=50.0, explain_frac=0.2)
 
 
 def _env(name, cast, fallback):
@@ -69,6 +76,8 @@ def knobs(smoke: bool) -> dict:
         clients=_env("SERVE_CLIENTS", int, base["clients"]),
         duration_s=_env("SERVE_DURATION_S", float, base["duration_s"]),
         rate=_env("SERVE_RATE", float, base["rate"]),
+        explain_frac=_env("SERVE_EXPLAIN_FRAC", float,
+                          base["explain_frac"]),
         model=os.environ.get("SERVE_MODEL", ""),
     )
 
@@ -157,39 +166,55 @@ def closed_loop(sess, Xpool, k: dict) -> dict:
 
 def open_loop(sess, Xpool, k: dict) -> dict:
     """Poisson arrivals at SERVE_RATE req/s; latency measured from the
-    scheduled submit to future completion, so queueing delay counts."""
+    scheduled submit to future completion, so queueing delay counts.
+    A fraction ``explain_frac`` of the arrivals are ``submit_explain``
+    TreeSHAP requests riding their own microbatch queue — the mixed
+    load that makes ``explain_p99`` an under-contention number instead
+    of an idle-path one."""
     import numpy as np
     rng = np.random.default_rng(11)
     lat, overloads, failures = [], [0], [0]
+    xlat, xfailures = [], [0]
     lock = threading.Lock()
     pending = []
     stop_at = time.perf_counter() + k["duration_s"]
     from lightgbm_tpu.serve import ServeOverloadError
+    xfrac = (min(max(k.get("explain_frac", 0.0), 0.0), 1.0)
+             if getattr(sess, "explain_enabled", False) else 0.0)
 
-    def on_done(t0):
+    def on_done(t0, sink, fail):
         def cb(fut):
             with lock:
                 if fut.exception() is None:
-                    lat.append((time.perf_counter() - t0) * 1e3)
+                    sink.append((time.perf_counter() - t0) * 1e3)
                 else:
-                    failures[0] += 1
+                    fail[0] += 1
         return cb
 
-    n_sent = 0
+    n_sent, x_sent = 0, 0
     while time.perf_counter() < stop_at:
         gap = rng.exponential(1.0 / max(k["rate"], 1e-6))
         time.sleep(gap)
+        explain = rng.random() < xfrac
         n = _request_sizes(rng, k["max_batch"])
         lo = int(rng.integers(0, max(Xpool.shape[0] - n, 1)))
         t0 = time.perf_counter()
         try:
-            ticket = sess.submit(Xpool[lo:lo + n])
+            if explain:
+                ticket = sess.submit_explain(Xpool[lo:lo + n])
+            else:
+                ticket = sess.submit(Xpool[lo:lo + n])
         except ServeOverloadError:
             overloads[0] += 1
             continue
-        n_sent += 1
+        if explain:
+            x_sent += 1
+            cb = on_done(t0, xlat, xfailures)
+        else:
+            n_sent += 1
+            cb = on_done(t0, lat, failures)
         for fut, _ in ticket.parts:
-            fut.add_done_callback(on_done(t0))
+            fut.add_done_callback(cb)
             pending.append(fut)
     deadline = time.time() + 30
     for fut in pending:
@@ -198,9 +223,16 @@ def open_loop(sess, Xpool, k: dict) -> dict:
         except Exception:  # noqa: BLE001 — on_done already counted it;
             pass           # a failed request must not kill the bench
     p50, p99 = _percentiles(lat)
-    return {"rate_rps": k["rate"], "requests": n_sent,
-            "completed": len(lat), "overloads": overloads[0],
-            "failures": failures[0], "p50_ms": p50, "p99_ms": p99}
+    out = {"rate_rps": k["rate"], "requests": n_sent,
+           "completed": len(lat), "overloads": overloads[0],
+           "failures": failures[0], "p50_ms": p50, "p99_ms": p99,
+           "explain_frac": xfrac}
+    if xfrac > 0:
+        xp50, xp99 = _percentiles(xlat)
+        out.update(explain_requests=x_sent, explain_completed=len(xlat),
+                   explain_failures=xfailures[0],
+                   explain_p50_ms=xp50, explain_p99_ms=xp99)
+    return out
 
 
 def http_smoke(server, Xpool, k: dict) -> dict:
@@ -213,29 +245,37 @@ def http_smoke(server, Xpool, k: dict) -> dict:
     import numpy as np
     url = server.url
     lat, errors = [], []
-    poll = {"metrics": 0, "flight": 0, "errors": []}
+    poll = {"metrics": 0, "flight": 0, "explain": 0, "errors": []}
     done = threading.Event()
     lock = threading.Lock()
+
+    xfrac = (min(max(k.get("explain_frac", 0.0), 0.0), 1.0)
+             if getattr(server.session, "explain_enabled", False) else 0.0)
 
     def post(seed):
         rng = np.random.default_rng(seed)
         for _ in range(4):
+            explain = rng.random() < xfrac
             n = _request_sizes(rng, k["max_batch"])
             lo = int(rng.integers(0, max(Xpool.shape[0] - n, 1)))
             body = json.dumps(
                 {"rows": Xpool[lo:lo + n].tolist()}).encode()
+            path = "/explain" if explain else "/predict"
             req = urllib.request.Request(
-                url + "/predict", data=body,
+                url + path, data=body,
                 headers={"Content-Type": "application/json",
                          "X-Request-Id": f"smoke-{seed}-{n}"})
             t0 = time.perf_counter()
             try:
                 with urllib.request.urlopen(req, timeout=60) as resp:
                     payload = json.loads(resp.read())
-                if len(payload["predictions"]) != n:
+                field = "contributions" if explain else "predictions"
+                if len(payload[field]) != n:
                     raise ValueError("row count mismatch")
                 with lock:
                     lat.append((time.perf_counter() - t0) * 1e3)
+                    if explain:
+                        poll["explain"] += 1
             except Exception as exc:  # noqa: BLE001
                 with lock:
                     errors.append(f"{type(exc).__name__}: {exc}")
@@ -273,6 +313,7 @@ def http_smoke(server, Xpool, k: dict) -> dict:
     p50, p99 = _percentiles(lat)
     return {"requests": len(lat), "errors": errors[:5],
             "p50_ms": p50, "p99_ms": p99, "health": health,
+            "explain_requests": poll["explain"],
             "metrics_polls": poll["metrics"],
             "flight_polls": poll["flight"],
             "poll_errors": poll["errors"][:5]}
@@ -307,8 +348,15 @@ def main(argv=None) -> int:
                     help="artifact directory (default: repo root)")
     ap.add_argument("--round", type=int, default=0,
                     help="round number (default: next free SERVE_rN)")
+    ap.add_argument("--explain-frac", type=float, default=None,
+                    help="fraction of open-loop arrivals that are "
+                         "/explain TreeSHAP requests (default: "
+                         "SERVE_EXPLAIN_FRAC or 0.1 full / 0.2 smoke; "
+                         "0 disables the mixed leg)")
     args = ap.parse_args(argv)
     k = knobs(args.smoke)
+    if args.explain_frac is not None:
+        k["explain_frac"] = args.explain_frac
 
     import numpy as np
 
@@ -333,6 +381,10 @@ def main(argv=None) -> int:
         sess = PredictorSession(model_path, max_batch=k["max_batch"],
                                 max_wait_ms=2.0)
         sess.warmup()
+        if k["explain_frac"] > 0 and sess.explain_enabled:
+            # pre-compile the explain bucket family too, so the mixed
+            # leg's explain_p99 measures serving, not XLA compilation
+            sess.warmup_explain()
         record = {
             "kind": "serve", "t": round(time.time(), 1),
             "backend": jax.default_backend(),
@@ -375,11 +427,26 @@ def main(argv=None) -> int:
                            "events": obs.flight_snapshot()},
                           fh, indent=1, default=str)
             record["flight_out"] = flight_out
+        if st.get("explain_armed"):
+            # the server-side TreeSHAP view beside the client-observed
+            # explain_p99 (bench_history.py trends both)
+            record["explain"] = {
+                f: st.get(f) for f in
+                ("explain_requests", "explain_ok", "explain_batches",
+                 "explain_rows", "explain_occupancy", "explain_p50_ms",
+                 "explain_p99_ms", "explain_buckets",
+                 "explain_max_batch")}
+            record["explain"]["compile_bound"] = int(
+                math.ceil(math.log2(max(sess.explain_max_batch, 2)))) + 1
         sess.close()
         record["compiles"] = int(obs.counter_value("jax/compiles")
                                  - compiles0)
+        # two independent pow2 bucket families, each with its own
+        # compile budget: predict's and (when armed) explain's
         record["compile_bound"] = int(
             math.ceil(math.log2(max(sess.max_batch, 2)))) + 1
+        if "explain" in record:
+            record["compile_bound"] += record["explain"]["compile_bound"]
         record["occupancy"] = st["occupancy"]
         record["buckets"] = st["buckets"]
         record["degraded"] = st["degraded"]
@@ -410,6 +477,19 @@ def main(argv=None) -> int:
             "not_degraded": not record["degraded"],
             "clean_shutdown": not record["batcher_alive"],
         }
+        if record["open"].get("explain_frac", 0) > 0:
+            x = record.get("explain") or {}
+            checks.update({
+                # the mixed leg actually exercised the explain queue…
+                "explain_served":
+                    record["open"].get("explain_completed", 0) > 0,
+                "explain_no_failures":
+                    record["open"].get("explain_failures", 0) == 0,
+                # …within its own pow2 bucket family's compile budget
+                "explain_buckets_bounded":
+                    len(x.get("explain_buckets") or [])
+                    <= x.get("compile_bound", 0),
+            })
         record["checks"] = checks
         record["ok"] = all(checks.values())
         print(json.dumps(record))
@@ -428,6 +508,8 @@ def main(argv=None) -> int:
                       "closed_rows_per_s": record["closed"]["rows_per_s"],
                       "closed_p99_ms": record["closed"]["p99_ms"],
                       "open_p99_ms": record["open"]["p99_ms"],
+                      "explain_p99_ms":
+                          record["open"].get("explain_p99_ms"),
                       "server_p99_ms": record["server"]["p99_ms"],
                       "slo_burn": record["server"]["slo_burn"],
                       "occupancy": record["occupancy"],

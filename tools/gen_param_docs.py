@@ -167,6 +167,25 @@ ENV_VARS = [
     ("LGBM_TPU_FAULTS_SEED",
      "seed for the fault harness's probabilistic conds (`p=`); the same "
      "spec + seed replays the identical fault schedule (default 0)."),
+    ("LGBM_TPU_EXPLAIN",
+     "serving-engine override for `tpu_explain` — set to `0`/`false` to "
+     "remove `POST /explain` and `PredictorSession.explain()` from a "
+     "running deployment (the endpoint answers 404, the session raises), "
+     "or `1` to force it on.  The TreeSHAP forest pack (per-node cover "
+     "counts + path metadata) is built lazily on the first explain call "
+     "either way, so predict-only sessions never pay the HBM cost."),
+    ("LGBM_TPU_EXPLAIN_MAX_BATCH",
+     "serving-engine override for `tpu_explain_max_batch` — the row cap "
+     "of the explain plane's OWN microbatcher and pow2 bucket family "
+     "(compiles at most `ceil(log2(max_batch)) + 1` TreeSHAP kernel "
+     "shapes, counted by the same recompile counter as predict's).  "
+     "Kept separate from `tpu_serve_max_batch` because one explained "
+     "row costs O(leaves x depth^2) where a predicted row costs "
+     "O(depth)."),
+    ("LGBM_TPU_EXPLAIN_MAX_WAIT_MS",
+     "serving-engine override for `tpu_explain_max_wait_ms` — the "
+     "longest the explain microbatcher holds the oldest queued request "
+     "while coalescing."),
     ("LGBM_TPU_SERVE_REPROBE_S",
      "serving-engine override for `tpu_serve_reprobe_s` — seconds "
      "between device re-probes while a session is degraded to the host "
@@ -179,6 +198,14 @@ ENV_VARS = [
      "host loop.  `0` forces every predict through the session; a huge "
      "value forces the host loop.  Unset uses the booster's built-in "
      "dispatch-overhead heuristic."),
+    ("LGBM_TPU_CONTRIB_MIN_WORK",
+     "`predict_contrib` routing override: the rows x trees work "
+     "threshold above which contribution requests go through the "
+     "batched device TreeSHAP kernel (`explain/`) instead of the host "
+     "oracle (`core/shap.py`).  `0` forces every contrib through the "
+     "device kernel; a huge value forces the host oracle.  Unset uses "
+     "the built-in threshold (50k), which keeps tiny ad-hoc calls off "
+     "the compile path."),
     ("LGBM_TPU_PEAK_FLOPS",
      "override the profile mode's device peak FLOP/s (used with "
      "`LGBM_TPU_PEAK_BW`) when the built-in per-chip table "

@@ -32,7 +32,12 @@ that ``/metrics`` and ``/debug/flight`` keep answering while the POST
 storm runs and ``/health`` carries the load-balancer signals
 (queue_rows, uptime_s, compile_count, slo_burn) — so every suite round
 re-proves the serving engine AND its introspection plane end to end on
-CPU.
+CPU.  Since ISSUE 9 the smoke pins ``--explain-frac 0.2``: a fifth of
+the open-loop Poisson arrivals are ``/explain`` TreeSHAP requests, so
+the explanation plane (its own microbatch queue + pow2 bucket family)
+is re-proved by the same round — ``explain_served``,
+``explain_no_failures`` and ``explain_buckets_bounded`` join the
+check map.
 
 The ``faults`` tier (ISSUE 7) runs ``tools/fault_matrix.py --json``:
 every ``LGBM_TPU_FAULTS`` injection point x recovery mode — transient
@@ -120,7 +125,11 @@ def run_tier(tier: str, select: str, timeout: int,
 
 # built-in (non-pytest) tiers: tier name -> argv tail under tools/
 _TOOL_TIERS = {
-    "serve": ["bench_serve.py", "--smoke"],
+    # --explain-frac pinned so the suite's serve leg always smokes the
+    # explain plane (bench_serve adds explain_served /
+    # explain_buckets_bounded checks when the mixed leg runs), even if
+    # the environment zeroes SERVE_EXPLAIN_FRAC
+    "serve": ["bench_serve.py", "--smoke", "--explain-frac", "0.2"],
     "faults": ["fault_matrix.py", "--json"],
 }
 

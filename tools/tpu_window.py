@@ -29,7 +29,12 @@ capture checklist with health monitoring enabled:
    the ``SERVE_r*.json`` CI rounds).  The leg runs with
    ``LGBM_TPU_TRACE=1`` and a flight capture, so one good window also
    yields a Perfetto-loadable ``serve_trace.json`` (request span trees)
-   and a ``FLIGHT_serve.json`` flight record in the artifacts dir.
+   and a ``FLIGHT_serve.json`` flight record in the artifacts dir;
+7. ``tools/bench_serve.py --json --explain-frac 0.5`` — the
+   explanation-serving leg (ISSUE 9): half the open-loop Poisson
+   arrivals are ``/explain`` TreeSHAP requests, so the window captures
+   ``explain_p99`` under real mixed contention on the live backend,
+   written as ``SERVE_explain_manual_r{N}.json``.
 
 Artifacts (``--out``, default repo root):
 
@@ -185,6 +190,14 @@ def checklist_legs(art_dir: str, dry_run: bool, py: str = sys.executable):
                          "SERVE_FLIGHT_OUT": os.path.join(
                              art_dir, "FLIGHT_serve.json")},
                         dry_env=_DRY_SERVE_ENV),
+         "parse_json": True},
+        # explanation-serving leg (ISSUE 9): an explain-heavy mix so the
+        # window yields a TreeSHAP p99 under contention, not an
+        # idle-path number — its own telemetry dir keeps the span
+        # streams separable
+        {"name": "bench_explain",
+         "argv": [py, serve, "--json", "--explain-frac", "0.5"],
+         "env": env_for("bench_explain", dry_env=_DRY_SERVE_ENV),
          "parse_json": True},
         {"name": "trace",
          "argv": [py, "-c", _TRACE_CODE, trace_rows, trace_dir],
@@ -384,6 +397,15 @@ def run_checklist(out_dir: str, n: int, dry_run: bool,
             json.dump(serve_parsed, fh, indent=1)
         record["serve_path"] = serve_path
         print(f"# wrote {serve_path}")
+    explain_parsed = (results.get("bench_explain") or {}).get("parsed")
+    if explain_parsed:
+        explain_parsed = dict(explain_parsed, n=n, dry_run=dry_run)
+        explain_path = os.path.join(out_dir,
+                                    f"SERVE_explain_manual_r{n:02d}.json")
+        with open(explain_path, "w") as fh:
+            json.dump(explain_parsed, fh, indent=1)
+        record["explain_path"] = explain_path
+        print(f"# wrote {explain_path}")
     if "bench_serve" in results:
         st_path, st_events = export_serve_trace(art_dir)
         if st_path:
