@@ -218,17 +218,22 @@ def run_predict(cfg: Config, params: Dict) -> None:
 
 
 def run_serve(cfg: Config, params: Dict) -> None:
-    """task=serve: pack input_model device-resident and serve it over
-    HTTP (serve/server.py: POST /predict, GET /health) until
-    interrupted."""
+    """task=serve: pack input_model into a replicated, registry-managed
+    fleet and serve it over HTTP until interrupted (serve/server.py:
+    POST /predict /explain, POST /models/{name}/swap|rollback for
+    zero-downtime model pushes, GET /health /metrics /stats /models).
+    The model registers as ``default``; ``tpu_serve_replicas`` sessions
+    serve it behind the failover router."""
     if not cfg.input_model:
         log.fatal("task=serve needs input_model (alias: model_file)")
-    from .serve import PredictorSession, PredictServer
-    sess = PredictorSession(cfg.input_model, config=cfg)
-    n = sess.warmup()
-    log.info("serve: warmed %d bucket shapes (max_batch=%d)",
-             n, sess.max_batch)
-    PredictServer(sess, host=cfg.tpu_serve_host,
+    from .serve import ModelRegistry, PredictServer
+    reg = ModelRegistry(config=cfg)
+    reg.add_model("default", cfg.input_model)
+    router = reg.resolve(None).router
+    n = router.warmup()
+    log.info("serve: %d replica(s) warmed %d bucket shapes "
+             "(max_batch=%d)", len(router.replicas), n, router.max_batch)
+    PredictServer(reg, host=cfg.tpu_serve_host,
                   port=cfg.tpu_serve_port).serve_forever()
 
 

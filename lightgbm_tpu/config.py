@@ -467,6 +467,86 @@ class Config:
                                         # rate); 0 disables the gauge
                                         # (LGBM_TPU_SERVE_SLO_P99_MS env)
 
+    # ---- Serving fleet (serve/router.py + serve/registry.py) ----
+    tpu_serve_replicas: int = 2         # PredictorSession replicas per
+                                        # model version behind the
+                                        # router: per-device on a multi-
+                                        # chip host, thread-pool
+                                        # replicas on CPU — one wedged
+                                        # replica costs capacity, not
+                                        # availability
+                                        # (LGBM_TPU_SERVE_REPLICAS env)
+    tpu_serve_breaker_trip: int = 3     # consecutive transient failures
+                                        # that open a replica's circuit
+                                        # breaker (a FATAL failure opens
+                                        # it immediately)
+    tpu_serve_breaker_backoff_s: float = 0.5  # base of the breaker's
+                                        # bounded exponential backoff:
+                                        # how long an open breaker waits
+                                        # before letting one half-open
+                                        # probe request through
+    tpu_serve_canary_rows: int = 64     # pinned probe-set rows the
+                                        # canary gate scores on a swap
+                                        # candidate (device-vs-host
+                                        # parity + finite-output checks)
+    tpu_serve_canary_probes: int = 16   # single-row latency probes the
+                                        # canary gate times (p99
+                                        # recorded in the swap report)
+    tpu_serve_canary_p99_ms: float = 0.0  # reject a swap whose canary
+                                        # p99 exceeds this; 0 = record
+                                        # the p99 but never gate on it
+                                        # (CI latency is too noisy to
+                                        # gate by default)
+    tpu_serve_rollback_watch_s: float = 30.0  # post-swap health-watch
+                                        # window: the new live version's
+                                        # metrics are monitored this
+                                        # long and a regression triggers
+                                        # AUTOMATIC rollback to the
+                                        # still-resident previous
+                                        # version; 0 disables the watch
+                                        # (manual rollback still works)
+                                        # (LGBM_TPU_SERVE_ROLLBACK_WATCH_S
+                                        # env)
+    tpu_serve_rollback_error_rate: float = 0.5  # post-swap failed-
+                                        # request fraction (over the
+                                        # watch window) that triggers
+                                        # automatic rollback
+    tpu_serve_rollback_degraded: int = 2  # post-swap degraded
+                                        # transitions that trigger
+                                        # automatic rollback (the new
+                                        # version's device path keeps
+                                        # dying)
+    tpu_serve_rollback_slo_burn: float = 0.0  # post-swap SLO-burn rate
+                                        # that triggers automatic
+                                        # rollback; 0 = never gate the
+                                        # rollback on burn
+    tpu_serve_shed_low_frac: float = 0.5  # fraction of the queue-row
+                                        # budget low-priority requests
+                                        # may fill before being shed
+                                        # (overload drops bulk traffic
+                                        # first)
+                                        # (LGBM_TPU_SERVE_SHED_LOW_FRAC
+                                        # env)
+    tpu_serve_shed_normal_frac: float = 0.85  # queue-budget fraction for
+                                        # normal-priority requests
+                                        # (high priority always owns
+                                        # the full queue)
+                                        # (LGBM_TPU_SERVE_SHED_NORMAL_FRAC
+                                        # env)
+    tpu_serve_retry_after_s: float = 1.0  # Retry-After header seconds on
+                                        # shed (503) responses — when a
+                                        # rejected client should come
+                                        # back
+    tpu_serve_swap_warmup: bool = True  # compile every bucket shape of
+                                        # a swap candidate BEFORE the
+                                        # atomic flip (the old version
+                                        # keeps serving meanwhile), so
+                                        # post-flip traffic never pays
+                                        # the new forest's XLA compiles
+                                        # — the zero-cold-start half of
+                                        # zero-downtime; false flips
+                                        # immediately after the canary
+
     # ---- Explanation serving (explain/ subsystem) ----
     tpu_explain: bool = True            # arm POST /explain and
                                         # PredictorSession.explain():
@@ -608,6 +688,20 @@ class Config:
             log.fatal("tpu_serve_port should be in [0, 65535]")
         if self.tpu_serve_slo_p99_ms < 0:
             log.fatal("tpu_serve_slo_p99_ms should be >= 0")
+        if self.tpu_serve_replicas < 1:
+            log.fatal("tpu_serve_replicas should be >= 1")
+        if self.tpu_serve_breaker_trip < 1:
+            log.fatal("tpu_serve_breaker_trip should be >= 1")
+        if self.tpu_serve_canary_rows < 1:
+            log.fatal("tpu_serve_canary_rows should be >= 1")
+        if not (0.0 <= self.tpu_serve_rollback_error_rate <= 1.0):
+            log.fatal("tpu_serve_rollback_error_rate should be in [0, 1]")
+        if not (0.0 <= self.tpu_serve_shed_low_frac <= 1.0):
+            log.fatal("tpu_serve_shed_low_frac should be in [0, 1]")
+        if not (0.0 <= self.tpu_serve_shed_normal_frac <= 1.0):
+            log.fatal("tpu_serve_shed_normal_frac should be in [0, 1]")
+        if self.tpu_serve_rollback_watch_s < 0:
+            log.fatal("tpu_serve_rollback_watch_s should be >= 0")
         if self.tpu_explain_max_batch < 1:
             log.fatal("tpu_explain_max_batch should be >= 1")
         if self.tpu_explain_max_wait_ms < 0:

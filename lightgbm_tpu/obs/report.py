@@ -340,6 +340,35 @@ def serve_summary(events: List[dict]) -> dict:
     }
     if degraded:
         out["degraded_error"] = degraded[0].get("error")
+    # fleet lifecycle (serve/registry.py): swaps / canary verdicts /
+    # rollbacks / failovers beside the request numbers they governed
+    swaps = [e for e in events if e.get("event") == "serve_swap"]
+    rollbacks = [e for e in events if e.get("event") == "serve_rollback"]
+    failovers = [e for e in events if e.get("event") == "serve_failover"]
+    if swaps or rollbacks or failovers:
+        out["fleet"] = {
+            # initial deploys (add_model stamps initial=True) are not
+            # hot-swaps — the registry's swaps counter and
+            # tpu_serve_swaps_total exclude them, so the digest must too
+            "swaps": sum(1 for e in swaps
+                         if e.get("ok") and not e.get("initial")),
+            "deploys": sum(1 for e in swaps
+                           if e.get("ok") and e.get("initial")),
+            "swaps_rejected": sum(1 for e in swaps if not e.get("ok")),
+            "rollbacks": len(rollbacks),
+            "failovers": len(failovers),
+        }
+        if rollbacks:
+            out["fleet"]["last_rollback"] = {
+                "model": rollbacks[-1].get("model"),
+                "reason": rollbacks[-1].get("reason")}
+    shed = [e for e in events if e.get("event") == "serve_overload"
+            and e.get("priority")]
+    if shed:
+        by_class = defaultdict(int)
+        for e in shed:
+            by_class[e.get("priority", "?")] += 1
+        out["shed_by_priority"] = dict(sorted(by_class.items()))
     xreqs = [e for e in events if e.get("event") == "explain_request"]
     xbatches = [e for e in events if e.get("event") == "explain_batch"]
     if xreqs or xbatches:
@@ -538,6 +567,40 @@ EVENT_SCHEMAS = {
     "serve_overload": {
         "rows": (int, True),
         "queue_rows": (int, True),
+        "priority": (str, False),   # shedding class of the rejected
+                                    # request (low sheds first)
+    },
+    # serving fleet (serve/registry.py + serve/router.py)
+    "serve_swap": {
+        "model": (str, True),
+        "ok": (bool, True),
+        "from_version": (int, False),
+        "to_version": (int, True),
+        "ms": (_NUM, False),
+        "initial": (bool, False),
+    },
+    "serve_canary": {
+        "model": (str, True),
+        "version": (int, True),
+        "ok": (bool, True),
+        "checks": (dict, True),
+        "p99_ms": (_NUM, False),
+    },
+    "serve_rollback": {
+        "model": (str, True),
+        "from_version": (int, True),
+        "to_version": (int, True),
+        "reason": (str, True),
+    },
+    "serve_failover": {
+        "replica": (int, True),
+        "classify": (str, True),
+        "breaker": (str, True),
+        "error": (str, False),
+    },
+    "serve_drain": {
+        "replica": (int, True),
+        "draining": (bool, True),
     },
     # trace plane (obs/spans.py) + the HTTP access log (serve/server.py)
     "span": {
@@ -748,6 +811,20 @@ def render(digest: dict) -> str:
                        + (f", occupancy {occ:.1%}" if occ else "")
                        + (f", deadline misses {x['deadline_missed']}"
                           if x.get("deadline_missed") else ""))
+        if s.get("fleet"):
+            f = s["fleet"]
+            line = (f"  fleet: {f['swaps']} swap(s), "
+                    f"{f['swaps_rejected']} rejected by canary, "
+                    f"{f['rollbacks']} rollback(s), "
+                    f"{f['failovers']} replica failover(s)")
+            if f.get("last_rollback"):
+                lr = f["last_rollback"]
+                line += (f" — last rollback: {lr.get('model')} "
+                         f"({lr.get('reason')})")
+            out.append(line)
+        if s.get("shed_by_priority"):
+            out.append("  shed by priority: " + ", ".join(
+                f"{k}={v}" for k, v in s["shed_by_priority"].items()))
     if digest.get("robust"):
         r = digest["robust"]
         out.append("")

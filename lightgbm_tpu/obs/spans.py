@@ -70,6 +70,11 @@ _FLIGHT_EVENTS = frozenset((
     # wedge post-mortem needs in the ring
     "checkpoint", "restore", "retry", "fault_injected", "device_stall",
     "serve_probe", "serve_recovered",
+    # serving fleet (serve/registry.py + serve/router.py): the swap /
+    # rollback / failover lifecycle IS the post-mortem when a model push
+    # bounces
+    "serve_swap", "serve_canary", "serve_rollback", "serve_failover",
+    "serve_drain",
 ))
 
 

@@ -13,18 +13,26 @@ Three cooperating layers, each usable alone:
   backoff + deterministic jitter, stamp stalled steps against a rolling
   per-step p99 deadline, and on a fatal wedge dump the flight recorder,
   write a boundary checkpoint, and abort / fall back to CPU per
-  ``tpu_on_device_error``.
+  ``tpu_on_device_error``.  ``CircuitBreaker`` applies the same
+  taxonomy + backoff to serving-replica routing (serve/router.py): a
+  wedged replica drops out of the routing set and a half-open probe
+  re-admits it.
 - :mod:`.faults` — the ``LGBM_TPU_FAULTS`` injection harness: seeded,
   deterministic faults (``raise``/``transient``/``sleep``) at named
   points (device_execute, gradients, collective, serve_device,
-  checkpoint_write) so every recovery branch is CI-provable on CPU.
+  serve_explain_submit/serve_explain_device, serve_replica{_i},
+  serve_swap, serve_canary, checkpoint_write) so every recovery branch
+  — training (tools/fault_matrix.py) and serving
+  (tools/chaos_serve.py) — is CI-provable on CPU.
 """
 from .checkpoint import CheckpointManager, config_digest
 from .faults import FaultInjected, FaultTransient
-from .watchdog import DeviceGuard, DeviceWedgedError, classify_error
+from .watchdog import (CircuitBreaker, DeviceGuard, DeviceWedgedError,
+                       classify_error)
 
 __all__ = [
     "CheckpointManager", "config_digest",
-    "DeviceGuard", "DeviceWedgedError", "classify_error",
+    "CircuitBreaker", "DeviceGuard", "DeviceWedgedError",
+    "classify_error",
     "FaultInjected", "FaultTransient",
 ]

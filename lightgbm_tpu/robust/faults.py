@@ -9,7 +9,10 @@ CPU-only container.  The spec grammar (env var ``LGBM_TPU_FAULTS`` or
     spec      := leg (';' leg)*
     leg       := point ':' action ('@' cond ('&' cond)*)?
     point     := device_execute | gradients | collective | serve_device
-                 | checkpoint_write        (free-form: any check() name)
+                 | serve_explain_submit | serve_explain_device
+                 | serve_replica | serve_replica_N | serve_swap
+                 | serve_canary | checkpoint_write
+                 (free-form: any check() name)
     action    := raise | transient | sleep=SECONDS | hang
     cond      := iter=N     fire only during boosting iteration N
                | call=N     fire on the N-th check() at this point (1-based)
@@ -32,9 +35,14 @@ so a given spec+seed replays the identical fault schedule.
 
 Injection points live in the trainer's guarded device dispatch
 (boosting/gbdt.py), the gradient step, the host collective path
-(parallel/distributed.py), the serving device path (serve/session.py),
-and the checkpoint writer.  When no plan is configured every
-:func:`check` call is one ``None`` test.
+(parallel/distributed.py), the serving predict + explain device paths
+(serve/session.py: ``serve_device``, ``serve_explain_submit``,
+``serve_explain_device``), the replica router's dispatch
+(serve/router.py: ``serve_replica`` plus per-replica
+``serve_replica_{i}`` so a chaos run can wedge exactly one replica),
+the model registry's swap/canary path (serve/registry.py:
+``serve_swap``, ``serve_canary``), and the checkpoint writer.  When no
+plan is configured every :func:`check` call is one ``None`` test.
 """
 from __future__ import annotations
 

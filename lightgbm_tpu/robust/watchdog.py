@@ -267,6 +267,108 @@ class DeviceGuard:
             + f": {type(exc).__name__}: {exc}") from exc
 
 
+class CircuitBreaker:
+    """Per-replica circuit breaker for the serving router (serve/router.py).
+
+    The serving twin of :class:`DeviceGuard`: same failure taxonomy
+    (:func:`classify_error`), same bounded deterministic backoff
+    (:func:`backoff_delays`) — but instead of retrying in place it takes
+    a replica OUT of the routing set, so one wedged replica costs
+    capacity, never availability.  States:
+
+    - **closed** — healthy; every request is allowed.
+    - **open** — tripped (a FATAL failure immediately, or ``trip_after``
+      consecutive transient ones); requests are routed elsewhere until
+      the backoff delay expires.  Re-trips walk the bounded backoff
+      schedule, so a flapping replica is probed less and less often.
+    - **half_open** — the backoff expired; exactly ONE probe request is
+      let through.  Success closes the breaker, failure re-opens it at
+      the next backoff step.
+    """
+
+    def __init__(self, trip_after: int = 3, backoff_base_s: float = 0.5,
+                 backoff_cap_s: float = 30.0, seed: int = 0):
+        self.trip_after = max(int(trip_after), 1)
+        # a long-enough schedule that a permanently dead replica keeps
+        # being probed at the cap instead of running off the end
+        self._delays = backoff_delays(16, backoff_base_s, backoff_cap_s,
+                                      seed)
+        self._lock = threading.Lock()
+        self.state = "closed"
+        self.failures = 0            # lifetime failure count
+        self.consecutive = 0         # consecutive failures since last ok
+        self.opens = 0               # times the breaker tripped
+        self._open_until = 0.0
+        self._open_step = 0          # index into the backoff schedule
+        # a half-open probe that never resolves (the probing request was
+        # never dispatched — e.g. a sibling replica answered first, or
+        # its thread died) must not strand the breaker: after this long
+        # in half_open without a verdict, another probe is allowed
+        self._probe_timeout_s = max(float(backoff_cap_s), 1.0)
+        self._half_open_since = 0.0
+        self.last_error = ""
+
+    def allow(self) -> bool:
+        """True when a request may be routed to this replica.  While
+        open, flips to half_open (one probe) once the backoff expires;
+        a probe that evaporates is re-allowed after the probe timeout."""
+        with self._lock:
+            now = time.monotonic()
+            if self.state == "closed":
+                return True
+            if self.state == "half_open":
+                if now - self._half_open_since > self._probe_timeout_s:
+                    self._half_open_since = now
+                    return True  # the earlier probe never resolved
+                return False  # a probe is already in flight
+            if now >= self._open_until:
+                self.state = "half_open"
+                self._half_open_since = now
+                return True
+            return False
+
+    def record_ok(self) -> None:
+        with self._lock:
+            self.consecutive = 0
+            if self.state == "open":
+                # only the half-open probe may close a tripped breaker:
+                # a success belonging to a request dispatched BEFORE the
+                # trip (a stale in-flight result) must not re-admit the
+                # replica or reset the backoff escalation
+                return
+            self.state = "closed"
+            self._open_step = 0
+
+    def record_failure(self, exc: BaseException) -> str:
+        """Account one failure; returns the classification.  A fatal
+        failure (or a half-open probe failure, or ``trip_after``
+        consecutive transients) opens the breaker."""
+        cls = classify_error(exc)
+        with self._lock:
+            self.failures += 1
+            self.consecutive += 1
+            self.last_error = f"{type(exc).__name__}: {exc}"
+            trip = (cls == "fatal" or self.state == "half_open"
+                    or self.consecutive >= self.trip_after)
+            if trip:
+                self.state = "open"
+                self.opens += 1
+                delay = self._delays[min(self._open_step,
+                                         len(self._delays) - 1)]
+                self._open_step += 1
+                self._open_until = time.monotonic() + delay
+        return cls
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            open_for = (max(self._open_until - time.monotonic(), 0.0)
+                        if self.state == "open" else 0.0)
+            return {"state": self.state, "failures": self.failures,
+                    "consecutive": self.consecutive, "opens": self.opens,
+                    "open_for_s": round(open_for, 3),
+                    "last_error": self.last_error or None}
+
+
 # convenience for one-off guarded calls (the host collective path uses
 # this — a full per-trainer guard would be overkill there; heartbeat
 # disabled: collectives are guarded for retries only)

@@ -13,17 +13,31 @@ microbatcher behind a threaded HTTP front end.
   ``submit_explain`` — batched device TreeSHAP (explain/) behind its
   own microbatch queue and pow2 bucket family (``POST /explain``)
 - ``batcher``  — request coalescing, power-of-two padding, backpressure
+  with priority-class load shedding (low sheds first)
+- ``router``   — ``ReplicaRouter``: >=2 session replicas behind
+  health-based routing, per-replica circuit breakers, and draining —
+  one wedged replica degrades capacity, not availability
+- ``registry`` — ``ModelRegistry``: named model versions with a
+  canary-gated zero-downtime hot-swap (parity/finite/latency gate,
+  atomic flip, resident previous version, automatic post-swap
+  rollback on health regression)
 - ``server``   — JSON-over-HTTP front end with deadlines + /health,
-  /metrics (Prometheus), /stats, /debug/flight
+  /metrics (Prometheus), /stats, /models, /models/{name}/swap,
+  /models/{name}/rollback, /debug/flight
 - ``metrics``  — lock-cheap counters/histogram + SLO-burn behind
   /metrics, with the minimal text-format parser for reading it back
 """
-from .batcher import DeadlineExceeded, MicroBatcher, ServeOverloadError
+from .batcher import (PRIORITIES, DeadlineExceeded, MicroBatcher,
+                      ServeOverloadError, normalize_priority)
 from .metrics import ServeMetrics, parse_prometheus
 from .packing import ServeBinSpace
+from .registry import ModelRegistry, SwapRejected, UnknownModelError
+from .router import NoReplicaAvailable, ReplicaRouter
 from .server import PredictServer
 from .session import PredictorSession
 
-__all__ = ["DeadlineExceeded", "MicroBatcher", "PredictServer",
-           "PredictorSession", "ServeBinSpace", "ServeMetrics",
-           "ServeOverloadError", "parse_prometheus"]
+__all__ = ["PRIORITIES", "DeadlineExceeded", "MicroBatcher",
+           "ModelRegistry", "NoReplicaAvailable", "PredictServer",
+           "PredictorSession", "ReplicaRouter", "ServeBinSpace",
+           "ServeMetrics", "ServeOverloadError", "SwapRejected",
+           "UnknownModelError", "normalize_priority", "parse_prometheus"]

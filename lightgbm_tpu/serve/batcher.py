@@ -23,8 +23,33 @@ from concurrent.futures import Future
 from typing import List, Optional
 
 
+# priority classes, most- to least-important.  Overload sheds from the
+# LOW end first: each class owns a fraction of the queue-row budget, so
+# a saturating flood of low-priority bulk traffic hits ITS cap while
+# interactive high-priority requests still have headroom.
+PRIORITIES = ("high", "normal", "low")
+DEFAULT_SHED_FRACS = {"high": 1.0, "normal": 0.85, "low": 0.5}
+
+
+def normalize_priority(value) -> str:
+    """Map a request's priority field to a known class (unknown/absent
+    values serve as ``normal`` rather than erroring — shedding is an
+    overload policy, not an input validator)."""
+    p = str(value or "normal").strip().lower()
+    return p if p in PRIORITIES else "normal"
+
+
 class ServeOverloadError(RuntimeError):
-    """The bounded request queue is full — backpressure, not OOM."""
+    """The bounded request queue is full — backpressure, not OOM.
+    ``priority`` is the class of the rejected request; ``shed`` is True
+    when the rejection came from a priority class's partial budget
+    (rows remained for higher classes), False at the absolute cap."""
+
+    def __init__(self, msg: str, priority: str = "normal",
+                 shed: bool = False):
+        super().__init__(msg)
+        self.priority = priority
+        self.shed = shed
 
 
 class DeadlineExceeded(RuntimeError):
@@ -40,11 +65,12 @@ class Request:
     request's root span) the session's span emission attributes to."""
 
     __slots__ = ("bins", "raw", "n", "future", "deadline", "t_submit",
-                 "t_submit_wall", "trace_id", "parent_id")
+                 "t_submit_wall", "trace_id", "parent_id", "priority")
 
     def __init__(self, bins, raw, deadline: Optional[float] = None,
                  trace_id: Optional[str] = None,
-                 parent_id: Optional[str] = None):
+                 parent_id: Optional[str] = None,
+                 priority: str = "normal"):
         self.bins = bins
         self.raw = raw
         self.n = int(bins.shape[0])
@@ -54,6 +80,7 @@ class Request:
         self.t_submit_wall = time.time()  # span timestamps are wall clock
         self.trace_id = trace_id
         self.parent_id = parent_id
+        self.priority = normalize_priority(priority)
 
 
 class MicroBatcher:
@@ -62,11 +89,25 @@ class MicroBatcher:
     requests only — a request is never split across batches)."""
 
     def __init__(self, execute, max_batch: int, max_wait_s: float,
-                 max_queue_rows: int, name: str = "lgbm-serve-batcher"):
+                 max_queue_rows: int, name: str = "lgbm-serve-batcher",
+                 shed_fracs: Optional[dict] = None):
         self._execute = execute
         self.max_batch = max(int(max_batch), 1)
         self.max_wait_s = max(float(max_wait_s), 0.0)
         self.max_queue_rows = max(int(max_queue_rows), self.max_batch)
+        # per-priority queue-row budgets (fraction of max_queue_rows);
+        # high priority always owns the full queue, and normal/high
+        # budgets floor at one full batch so default traffic can always
+        # be admitted to an idle queue — only LOW may be configured
+        # below a batch (bulk traffic on a tiny queue is shed by design)
+        fracs = dict(DEFAULT_SHED_FRACS)
+        fracs.update(shed_fracs or {})
+        fracs["high"] = 1.0
+        self.shed_caps = {p: max(int(self.max_queue_rows
+                                     * min(max(float(fracs.get(p, 1.0)),
+                                               0.0), 1.0)),
+                                 0 if p == "low" else self.max_batch)
+                          for p in PRIORITIES}
         self._q: deque = deque()
         self._rows = 0
         self._closed = False
@@ -84,10 +125,14 @@ class MicroBatcher:
         with self._cv:
             if self._closed:
                 raise RuntimeError("batcher is closed")
-            if self._rows + req.n > self.max_queue_rows:
+            cap = self.shed_caps.get(req.priority, self.max_queue_rows)
+            if self._rows + req.n > cap:
                 raise ServeOverloadError(
-                    f"serve queue full ({self._rows} rows queued, "
-                    f"cap {self.max_queue_rows})")
+                    f"serve queue full for priority {req.priority!r} "
+                    f"({self._rows} rows queued, cap {cap} of "
+                    f"{self.max_queue_rows})",
+                    priority=req.priority,
+                    shed=cap < self.max_queue_rows)
             self._q.append(req)
             self._rows += req.n
             self._cv.notify_all()

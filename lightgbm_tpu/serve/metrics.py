@@ -50,6 +50,11 @@ class ServeMetrics:
         self._x_ok = 0
         self._x_failed = 0
         self._status: Dict[int, int] = {}
+        # priority-class accounting for load shedding (serve/batcher.py
+        # PRIORITIES): served vs shed per class is the evidence that
+        # overload dropped low-priority traffic first
+        self._served: Dict[str, int] = {}
+        self._shed: Dict[str, int] = {}
         self._recent = deque(maxlen=_SLO_WINDOW)
         # degradation is a recoverable state (serve/session.py re-probes
         # the device), so the gauge needs transition counters beside it:
@@ -104,6 +109,18 @@ class ServeMetrics:
         code = int(code)
         with self._lock:
             self._status[code] = self._status.get(code, 0) + 1
+
+    def count_served(self, priority: str) -> None:
+        """One successfully served request of this priority class."""
+        p = str(priority or "normal")
+        with self._lock:
+            self._served[p] = self._served.get(p, 0) + 1
+
+    def count_shed(self, priority: str) -> None:
+        """One request rejected by overload/shedding in this class."""
+        p = str(priority or "normal")
+        with self._lock:
+            self._shed[p] = self._shed.get(p, 0) + 1
 
     def set_degraded(self, flag: bool) -> None:
         """Record a degradation-state transition (session -> host
@@ -163,6 +180,8 @@ class ServeMetrics:
                 "explain_ok": self._x_ok,
                 "explain_failed": self._x_failed,
                 "status": dict(sorted(self._status.items())),
+                "served_by_priority": dict(sorted(self._served.items())),
+                "shed_by_priority": dict(sorted(self._shed.items())),
                 "slo_p99_ms": self.slo_p99_ms or None,
                 "slo_burn": burn,
                 "degraded": self._degraded,
@@ -231,6 +250,21 @@ def render_prometheus(session) -> str:
                % _fmt(snap["explain_latency_sum_ms"]))
     out.append("tpu_serve_explain_latency_ms_count %d"
                % snap["explain_latency_count"])
+    # priority-class shedding (serve/batcher.py): served vs shed per
+    # class — every class is rendered even at 0 so a scrape series never
+    # appears mid-overload
+    from .batcher import PRIORITIES
+    head("tpu_serve_served_total", "counter",
+         "Successfully served requests by priority class.")
+    for p in PRIORITIES:
+        out.append('tpu_serve_served_total{priority="%s"} %d'
+                   % (p, snap["served_by_priority"].get(p, 0)))
+    head("tpu_serve_shed_total", "counter",
+         "Requests rejected by overload shedding, by priority class "
+         "(low sheds first).")
+    for p in PRIORITIES:
+        out.append('tpu_serve_shed_total{priority="%s"} %d'
+                   % (p, snap["shed_by_priority"].get(p, 0)))
 
     gauges = (
         ("tpu_serve_queue_rows", "gauge", "Rows waiting in the batcher "
@@ -273,6 +307,72 @@ def render_prometheus(session) -> str:
     for name, kind, help_, v in gauges:
         head(name, kind, help_)
         out.append(f"{name} {_fmt(v)}")
+    # replica fleet view (serve/router.py): when the target is a
+    # ReplicaRouter its stats() carries per-replica rows — rendered with
+    # a replica label so one scrape shows which replica is degraded /
+    # breaker-open / draining
+    reps = st.get("replicas")
+    if isinstance(reps, list) and reps:
+        _BREAKER_CODE = {"closed": 0, "half_open": 1, "open": 2}
+        head("tpu_serve_replica_healthy", "gauge",
+             "1 when the replica is routable (breaker closed, not "
+             "draining, not degraded).")
+        for r in reps:
+            out.append('tpu_serve_replica_healthy{replica="%s"} %d'
+                       % (r.get("replica"), 1 if r.get("healthy") else 0))
+        head("tpu_serve_replica_breaker_state", "gauge",
+             "Replica circuit-breaker state: 0 closed, 1 half_open, "
+             "2 open.")
+        for r in reps:
+            out.append(
+                'tpu_serve_replica_breaker_state{replica="%s"} %d'
+                % (r.get("replica"),
+                   _BREAKER_CODE.get((r.get("breaker") or {})
+                                     .get("state"), 0)))
+        head("tpu_serve_replica_queue_rows", "gauge",
+             "Rows waiting in each replica's batcher queue.")
+        for r in reps:
+            out.append('tpu_serve_replica_queue_rows{replica="%s"} %d'
+                       % (r.get("replica"),
+                          int(r.get("queue_rows") or 0)))
+    return "\n".join(out) + "\n"
+
+
+def render_prometheus_fleet(registry) -> str:
+    """Prometheus text for a ``ModelRegistry`` fleet: the default
+    model's live router rendered as the primary series (so dashboards
+    built against the single-session exposition keep working), plus the
+    registry-level model/version/swap/rollback series."""
+    ver = registry.resolve(None)
+    out = [render_prometheus(ver.router).rstrip("\n")]
+
+    def head(name, kind, help_):
+        out.append(f"# HELP {name} {help_}")
+        out.append(f"# TYPE {name} {kind}")
+
+    listing = registry.models()
+    head("tpu_serve_models", "gauge", "Models resident in the registry.")
+    out.append("tpu_serve_models %d" % len(listing))
+    head("tpu_serve_model_version", "gauge",
+         "Live version per registered model.")
+    for m in listing:
+        out.append('tpu_serve_model_version{model="%s"} %d'
+                   % (m["name"], m["live_version"]))
+    head("tpu_serve_swaps_total", "counter",
+         "Completed hot-swaps per model (canary-gated flips).")
+    for m in listing:
+        out.append('tpu_serve_swaps_total{model="%s"} %d'
+                   % (m["name"], m["swaps"]))
+    head("tpu_serve_swaps_rejected_total", "counter",
+         "Swap attempts rejected by the canary gate.")
+    for m in listing:
+        out.append('tpu_serve_swaps_rejected_total{model="%s"} %d'
+                   % (m["name"], m["swaps_rejected"]))
+    head("tpu_serve_rollbacks_total", "counter",
+         "Rollbacks per model (manual + automatic post-swap).")
+    for m in listing:
+        out.append('tpu_serve_rollbacks_total{model="%s"} %d'
+                   % (m["name"], m["rollbacks"]))
     return "\n".join(out) + "\n"
 
 

@@ -1,0 +1,532 @@
+"""Model registry: named versions, canary-gated hot-swap, rollback.
+
+The reference reloads a Booster as a blocking offline swap
+(src/c_api.cpp Booster reload path) — the serving process stops
+answering while the new model loads.  A production fleet cannot: this
+registry owns every resident model version and makes a model push a
+*governed* transition instead of a file overwrite:
+
+1. **load beside** — the candidate version packs its own
+   ``ReplicaRouter`` (its own device forests, batchers, metrics) while
+   the live version keeps serving; nothing about the live path changes.
+2. **canary gate** — before any traffic shifts, the candidate must pass
+   (a) *parity*: device predictions on a pinned probe set match the
+   candidate's own host-oracle traversal (the bit-space contract that
+   caught every packing bug so far), (b) *finite outputs*: no NaN/Inf
+   leaves the kernel, (c) a *latency probe*: p99 over
+   ``tpu_serve_canary_probes`` single-row predicts, gated against
+   ``tpu_serve_canary_p99_ms`` when that knob is > 0 (recorded either
+   way).  A gate failure closes the candidate and leaves the old
+   version serving — the swap simply did not happen.
+3. **atomic flip** — the live pointer swaps under the registry lock.
+   In-flight tickets hold references to the version that issued them,
+   so they complete against the OLD forests: zero dropped requests, and
+   every response remains attributable to exactly one version.
+4. **instant rollback** — the previous version stays resident (device
+   arrays and all).  ``rollback()`` is another pointer flip, not a
+   reload.  After a swap the registry watches the new version's
+   ``ServeMetrics`` (each version gets a FRESH instance, so post-swap
+   deltas start from zero) for ``tpu_serve_rollback_watch_s`` seconds:
+   a failed-request rate over ``tpu_serve_rollback_error_rate``,
+   ``tpu_serve_rollback_degraded`` degraded transitions, or an SLO burn
+   over ``tpu_serve_rollback_slo_burn`` triggers an AUTOMATIC rollback
+   (plus a flight-recorder dump — the post-mortem for "why did the push
+   bounce").
+
+Fault injection: ``serve_swap`` fires before the flip (swap-mid-flight
+chaos), ``serve_canary`` inside the gate (canary-fail chaos).
+``tools/chaos_serve.py`` drives every scenario on CPU.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .. import obs
+from ..robust import faults
+from ..utils import log
+from .metrics import ServeMetrics
+from .router import ReplicaRouter
+from .session import _env_num
+
+_CANARY_SEED = 17          # the pinned probe set is deterministic
+_CANARY_ATOL = 1e-5        # device-vs-host parity tolerance (f32 forest)
+_POSTSWAP_MIN_REQUESTS = 4  # error-rate needs a denominator
+
+
+class UnknownModelError(KeyError):
+    """The requested model name is not registered."""
+
+
+class SwapRejected(RuntimeError):
+    """The canary gate (or an injected swap fault) refused the flip;
+    the previous version is still serving."""
+
+    def __init__(self, msg: str, report: dict):
+        super().__init__(msg)
+        self.report = report
+
+
+class _Version:
+    """One resident model version: a router + lifecycle state."""
+
+    __slots__ = ("version", "router", "source", "state", "created_t",
+                 "canary", "baseline", "watch_until")
+
+    def __init__(self, version: int, router: ReplicaRouter, source: str):
+        self.version = version
+        self.router = router
+        self.source = source
+        self.state = "canary"          # canary|live|previous|retired
+        self.created_t = time.time()
+        self.canary: Optional[dict] = None
+        self.baseline: Optional[dict] = None   # metrics at flip time
+        self.watch_until: Optional[float] = None
+
+    def row(self) -> dict:
+        return {"version": self.version, "state": self.state,
+                "source": self.source,
+                "created_t": round(self.created_t, 1),
+                "canary": self.canary}
+
+
+class _Entry:
+    """All versions of one model name."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.live: Optional[_Version] = None
+        self.previous: Optional[_Version] = None
+        self.history: List[dict] = []   # retired/rejected version rows
+        self.next_version = 1
+        self.swaps = 0
+        self.swaps_rejected = 0
+        self.rollbacks = 0
+        self.swap_lock = threading.Lock()  # one swap at a time per model
+
+
+class ModelRegistry:
+    """Named model versions with canary-gated zero-downtime swaps."""
+
+    def __init__(self, config=None, n_replicas: Optional[int] = None,
+                 **session_kw):
+        self.config = config
+        self.n_replicas = int(
+            n_replicas if n_replicas is not None else _env_num(
+                "LGBM_TPU_SERVE_REPLICAS", int,
+                getattr(config, "tpu_serve_replicas", 2)))
+        self._session_kw = session_kw
+        self._models: Dict[str, _Entry] = {}
+        self._default: Optional[str] = None
+        self._lock = threading.Lock()
+        # canary + rollback policy knobs
+        self.canary_rows = int(getattr(config, "tpu_serve_canary_rows",
+                                       64) or 64)
+        self.canary_probes = int(getattr(config,
+                                         "tpu_serve_canary_probes", 16)
+                                 or 16)
+        self.canary_p99_ms = float(getattr(config,
+                                           "tpu_serve_canary_p99_ms",
+                                           0.0) or 0.0)
+        self.rollback_watch_s = float(_env_num(
+            "LGBM_TPU_SERVE_ROLLBACK_WATCH_S", float,
+            getattr(config, "tpu_serve_rollback_watch_s", 30.0)))
+        self.rollback_error_rate = float(getattr(
+            config, "tpu_serve_rollback_error_rate", 0.5) or 0.5)
+        self.rollback_degraded = int(getattr(
+            config, "tpu_serve_rollback_degraded", 2) or 2)
+        self.rollback_slo_burn = float(getattr(
+            config, "tpu_serve_rollback_slo_burn", 0.0) or 0.0)
+        self.swap_warmup = bool(getattr(config, "tpu_serve_swap_warmup",
+                                        True))
+
+    # ------------------------------------------------------------------
+    def _build_version(self, entry: _Entry, model) -> _Version:
+        vnum = entry.next_version
+        entry.next_version += 1
+        slo = float(getattr(self.config, "tpu_serve_slo_p99_ms", 250.0)
+                    or 0.0) if self.config is not None else 250.0
+        router = ReplicaRouter(
+            model, n_replicas=self.n_replicas, config=self.config,
+            name=entry.name, version=vnum,
+            metrics=ServeMetrics(slo_p99_ms=slo), **self._session_kw)
+        return _Version(vnum, router,
+                        model if isinstance(model, str)
+                        else type(model).__name__)
+
+    def add_model(self, name: str, model, canary: bool = True) -> dict:
+        """Register (and immediately serve) the first version of
+        ``name``.  The canary gate runs by default even for an initial
+        deploy — a model that cannot pass parity should never reach
+        traffic."""
+        with self._lock:
+            if name in self._models:
+                raise ValueError(
+                    f"model {name!r} already registered — use swap()")
+            entry = self._models[name] = _Entry(name)
+            if self._default is None:
+                self._default = name
+        ver = self._build_version(entry, model)
+        if canary:
+            report = self.canary_gate(ver.router)
+            ver.canary = report
+            if not report["ok"]:
+                ver.router.close()
+                with self._lock:
+                    del self._models[name]
+                    if self._default == name:
+                        self._default = next(iter(self._models), None)
+                raise SwapRejected(
+                    f"initial deploy of {name!r} failed the canary gate: "
+                    f"{report['checks']}", report)
+        with self._lock:
+            ver.state = "live"
+            entry.live = ver
+        obs.event("serve_swap", model=name, ok=True, to_version=ver.version,
+                  initial=True)
+        log.info("registry: model %r v%d live (%d replica(s))", name,
+                 ver.version, self.n_replicas)
+        return {"ok": True, "model": name, "version": ver.version,
+                "canary": ver.canary}
+
+    # ------------------------------------------------------------------
+    def canary_gate(self, router) -> dict:
+        """Validate a candidate router before it may take traffic.
+        Returns ``{"ok": bool, "checks": {...}, "p99_ms": float}``;
+        never raises (an exception inside the gate IS a failed gate)."""
+        sess = router.session
+        checks: Dict[str, bool] = {}
+        p99 = None
+        t0 = time.perf_counter()
+        try:
+            faults.check("serve_canary")
+            rng = np.random.default_rng(_CANARY_SEED)
+            X = rng.normal(size=(self.canary_rows, sess.num_features))
+            X[rng.random(X.shape) < 0.05] = np.nan
+            # chunk to the batch cap like predict() does: an oversize
+            # probe must not compile an off-bucket shape the bounded
+            # pow2 compile budget never pays for again
+            dev = np.concatenate(
+                [sess._run_device(sess.space.bin_matrix(
+                    X[lo:lo + sess.max_batch]))[0]
+                 for lo in range(0, X.shape[0], sess.max_batch)])
+            checks["finite"] = bool(np.isfinite(dev).all())
+            host = sess._run_host(X)
+            checks["parity"] = bool(np.allclose(dev, host,
+                                                atol=_CANARY_ATOL,
+                                                rtol=_CANARY_ATOL))
+            # p99 probe: single-row predicts through the real sync path
+            # (bucketed, so these compiles are the ones traffic reuses)
+            lats = []
+            for _ in range(max(self.canary_probes, 1)):
+                t = time.perf_counter()
+                sess.predict(X[:1])
+                lats.append((time.perf_counter() - t) * 1e3)
+            from ..obs.report import percentile
+            p99 = percentile(sorted(lats), 0.99)
+            checks["latency"] = (p99 <= self.canary_p99_ms
+                                 if self.canary_p99_ms > 0 else True)
+            checks["not_degraded"] = not sess._degraded
+        except Exception as exc:  # noqa: BLE001 — a failed gate, not a crash
+            checks["gate"] = False
+            report = {"ok": False, "checks": dict(checks), "p99_ms": p99,
+                      "error": f"{type(exc).__name__}: {exc}",
+                      "ms": round((time.perf_counter() - t0) * 1e3, 1)}
+            obs.event("serve_canary", model=router.name or "?",
+                      version=int(router.version or 0), ok=False,
+                      checks={k: bool(v) for k, v in checks.items()})
+            return report
+        ok = all(checks.values())
+        report = {"ok": ok, "checks": checks, "p99_ms": p99,
+                  "ms": round((time.perf_counter() - t0) * 1e3, 1)}
+        obs.event("serve_canary", model=router.name or "?",
+                  version=int(router.version or 0), ok=ok, checks=checks,
+                  **({} if p99 is None else {"p99_ms": p99}))
+        return report
+
+    # ------------------------------------------------------------------
+    def swap(self, name: str, model) -> dict:
+        """Canary-gated hot swap: pack ``model`` beside the live
+        version, gate it, atomically flip, keep the old version resident
+        for rollback, and arm the post-swap health watch.  Returns the
+        swap report; raises :class:`SwapRejected` when the gate (or an
+        injected swap fault) refuses — the old version keeps serving."""
+        entry = self._entry(name)
+        with entry.swap_lock:
+            t0 = time.perf_counter()
+            span_id = (obs.new_span_id()
+                       if obs.span_record_enabled() else None)
+            t0_wall = time.time()
+            ver = None
+            try:
+                faults.check("serve_swap")
+                ver = self._build_version(entry, model)
+                report = self.canary_gate(ver.router)
+                ver.canary = report
+                if not report["ok"]:
+                    raise SwapRejected(
+                        f"swap of {name!r} rejected by the canary gate: "
+                        f"{report.get('error') or report['checks']}",
+                        report)
+                if self.swap_warmup:
+                    # compile every bucket shape BEFORE the flip, while
+                    # the old version still serves — post-flip traffic
+                    # must never pay the candidate's XLA compiles (the
+                    # zero-cold-start half of "zero-downtime")
+                    report["warmed_buckets"] = ver.router.warmup()
+            except SwapRejected as exc:
+                self._reject(entry, ver, exc.report, t0)
+                raise
+            except Exception as exc:  # noqa: BLE001 — injected/packing fail
+                report = {"ok": False, "checks": {},
+                          "error": f"{type(exc).__name__}: {exc}"}
+                self._reject(entry, ver, report, t0)
+                raise SwapRejected(
+                    f"swap of {name!r} failed before the flip: "
+                    f"{type(exc).__name__}: {exc}", report) from exc
+            # ---- atomic flip ----------------------------------------
+            with self._lock:
+                old = entry.live
+                retired = entry.previous
+                entry.previous = old
+                if old is not None:
+                    old.state = "previous"
+                ver.state = "live"
+                ver.baseline = ver.router.metrics.snapshot()
+                if self.rollback_watch_s > 0:
+                    ver.watch_until = (time.monotonic()
+                                       + self.rollback_watch_s)
+                entry.live = ver
+                entry.swaps += 1
+            if retired is not None:
+                # the version two pushes back leaves the fleet; closing
+                # it drains its (by now idle) batchers
+                retired.state = "retired"
+                entry.history.append(retired.row())
+                retired.router.close()
+            ms = round((time.perf_counter() - t0) * 1e3, 1)
+            obs.event("serve_swap", model=name, ok=True,
+                      from_version=(old.version if old else 0),
+                      to_version=ver.version, ms=ms)
+            if span_id is not None:
+                obs.emit_span("serve/swap", t0_wall, ms,
+                              obs.new_trace_id(), span_id=span_id,
+                              attrs={"model": name,
+                                     "to_version": ver.version})
+            log.info("registry: %r v%s -> v%d live (canary p99 %.3gms, "
+                     "%s)", name,
+                     old.version if old else "-", ver.version,
+                     ver.canary.get("p99_ms") or 0,
+                     f"{self.rollback_watch_s:g}s health watch"
+                     if self.rollback_watch_s else "no health watch")
+            if ver.watch_until is not None:
+                self._start_watch(name, ver)
+            return {"ok": True, "model": name,
+                    "from_version": old.version if old else None,
+                    "to_version": ver.version, "canary": ver.canary,
+                    "ms": ms}
+
+    def _reject(self, entry: _Entry, ver: Optional[_Version],
+                report: dict, t0: float) -> None:
+        with self._lock:
+            entry.swaps_rejected += 1
+        if ver is not None:
+            ver.state = "rejected"
+            entry.history.append(ver.row())
+            ver.router.close()
+        obs.event("serve_swap", model=entry.name, ok=False,
+                  to_version=ver.version if ver else 0,
+                  ms=round((time.perf_counter() - t0) * 1e3, 1))
+        log.warning("registry: swap of %r REJECTED (%s) — previous "
+                    "version keeps serving", entry.name,
+                    report.get("error") or report.get("checks"))
+
+    # ------------------------------------------------------------------
+    def rollback(self, name: str, reason: str = "manual") -> dict:
+        """Instant flip back to the previous resident version.  The bad
+        version is closed (it may be actively broken); the flight
+        recorder dumps the moments leading up to the bounce."""
+        entry = self._entry(name)
+        with self._lock:
+            if entry.previous is None:
+                raise RuntimeError(
+                    f"model {name!r} has no previous version resident")
+            bad = entry.live
+            entry.live = entry.previous
+            entry.live.state = "live"
+            entry.live.watch_until = None
+            entry.previous = None
+            entry.rollbacks += 1
+        bad.state = "rolled_back"
+        entry.history.append(bad.row())
+        obs.event("serve_rollback", model=name,
+                  from_version=bad.version,
+                  to_version=entry.live.version, reason=reason)
+        log.warning("registry: ROLLED BACK %r v%d -> v%d (%s)", name,
+                    bad.version, entry.live.version, reason)
+        if obs.flight_enabled():
+            # same post-mortem contract as a degradation storm: the ring
+            # holds the requests/events that made the new version bounce
+            obs.flight_dump("serve_rollback",
+                            extra={"model": name,
+                                   "from_version": bad.version,
+                                   "to_version": entry.live.version,
+                                   "reason": reason})
+        bad.router.close()
+        return {"ok": True, "model": name, "from_version": bad.version,
+                "to_version": entry.live.version, "reason": reason}
+
+    # ------------------------------------------------------------------
+    def check_postswap(self, name: str) -> Optional[dict]:
+        """One post-swap health evaluation of the live version against
+        its flip-time metrics baseline.  Returns a rollback report when
+        a regression threshold tripped (and the rollback ran), the
+        string ``"watching"``/``"clear"`` wrapped in a dict otherwise.
+        Deterministically callable — the chaos matrix drives it directly
+        instead of racing the background watcher."""
+        entry = self._entry(name)
+        with self._lock:
+            ver = entry.live
+            if (ver is None or ver.baseline is None
+                    or entry.previous is None):
+                return None
+            watching = (ver.watch_until is not None
+                        and time.monotonic() < ver.watch_until)
+        snap = ver.router.metrics.snapshot()
+        base = ver.baseline
+        ok_d = snap["ok"] - base["ok"]
+        failed_d = snap["failed"] - base["failed"]
+        total = ok_d + failed_d
+        deg_d = (snap["degraded_transitions"]
+                 - base["degraded_transitions"])
+        burn = snap.get("slo_burn")
+        reason = None
+        if (total >= _POSTSWAP_MIN_REQUESTS
+                and failed_d / total > self.rollback_error_rate):
+            reason = (f"error_rate {failed_d}/{total} > "
+                      f"{self.rollback_error_rate:g}")
+        elif deg_d >= self.rollback_degraded:
+            reason = (f"degraded_transitions {deg_d} >= "
+                      f"{self.rollback_degraded}")
+        elif (self.rollback_slo_burn > 0 and burn is not None
+                and burn > self.rollback_slo_burn):
+            reason = f"slo_burn {burn:g} > {self.rollback_slo_burn:g}"
+        if reason is not None:
+            return self.rollback(name, reason=f"auto: {reason}")
+        return {"ok": True, "status": "watching" if watching else "clear",
+                "requests": total, "failed": failed_d,
+                "degraded_transitions": deg_d, "slo_burn": burn}
+
+    def _start_watch(self, name: str, ver: _Version) -> None:
+        """Background post-swap watcher: polls ``check_postswap`` until
+        the watch window closes, the version is replaced, or a rollback
+        fires.  Daemon — a hung fleet never blocks process exit."""
+        interval = max(min(self.rollback_watch_s / 10.0, 2.0), 0.05)
+
+        def watch():
+            while True:
+                time.sleep(interval)
+                entry = self._models.get(name)
+                if entry is None or entry.live is not ver:
+                    return  # replaced or rolled back already
+                if (ver.watch_until is None
+                        or time.monotonic() >= ver.watch_until):
+                    return
+                try:
+                    out = self.check_postswap(name)
+                except Exception as exc:  # noqa: BLE001 — watcher must die quietly
+                    log.warning("registry: post-swap watch of %r failed "
+                                "(%s: %s)", name, type(exc).__name__, exc)
+                    return
+                if out is not None and out.get("reason"):
+                    return  # rolled back
+
+        threading.Thread(target=watch, daemon=True,
+                         name=f"lgbm-swap-watch-{name}").start()
+
+    # ------------------------------------------------------------------
+    def _entry(self, name: Optional[str]) -> _Entry:
+        key = name or self._default
+        if key is None or key not in self._models:
+            raise UnknownModelError(name or "<default>")
+        return self._models[key]
+
+    def resolve(self, name: Optional[str]) -> _Version:
+        """The live version serving ``name`` (None = default model)."""
+        entry = self._entry(name)
+        with self._lock:
+            ver = entry.live
+        if ver is None:
+            raise UnknownModelError(name or "<default>")
+        return ver
+
+    @property
+    def default(self) -> Optional[str]:
+        return self._default
+
+    def models(self) -> List[dict]:
+        """One row per registered model (GET /models)."""
+        out = []
+        with self._lock:
+            entries = list(self._models.values())
+        for e in entries:
+            out.append({
+                "name": e.name,
+                "default": e.name == self._default,
+                "live_version": e.live.version if e.live else None,
+                "previous_version": (e.previous.version
+                                     if e.previous else None),
+                "swaps": e.swaps,
+                "swaps_rejected": e.swaps_rejected,
+                "rollbacks": e.rollbacks,
+                "versions": ([e.live.row()] if e.live else [])
+                + ([e.previous.row()] if e.previous else [])
+                + e.history[-4:],
+            })
+        return out
+
+    def submit(self, X, model: Optional[str] = None, **kw):
+        ver = self.resolve(model)
+        return ver.router.submit(X, **kw)
+
+    def submit_explain(self, X, model: Optional[str] = None, **kw):
+        ver = self.resolve(model)
+        return ver.router.submit_explain(X, **kw)
+
+    def result(self, ticket, timeout: Optional[float] = None):
+        # a RoutedTicket carries its issuing router — redemption never
+        # touches the (possibly since-swapped) live pointer, so a ticket
+        # submitted before a flip completes against the version that
+        # issued it (and keeps the router's breaker accounting)
+        return ticket.router.result(ticket, timeout)
+
+    def stats(self) -> dict:
+        with self._lock:
+            # snapshot (name, live router) pairs under the lock — a
+            # concurrent close()/failed deploy mutates _models, and a
+            # /stats scrape racing it must not 500
+            live = {name: (e.live.router if e.live else None)
+                    for name, e in self._models.items()}
+        return {m["name"]: dict(
+            m, live=(live[m["name"]].stats()
+                     if live.get(m["name"]) is not None else None))
+            for m in self.models() if m["name"] in live}
+
+    def close(self) -> None:
+        with self._lock:
+            entries = list(self._models.values())
+            self._models.clear()
+            self._default = None
+        for e in entries:
+            for v in (e.live, e.previous):
+                if v is not None:
+                    v.router.close()
+
+    def __enter__(self) -> "ModelRegistry":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -1,11 +1,21 @@
-"""Threaded JSON-over-HTTP front end for a ``PredictorSession``.
+"""Threaded JSON-over-HTTP front end for the serving plane.
 
-Stdlib ``http.server`` only — no new dependencies.  Protocol:
+Wraps a bare ``PredictorSession``, a ``ReplicaRouter``, or a full
+``ModelRegistry`` fleet.  Stdlib ``http.server`` only — no new
+dependencies.  Protocol:
 
     POST /predict   body {"rows": [[...], ...], "raw_score": false,
-                          "deadline_ms": 250}
+                          "deadline_ms": 250, "model": "name",
+                          "priority": "high|normal|low"}
                  -> 200 {"predictions": [...], "rows": N,
-                         "latency_ms": ..., "trace_id": ...}
+                         "latency_ms": ..., "trace_id": ...,
+                         "model": ..., "version": V, "replica": "rI"}
+                    — model/version/replica echoed only on a registry
+                    fleet: every response is attributable to exactly
+                    one model version (a mid-flight hot swap never
+                    changes which forest answered).  ``priority`` (or
+                    the ``X-Priority`` header) picks the load-shedding
+                    class; a shed 503 carries ``Retry-After``.
     POST /explain   body {"rows": [[...], ...], "deadline_ms": 250}
                  -> 200 {"contributions": [[...]], "rows": N,
                          "num_features": F, "num_class": K, ...}
@@ -14,9 +24,21 @@ Stdlib ``http.server`` only — no new dependencies.  Protocol:
                     device TreeSHAP kernel (explain/) through its OWN
                     microbatch queue and pow2 bucket family; 404 when
                     ``tpu_explain=false``
+    POST /models/{name}/swap      body {"model_file": path}
+                 -> 200 swap report (canary checks, versions) on a
+                    completed flip; 409 when the canary gate rejected
+                    (the previous version keeps serving untouched)
+    POST /models/{name}/rollback  body {"reason": "..."}
+                 -> 200 rollback report (instant flip to the resident
+                    previous version); 409 when none is resident
+    GET  /models       -> 200 registry listing (live/previous versions,
+                               swap/rollback counts, canary reports)
     GET  /health       -> 200 {"status": "ok"|"degraded", queue_rows,
                                uptime_s, compile_count, slo_burn,
-                               ...session stats...}
+                               ...session stats...; on a fleet also
+                               per-replica rows (breaker state,
+                               degraded planes, queue depth) and
+                               per-model status}
     GET  /metrics      -> 200 Prometheus text (request counts by status,
                                latency histogram, queue depth, occupancy,
                                pad waste, recompiles, degraded gauge,
@@ -53,6 +75,7 @@ every ``tpu_serve_reprobe_s`` seconds and a successful probe flips
 from __future__ import annotations
 
 import json
+import re
 import threading
 import time
 from concurrent.futures import TimeoutError as _FutureTimeout
@@ -62,13 +85,16 @@ import numpy as np
 
 from .. import obs
 from ..utils import log
-from .batcher import DeadlineExceeded, ServeOverloadError
-from .metrics import render_prometheus
+from .batcher import DeadlineExceeded, ServeOverloadError, \
+    normalize_priority
+from .metrics import render_prometheus, render_prometheus_fleet
 
 # grace added to a request's own deadline before the HTTP thread gives
 # up waiting on the batcher (the batch may be mid-flight on the device)
 _REPLY_GRACE_S = 30.0
 _DEFAULT_REPLY_TIMEOUT_S = 120.0
+
+_MODEL_PATH = re.compile(r"^/models/([A-Za-z0-9._-]{1,64})/(swap|rollback)$")
 
 
 def _json_safe(o):
@@ -127,37 +153,68 @@ class _Handler(BaseHTTPRequestHandler):
         self._t0 = None
         self._trace_id = None
 
-    def _reply(self, code: int, payload: dict) -> None:
+    def _reply(self, code: int, payload: dict, headers=None) -> None:
         body = json.dumps(payload, default=_json_safe).encode()
-        self._reply_bytes(code, body, "application/json")
+        self._reply_bytes(code, body, "application/json", headers=headers)
 
-    def _reply_bytes(self, code: int, body: bytes, ctype: str) -> None:
+    def _reply_bytes(self, code: int, body: bytes, ctype: str,
+                     headers=None) -> None:
         self._status = code
-        self.server.session.metrics.count_status(code)
+        try:
+            self.server.session.metrics.count_status(code)
+        except Exception:  # noqa: BLE001 — an empty registry must not 500
+            pass
         self.send_response(code)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
         if getattr(self, "_trace_id", None):
             self.send_header("X-Request-Id", self._trace_id)
+        for k, v in (headers or {}).items():
+            self.send_header(k, str(v))
         self.end_headers()
         self.wfile.write(body)
+
+    def _shed_headers(self) -> dict:
+        """503 responses tell the client when to come back — the
+        shedding contract (``tpu_serve_retry_after_s``)."""
+        return {"Retry-After":
+                "%g" % getattr(self.server, "retry_after_s", 1.0)}
 
     def do_GET(self):  # noqa: N802 — http.server API
         self._begin()
         try:
+            reg = getattr(self.server, "registry", None)
             sess = self.server.session
             path = self.path.split("?")[0].rstrip("/")
             if path in ("", "/health"):
                 st = sess.stats()
+                # fleet view: a router serves through its replicas, so
+                # "degraded" at the top level means NO replica still has
+                # a healthy device path (all-degraded), not any-replica
                 st["status"] = "degraded" if st.get("degraded") else "ok"
                 st["health_mode"] = obs.health_mode() or "off"
+                if reg is not None:
+                    st["models"] = {m["name"]: m for m in reg.models()}
                 self._reply(200, st)
             elif path == "/metrics":
-                self._reply_bytes(200, render_prometheus(sess).encode(),
+                text = (render_prometheus_fleet(reg) if reg is not None
+                        else render_prometheus(sess))
+                self._reply_bytes(200, text.encode(),
                                   "text/plain; version=0.0.4")
             elif path == "/stats":
-                self._reply(200, {"stats": sess.stats(),
-                                  "metrics": sess.metrics.snapshot()})
+                body = {"stats": sess.stats(),
+                        "metrics": sess.metrics.snapshot()}
+                if reg is not None:
+                    body["models"] = reg.stats()
+                self._reply(200, body)
+            elif path == "/models":
+                if reg is None:
+                    self._reply(404, {"error": "no_registry",
+                                      "detail": "server wraps a bare "
+                                      "session, not a model registry"})
+                else:
+                    self._reply(200, {"default": reg.default,
+                                      "models": reg.models()})
             elif path == "/debug/flight":
                 self._reply(200, {"enabled": obs.flight_enabled(),
                                   "ring_len": obs.flight_len(),
@@ -170,6 +227,13 @@ class _Handler(BaseHTTPRequestHandler):
     def do_POST(self):  # noqa: N802 — http.server API
         self._begin()
         path = self.path.split("?")[0].rstrip("/")
+        m = _MODEL_PATH.match(path)
+        if m is not None:
+            try:
+                self._do_admin(m.group(1), m.group(2))
+            finally:
+                self._end()
+            return
         if path not in ("/predict", "/explain"):
             try:
                 self._reply(404, {"error": "not_found", "path": self.path})
@@ -177,35 +241,57 @@ class _Handler(BaseHTTPRequestHandler):
                 self._end()
             return
         explain = path == "/explain"
-        sess = self.server.session
-        if explain and not getattr(sess, "explain_enabled", False):
-            try:
-                self._reply(404, {"error": "explain_disabled",
-                                  "detail": "explanation serving is off "
-                                  "(tpu_explain=false)"})
-            finally:
-                self._end()
-            return
+        reg = getattr(self.server, "registry", None)
         t0 = self._t0
         root_id = (obs.new_span_id() if obs.span_record_enabled()
                    else None)
         try:
             length = int(self.headers.get("Content-Length", 0) or 0)
             payload = json.loads(self.rfile.read(length) or b"{}")
+            # fleet routing: the body's "model" picks a registered model
+            # (default model when absent); a bare-session server ignores
+            # it.  Resolution happens HERE, once — the resolved version
+            # serves this whole request even if a swap lands mid-flight
+            model = payload.get("model")
+            version = None
+            if reg is not None:
+                from .registry import UnknownModelError
+                try:
+                    ver = reg.resolve(model)
+                except UnknownModelError:
+                    self._reply(404, {"error": "unknown_model",
+                                      "model": model})
+                    return
+                sess, model, version = ver.router, ver.router.name, \
+                    ver.version
+            else:
+                sess = self.server.session
+            if explain and not getattr(sess, "explain_enabled", False):
+                self._reply(404, {"error": "explain_disabled",
+                                  "detail": "explanation serving is off "
+                                  "(tpu_explain=false)"})
+                return
             rows = payload.get("rows")
             if rows is None:
                 raise ValueError("body needs a 'rows' matrix")
             X = np.asarray(rows, dtype=np.float64)
             deadline_ms = payload.get("deadline_ms")
+            # priority class for load shedding: body field wins, then
+            # the X-Priority header; anything unknown serves as normal
+            priority = normalize_priority(
+                payload.get("priority")
+                or self.headers.get("X-Priority"))
             if explain:
                 ticket = sess.submit_explain(X, deadline_ms=deadline_ms,
                                              trace_id=self._trace_id,
-                                             parent_id=root_id)
+                                             parent_id=root_id,
+                                             priority=priority)
             else:
                 ticket = sess.submit(
                     X, deadline_ms=deadline_ms,
                     raw_score=bool(payload.get("raw_score")),
-                    trace_id=self._trace_id, parent_id=root_id)
+                    trace_id=self._trace_id, parent_id=root_id,
+                    priority=priority)
             wait_s = (float(deadline_ms) / 1e3 + _REPLY_GRACE_S
                       if deadline_ms is not None
                       else _DEFAULT_REPLY_TIMEOUT_S)
@@ -215,6 +301,14 @@ class _Handler(BaseHTTPRequestHandler):
                 "latency_ms": round((time.perf_counter() - t0) * 1e3, 3),
                 "trace_id": self._trace_id,
             }
+            if version is not None:
+                # every response is attributable to exactly one model
+                # version (the ticket's, which survived any mid-flight
+                # swap) — the bit-consistency contract chaos_serve proves
+                body["model"] = model
+                body["version"] = int(getattr(ticket, "version", version))
+                if getattr(ticket, "replica", None) is not None:
+                    body["replica"] = f"r{ticket.replica.idx}"
             if explain:
                 # [n, F+1] (or [n, K*(F+1)] multiclass); the last column
                 # per class block is the expected value, like
@@ -226,7 +320,11 @@ class _Handler(BaseHTTPRequestHandler):
                 body["predictions"] = np.asarray(pred).tolist()
             self._reply(200, body)
         except ServeOverloadError as exc:
-            self._reply(503, {"error": "overloaded", "detail": str(exc)})
+            self._reply(503, {"error": "overloaded", "detail": str(exc),
+                              "priority": getattr(exc, "priority",
+                                                  "normal"),
+                              "shed": bool(getattr(exc, "shed", False))},
+                        headers=self._shed_headers())
         except (DeadlineExceeded, _FutureTimeout) as exc:
             self._reply(504, {"error": "deadline_exceeded",
                               "detail": str(exc)})
@@ -247,17 +345,102 @@ class _Handler(BaseHTTPRequestHandler):
                     attrs={"status": self._status, "path": path})
             self._end()
 
+    def _do_admin(self, name: str, action: str) -> None:
+        """POST /models/{name}/swap  body {"model_file": path}
+        POST /models/{name}/rollback  body {"reason": "..."} —
+        the registry's governed transitions over HTTP.  A canary-gate
+        rejection maps to 409 (the flip did not happen; the previous
+        version keeps serving)."""
+        reg = getattr(self.server, "registry", None)
+        if reg is None:
+            self._reply(404, {"error": "no_registry",
+                              "detail": "server wraps a bare session, "
+                              "not a model registry"})
+            return
+        from .registry import SwapRejected, UnknownModelError
+        try:
+            length = int(self.headers.get("Content-Length", 0) or 0)
+            payload = json.loads(self.rfile.read(length) or b"{}")
+            if action == "swap":
+                model = (payload.get("model_file")
+                         or payload.get("model"))
+                if not model:
+                    raise ValueError("swap body needs 'model_file'")
+                report = (reg.swap(name, model)
+                          if name in [m["name"] for m in reg.models()]
+                          else reg.add_model(name, model))
+                self._reply(200, report)
+            else:  # rollback
+                report = reg.rollback(
+                    name, reason=str(payload.get("reason") or "manual"))
+                self._reply(200, report)
+        except SwapRejected as exc:
+            self._reply(409, {"error": "swap_rejected",
+                              "detail": str(exc),
+                              "report": exc.report})
+        except UnknownModelError:
+            self._reply(404, {"error": "unknown_model", "model": name})
+        except (ValueError, TypeError, KeyError) as exc:
+            self._reply(400, {"error": "bad_request", "detail": str(exc)})
+        except RuntimeError as exc:
+            # rollback without a resident previous version
+            self._reply(409, {"error": "conflict", "detail": str(exc)})
+        except Exception as exc:  # noqa: BLE001 — HTTP thread must reply
+            self._reply(500, {"error": type(exc).__name__,
+                              "detail": str(exc)})
+
+
+class _FleetHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer whose ``session`` resolves through the model
+    registry at ACCESS time (so /health, /metrics and the status
+    counters always describe the CURRENT live version after a swap),
+    falling back to the bare session the server was built with."""
+
+    registry = None
+    bare_session = None
+    retry_after_s = 1.0
+
+    @property
+    def session(self):
+        if self.registry is not None:
+            return self.registry.resolve(None).router
+        return self.bare_session
+
 
 class PredictServer:
-    """Threaded HTTP server wrapping one session; ``port=0`` binds an
-    ephemeral port (read it back from ``.port`` after construction)."""
+    """Threaded HTTP server wrapping one serving target; ``port=0``
+    binds an ephemeral port (read it back from ``.port``).
 
-    def __init__(self, session, host: str = "127.0.0.1", port: int = 0):
-        self.session = session
-        self._httpd = ThreadingHTTPServer((host, int(port)), _Handler)
+    The target may be a bare ``PredictorSession`` (the original
+    single-model surface), a ``ReplicaRouter``, or a ``ModelRegistry``
+    — a registry additionally arms the fleet endpoints (``GET /models``,
+    ``POST /models/{name}/swap`` and ``/models/{name}/rollback``,
+    per-model ``/health`` blocks, ``model``/``version`` echo on every
+    prediction)."""
+
+    def __init__(self, target, host: str = "127.0.0.1", port: int = 0):
+        is_registry = (hasattr(target, "resolve")
+                       and hasattr(target, "swap"))
+        self.registry = target if is_registry else None
+        self._httpd = _FleetHTTPServer((host, int(port)), _Handler)
         self._httpd.daemon_threads = True
-        self._httpd.session = session
+        if is_registry:
+            self._httpd.registry = target
+            cfg = getattr(target, "config", None)
+            self._httpd.retry_after_s = float(
+                getattr(cfg, "tpu_serve_retry_after_s", 1.0) or 1.0)
+        else:
+            self._httpd.bare_session = target
+            cfg = getattr(target, "config", None)
+            if not isinstance(cfg, dict):
+                self._httpd.retry_after_s = float(
+                    getattr(cfg, "tpu_serve_retry_after_s", 1.0) or 1.0)
         self._thread = None
+
+    @property
+    def session(self):
+        """The current serving target (post-swap: the NEW live router)."""
+        return self._httpd.session
 
     @property
     def host(self) -> str:
@@ -290,7 +473,10 @@ class PredictServer:
             self._thread.join(5.0)
             self._thread = None
         if close_session:
-            self.session.close()
+            # a registry owns (and closes) every resident version; a
+            # bare session/router closes itself
+            (self.registry if self.registry is not None
+             else self.session).close()
 
     def serve_forever(self) -> None:
         """Blocking CLI entry: run until interrupted, then drain the

@@ -46,7 +46,7 @@ from ..config import Config
 from ..robust import faults
 from ..utils import log
 from .batcher import (DeadlineExceeded, MicroBatcher, Request,
-                      ServeOverloadError)
+                      ServeOverloadError, normalize_priority)
 from .metrics import ServeMetrics
 from .packing import ServeBinSpace
 
@@ -92,16 +92,18 @@ class Ticket:
     which accounting stream (latency histogram, events) the ticket's
     outcome lands in."""
 
-    __slots__ = ("parts", "rows", "raw_score", "t0", "counted", "kind")
+    __slots__ = ("parts", "rows", "raw_score", "t0", "counted", "kind",
+                 "priority")
 
     def __init__(self, parts, rows: int, raw_score: bool,
-                 kind: str = "predict"):
+                 kind: str = "predict", priority: str = "normal"):
         self.parts = parts          # [(future, n_rows), ...]
         self.rows = rows
         self.raw_score = raw_score
         self.t0 = time.perf_counter()
         self.counted = False        # request-level stats recorded once
         self.kind = kind
+        self.priority = priority
 
 
 class PredictorSession:
@@ -110,8 +112,18 @@ class PredictorSession:
     def __init__(self, model, config=None, num_iteration: Optional[int] = None,
                  start_iteration: int = 0, max_batch: Optional[int] = None,
                  max_wait_ms: Optional[float] = None,
-                 queue_depth: Optional[int] = None):
+                 queue_depth: Optional[int] = None,
+                 metrics: Optional[ServeMetrics] = None,
+                 device=None):
         gbdt = model
+        # fleet identity (serve/router.py + serve/registry.py stamp
+        # these): which model/version/replica this session serves, and an
+        # optional pinned device (per-device replicas on a multi-chip
+        # host; None = the backend default)
+        self.model_name: Optional[str] = None
+        self.model_version: Optional[int] = None
+        self.replica_id: Optional[str] = None
+        self._device = device
         if isinstance(model, str):
             from ..io.model_io import load_model_file
             gbdt, loaded_cfg = load_model_file(model)
@@ -228,7 +240,11 @@ class PredictorSession:
         self.slo_p99_ms = float(_env_num(
             "LGBM_TPU_SERVE_SLO_P99_MS", float,
             getattr(config, "tpu_serve_slo_p99_ms", 250.0)))
-        self.metrics = ServeMetrics(slo_p99_ms=self.slo_p99_ms)
+        # replicas of one model version share ONE ServeMetrics (the
+        # router passes it in) so the fleet's latency histogram and
+        # shed counters aggregate without a merge step
+        self.metrics = (metrics if metrics is not None
+                        else ServeMetrics(slo_p99_ms=self.slo_p99_ms))
         # probe-and-recover: while degraded, re-try the device every
         # reprobe_s seconds so a transient backend error is not a
         # one-way latch (0 disables — the pre-ISSUE-7 behavior)
@@ -243,10 +259,22 @@ class PredictorSession:
                 getattr(config, "tpu_flight_len", 256)))
         self._overload_times: deque = deque(maxlen=_STORM_N)
         self._last_flight_dump = None  # monotonic() of the last dump
+        # priority shedding (serve/batcher.py): per-class queue budgets
+        # so overload drops low-priority bulk traffic before interactive
+        # requests
+        self._shed_fracs = {
+            "low": float(_env_num(
+                "LGBM_TPU_SERVE_SHED_LOW_FRAC", float,
+                getattr(config, "tpu_serve_shed_low_frac", 0.5))),
+            "normal": float(_env_num(
+                "LGBM_TPU_SERVE_SHED_NORMAL_FRAC", float,
+                getattr(config, "tpu_serve_shed_normal_frac", 0.85))),
+        }
         self._batcher = MicroBatcher(
             self._execute_batch, max_batch=self.max_batch,
             max_wait_s=self.max_wait_ms / 1e3,
-            max_queue_rows=self.queue_depth)
+            max_queue_rows=self.queue_depth,
+            shed_fracs=self._shed_fracs)
         if obs.enabled():
             obs.event("serve_start", trees=self.num_trees, num_class=K,
                       num_features=F, max_batch=self.max_batch,
@@ -290,10 +318,14 @@ class PredictorSession:
                 [bins, np.zeros((b - n, bins.shape[1]), bins.dtype)])
         with self._lock:
             self._buckets.add(b)
-        arr = jnp.asarray(bins)
         t_exec0 = time.time()
         faults.check("serve_device")
-        out = self._device_fn(self.forest, arr)
+        if self._device is not None:
+            import jax
+            with jax.default_device(self._device):
+                out = self._device_fn(self.forest, jnp.asarray(bins))
+        else:
+            out = self._device_fn(self.forest, jnp.asarray(bins))
         raw = np.asarray(out, dtype=np.float64)[:n]
         if self.average_factor:
             raw /= self.average_factor
@@ -408,10 +440,12 @@ class PredictorSession:
                  "degraded mode, device explanations resume")
         return True
 
-    def _note_overload(self, rows: int, queue_rows: int) -> None:
+    def _note_overload(self, rows: int, queue_rows: int,
+                       priority: str = "normal") -> None:
         """Shared overload accounting for both submit paths: counter,
-        event, and the storm check (>= _STORM_N rejects inside
-        _STORM_WINDOW_S dumps the flight ring once per cooldown)."""
+        per-priority shed count, event, and the storm check (>= _STORM_N
+        rejects inside _STORM_WINDOW_S dumps the flight ring once per
+        cooldown)."""
         storm = False
         now = time.monotonic()
         with self._lock:
@@ -419,7 +453,16 @@ class PredictorSession:
             self._overload_times.append(now)
             storm = (len(self._overload_times) == _STORM_N
                      and now - self._overload_times[0] <= _STORM_WINDOW_S)
-        obs.event("serve_overload", rows=int(rows), queue_rows=queue_rows)
+        if self.replica_id is None:
+            # shed counters mean CLIENT-VISIBLE rejections.  A fleet
+            # replica's queue-full may still be served by a sibling
+            # (failover spill), so inside a router the ROUTER counts the
+            # shed — exactly once, on final rejection — while the
+            # per-replica serve_overload event below keeps the
+            # queue-level diagnostic
+            self.metrics.count_shed(priority)
+        obs.event("serve_overload", rows=int(rows), queue_rows=queue_rows,
+                  priority=priority)
         if storm:
             self._flight_dump("overload_storm")
 
@@ -502,7 +545,8 @@ class PredictorSession:
                     max_batch=self.explain_max_batch,
                     max_wait_s=self.explain_max_wait_ms / 1e3,
                     max_queue_rows=self.queue_depth,
-                    name="lgbm-serve-explain")
+                    name="lgbm-serve-explain",
+                    shed_fracs=self._shed_fracs)
                 self._explain = (forest, arrays, fn, batcher)
         return self._explain
 
@@ -541,10 +585,17 @@ class PredictorSession:
                 [bins, np.zeros((b - n, bins.shape[1]), bins.dtype)])
         with self._lock:
             self._explain_buckets.add(b)
-        arr = jnp.asarray(bins)
         t_exec0 = time.time()
-        faults.check("serve_device")
-        out = fn(forest, arrays, arr)
+        # the explain plane's OWN injection point (ISSUE 10): a wedge in
+        # the TreeSHAP kernel must be injectable without touching the
+        # predict plane, or the degrade-isolation contract is untestable
+        faults.check("serve_explain_device")
+        if self._device is not None:
+            import jax
+            with jax.default_device(self._device):
+                out = fn(forest, arrays, jnp.asarray(bins))
+        else:
+            out = fn(forest, arrays, jnp.asarray(bins))
         contrib = np.asarray(out, dtype=np.float64)[:n]
         if span_ctx:
             t_end = time.time()
@@ -619,13 +670,20 @@ class PredictorSession:
 
     def submit_explain(self, X, deadline_ms: Optional[float] = None,
                        trace_id: Optional[str] = None,
-                       parent_id: Optional[str] = None) -> Ticket:
+                       parent_id: Optional[str] = None,
+                       priority: str = "normal") -> Ticket:
         """Queue rows for the next coalesced TreeSHAP batch — the
-        explain analog of ``submit`` (same chunking, deadline and
-        backpressure semantics, its own queue + bucket family)."""
+        explain analog of ``submit`` (same chunking, deadline,
+        backpressure and priority-shedding semantics, its own queue +
+        bucket family)."""
         X = self._check_input(X)
         if self._closed:
             raise RuntimeError("session is closed")
+        # explain-plane injection point (ISSUE 10): a fault here models
+        # an admission-side failure (bad pack state, OOM on metadata)
+        # distinct from the device kernel's
+        faults.check("serve_explain_submit")
+        priority = normalize_priority(priority)
         _, _, _, batcher = self._ensure_explain()
         if trace_id is None and obs.span_record_enabled():
             trace_id = obs.new_trace_id()
@@ -638,14 +696,16 @@ class PredictorSession:
                 chunk = X[lo:lo + self.explain_max_batch]
                 req = Request(self.space.bin_matrix(chunk), chunk,
                               deadline=deadline, trace_id=trace_id,
-                              parent_id=parent_id)
+                              parent_id=parent_id, priority=priority)
                 parts.append((batcher.submit(req), chunk.shape[0]))
         except ServeOverloadError:
-            self._note_overload(X.shape[0], batcher.queue_rows)
+            self._note_overload(X.shape[0], batcher.queue_rows,
+                                priority=priority)
             for fut, _ in parts:  # a partially queued ticket must not leak
                 fut.cancel()
             raise
-        return Ticket(parts, int(X.shape[0]), False, kind="explain")
+        return Ticket(parts, int(X.shape[0]), False, kind="explain",
+                      priority=priority)
 
     def _execute_explain_batch(self, reqs) -> None:
         """Explain batcher callback: expire, coalesce, pad, dispatch the
@@ -726,7 +786,8 @@ class PredictorSession:
                   queue_rows=batcher.queue_rows if batcher else 0,
                   exec_ms=round(exec_ms, 3), degraded=degraded)
 
-    def _note_explain_request(self, rows: int, total_ms: float) -> None:
+    def _note_explain_request(self, rows: int, total_ms: float,
+                              priority: str = "normal") -> None:
         with self._lock:
             self._n_explain += 1
             self._n_explain_ok += 1
@@ -734,16 +795,20 @@ class PredictorSession:
             if len(self._xlat_ms) > _LAT_RESERVOIR:
                 del self._xlat_ms[:_LAT_RESERVOIR // 2]
         self.metrics.observe_explain(total_ms, ok=True)
+        self.metrics.count_served(priority)
         obs.event("explain_request", rows=int(rows),
                   total_ms=round(total_ms, 3), ok=True)
 
     # ------------------------------------------------------------------
     def submit(self, X, deadline_ms: Optional[float] = None,
                raw_score: bool = False, trace_id: Optional[str] = None,
-               parent_id: Optional[str] = None) -> Ticket:
+               parent_id: Optional[str] = None,
+               priority: str = "normal") -> Ticket:
         """Queue rows for the next coalesced batch.  Raises
         ``ServeOverloadError`` when the bounded queue is full (explicit
-        backpressure).  Oversize submissions are chunked to the batch
+        backpressure) — or when this request's ``priority`` class has
+        exhausted its share of the queue budget (load shedding: low
+        sheds first).  Oversize submissions are chunked to the batch
         cap; a chunk is never split across device batches.  ``trace_id``
         /``parent_id`` thread the request's trace context through the
         batcher (the HTTP edge mints them from ``X-Request-Id``); a
@@ -751,6 +816,7 @@ class PredictorSession:
         X = self._check_input(X)
         if self._closed:
             raise RuntimeError("session is closed")
+        priority = normalize_priority(priority)
         if trace_id is None and obs.span_record_enabled():
             trace_id = obs.new_trace_id()
         deadline = (time.monotonic() + float(deadline_ms) / 1e3
@@ -761,14 +827,16 @@ class PredictorSession:
                 chunk = X[lo:lo + self.max_batch]
                 req = Request(self.space.bin_matrix(chunk), chunk,
                               deadline=deadline, trace_id=trace_id,
-                              parent_id=parent_id)
+                              parent_id=parent_id, priority=priority)
                 parts.append((self._batcher.submit(req), chunk.shape[0]))
         except ServeOverloadError:
-            self._note_overload(X.shape[0], self._batcher.queue_rows)
+            self._note_overload(X.shape[0], self._batcher.queue_rows,
+                                priority=priority)
             for fut, _ in parts:  # a partially queued ticket must not leak
                 fut.cancel()
             raise
-        return Ticket(parts, int(X.shape[0]), raw_score)
+        return Ticket(parts, int(X.shape[0]), raw_score,
+                      priority=priority)
 
     def result(self, ticket: Ticket, timeout: Optional[float] = None
                ) -> np.ndarray:
@@ -794,11 +862,13 @@ class PredictorSession:
         if ticket.kind == "explain":
             if not ticket.counted:
                 ticket.counted = True
-                self._note_explain_request(ticket.rows, total_ms)
+                self._note_explain_request(ticket.rows, total_ms,
+                                           priority=ticket.priority)
             return self._convert_explain(raw)
         if not ticket.counted:
             ticket.counted = True
-            self._note_request(ticket.rows, total_ms)
+            self._note_request(ticket.rows, total_ms,
+                               priority=ticket.priority)
         return self._convert(raw, ticket.raw_score)
 
     def _note_failure(self, ticket: Ticket, exc: BaseException) -> None:
@@ -919,7 +989,8 @@ class PredictorSession:
                 f"as it was in training data ({self.num_features})")
         return X
 
-    def _note_request(self, rows: int, total_ms: float) -> None:
+    def _note_request(self, rows: int, total_ms: float,
+                      priority: str = "normal") -> None:
         with self._lock:
             self._n_req += 1
             self._n_ok += 1
@@ -927,6 +998,7 @@ class PredictorSession:
             if len(self._lat_ms) > _LAT_RESERVOIR:
                 del self._lat_ms[:_LAT_RESERVOIR // 2]
         self.metrics.observe(total_ms, ok=True)
+        self.metrics.count_served(priority)
         obs.event("serve_request", rows=int(rows),
                   total_ms=round(total_ms, 3), ok=True)
 
@@ -995,6 +1067,11 @@ class PredictorSession:
                 "reprobe_s": self.reprobe_s or None,
                 "degraded_transitions": self.metrics.degraded_transitions,
                 "recoveries": self.metrics.recoveries,
+                # fleet identity (None outside a router/registry): which
+                # model version this session's numbers belong to
+                "model": self.model_name,
+                "version": self.model_version,
+                "replica": self.replica_id,
             }
 
     def close(self) -> None:

@@ -232,6 +232,65 @@ def test_bench_history_mode_regressions(tmp_path):
     assert bh2.find_mode_regressions(rows2) == []
 
 
+def _serve_round(n, blip=None, steady=None, rollbacks=0):
+    return {"n": n, "parsed": {
+        "kind": "serve", "backend": "cpu", "trees": 20, "max_batch": 256,
+        "closed": {"rows_per_s": 5000.0, "p50_ms": 5.0, "p99_ms": 20.0},
+        "open": {"p99_ms": 25.0},
+        "server": {"p99_ms": 18.0, "slo_burn": 0.1},
+        "occupancy": 0.9, "compiles": 10,
+        "swap": {"swap_blip_p99_ms": blip, "steady_p99_ms": steady,
+                 "rollbacks": rollbacks}}}
+
+
+def test_bench_history_swap_blip_flag(tmp_path):
+    """A hot-swap blip p99 worse than 2x the steady p99 (and any
+    rollback during the swap leg) is flagged on the serving round —
+    categorical, like mode regressions, because a blip can double while
+    the steady p99 improves."""
+    sys.path.insert(0, TOOLS)
+    try:
+        import bench_history as bh
+    finally:
+        sys.path.remove(TOOLS)
+    with open(tmp_path / "SERVE_r01.json", "w") as fh:
+        json.dump(_serve_round(1, blip=90.0, steady=20.0, rollbacks=1),
+                  fh)
+    with open(tmp_path / "SERVE_r02.json", "w") as fh:
+        json.dump(_serve_round(2, blip=30.0, steady=20.0), fh)
+    rows = bh.collect([str(tmp_path)])
+    assert rows[0]["metrics"]["serve_swap_blip_p99_ms"] == 90.0
+    assert rows[0]["swap_blip"] == 4.5
+    assert "rollback" in rows[0]["note"]
+    assert "swap_blip" not in rows[1]          # 1.5x steady: no flag
+    blips = bh.find_swap_blips(rows)
+    assert [b["round"] for b in blips] == ["r01"]
+    text = bh.render(rows, [], [], blips)
+    assert "SWAP BLIPS" in text and "4.5x" in text
+
+
+def test_run_suite_chaos_tier_stubbed():
+    """The chaos tier wraps chaos_serve.py --json; its check map becomes
+    the tier's counts and it rides the default tier list."""
+    rs = _import_tool("run_suite")
+    assert "chaos" in rs._TOOL_TIERS
+
+    def fake(argv, **kw):
+        import types
+        assert any(isinstance(a, str) and "chaos_serve.py" in a
+                   for a in argv)
+        line = json.dumps({"kind": "chaos_serve", "ok": True,
+                           "checks": {"wedge.all_served": True,
+                                      "swap.zero_loss": True,
+                                      "rollback.triggered": True}})
+        return types.SimpleNamespace(returncode=0, stdout=line + "\n",
+                                     stderr="")
+
+    res = rs.run_tool_smoke("chaos", 60, runner=fake)
+    assert res["ok"] is True
+    assert res["counts"] == {"passed": 3, "failed": 0}
+
+
 def test_bench_history_cli_exit_codes(tmp_path, monkeypatch, capsys):
     tool = os.path.join(TOOLS, "bench_history.py")
     for i, r in enumerate([_bench_round(1, 2000.0, 0.5),

@@ -82,7 +82,19 @@ _DIRECTIONS = [
     ("serve_server_p99_ms", False),
     ("serve_slo_burn", False),
     ("serve_client_server_skew", False),
+    # hot-swap leg (ISSUE 10, bench_serve.py swap_leg): the p99 of
+    # requests completing inside the swap window, the steady-state p99
+    # beside it, and how many swaps bounced to a rollback
+    ("serve_swap_blip_p99_ms", False),
+    ("serve_steady_p99_ms", False),
+    ("serve_rollbacks", False),
 ]
+
+# a swap blip worse than this multiple of the steady p99 is flagged: the
+# hot swap is supposed to be invisible to traffic — a 2x p99 excursion
+# means the flip (pack/canary/fresh-bucket compiles) is leaking into the
+# request path
+_SWAP_BLIP_FLAG = 2.0
 
 # the headline columns of the human table, in order
 _TABLE_COLS = ["value", "vs_baseline", "per_iter_s", "compile_s",
@@ -152,6 +164,28 @@ def load_round(path: str) -> dict:
                         ("jax_compiles", parsed.get("compiles"))):
             if isinstance(v, (int, float)) and not isinstance(v, bool):
                 row["metrics"][name] = float(v)
+        # hot-swap leg (bench_serve.py swap_leg): blip vs steady p99 +
+        # rollback count.  A blip worse than _SWAP_BLIP_FLAG x steady is
+        # flagged here so a leaky flip is visible in the table even
+        # before the regression pass runs
+        sw = parsed.get("swap") or {}
+        for name, v in (("serve_swap_blip_p99_ms",
+                         sw.get("swap_blip_p99_ms")),
+                        ("serve_steady_p99_ms", sw.get("steady_p99_ms")),
+                        ("serve_rollbacks", sw.get("rollbacks"))):
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                row["metrics"][name] = float(v)
+        blip = row["metrics"].get("serve_swap_blip_p99_ms")
+        steady = row["metrics"].get("serve_steady_p99_ms")
+        if blip and steady and blip > _SWAP_BLIP_FLAG * steady:
+            row["swap_blip"] = round(blip / steady, 2)
+            row["note"] = ((row.get("note", "") + "; ")
+                           if row.get("note") else "") + \
+                f"swap blip p99 {blip / steady:.1f}x steady p99"
+        if sw.get("rollbacks"):
+            row["note"] = ((row.get("note", "") + "; ")
+                           if row.get("note") else "") + \
+                f"{sw['rollbacks']} rollback(s) during the swap leg"
         # client-vs-server p99 skew: the server-side number (session
         # submit->result) excludes HTTP/network and client queueing — a
         # big ratio means latency is accumulating OUTSIDE the session
@@ -337,6 +371,19 @@ def find_mode_regressions(rows: List[dict]) -> List[dict]:
     return out
 
 
+def find_swap_blips(rows: List[dict]) -> List[dict]:
+    """Serving rounds whose hot-swap blip p99 exceeded
+    ``_SWAP_BLIP_FLAG`` x their steady p99 (stamped by ``load_round``),
+    reported like mode regressions: categorical flags the numeric
+    threshold pass would miss (a blip can double while the steady p99
+    improves)."""
+    return [{"metric": "swap_blip_p99_ms", "round": r["round"],
+             "value": r["metrics"].get("serve_swap_blip_p99_ms"),
+             "steady": r["metrics"].get("serve_steady_p99_ms"),
+             "ratio": r["swap_blip"]}
+            for r in rows if r.get("swap_blip")]
+
+
 def canary_trend(rows: List[dict]) -> List[dict]:
     """per_iter_s + throughput trajectory across CANARY rounds of the
     same context.  Canaries never enter regression baselines
@@ -367,7 +414,8 @@ def canary_trend(rows: List[dict]) -> List[dict]:
 
 
 def render(rows: List[dict], regressions: List[dict],
-           mode_regressions: List[dict] = ()) -> str:
+           mode_regressions: List[dict] = (),
+           swap_blips: List[dict] = ()) -> str:
     cols = [c for c in _TABLE_COLS
             if any(c in r["metrics"] for r in rows)]
     out = [f"{'round':<6}{'context':<34}"
@@ -405,6 +453,13 @@ def render(rows: List[dict], regressions: List[dict],
         for g in mode_regressions:
             out.append(f"  {g['metric']:<32} {g['value']} vs "
                        f"{g['prior']} ({g['prior_round']})")
+    if swap_blips:
+        out.append("")
+        out.append(f"SWAP BLIPS (hot-swap p99 > {_SWAP_BLIP_FLAG:g}x "
+                   "steady p99 — the flip leaked into the request path):")
+        for g in swap_blips:
+            out.append(f"  {g['round']}: blip {g['value']:g}ms vs steady "
+                       f"{g['steady']:g}ms ({g['ratio']:g}x)")
     trend = [t for t in canary_trend(rows)
              if "per_iter_s_change_frac" in t or "value_change_frac" in t]
     if trend:
@@ -447,13 +502,16 @@ def main() -> int:
         return 1
     regressions = find_regressions(rows, args.threshold)
     mode_regressions = find_mode_regressions(rows)
+    swap_blips = find_swap_blips(rows)
     if args.json:
         print(json.dumps({"rounds": rows, "regressions": regressions,
                           "mode_regressions": mode_regressions,
+                          "swap_blips": swap_blips,
                           "canary_trend": canary_trend(rows)}))
     else:
-        print(render(rows, regressions, mode_regressions))
-    if (regressions or mode_regressions) and args.fail_on_regression:
+        print(render(rows, regressions, mode_regressions, swap_blips))
+    if ((regressions or mode_regressions or swap_blips)
+            and args.fail_on_regression):
         return 1
     return 0
 

@@ -13,11 +13,19 @@ answers "how does it serve" — the serve/ subsystem's round artifact:
    Poisson arrivals are ``submit_explain`` TreeSHAP requests riding
    their own microbatch queue — the mixed-load leg that writes
    ``explain_p99`` into the artifact.
-3. **HTTP smoke** (``--smoke``): starts ``PredictServer`` in-process,
+3. **swap leg** (default on; ``SERVE_SWAP=0`` disables): a multi-model
+   Poisson mix over a registry fleet (models ``a``+``b``,
+   ``SERVE_REPLICAS`` sessions each) with a canary-gated hot swap of
+   model ``a`` mid-run — records ``swap_blip_p99_ms`` (p99 of requests
+   completing inside the swap window) vs ``steady_p99_ms`` and the
+   rollback count; ``bench_history.py`` trends both and flags a blip
+   worse than 2x steady.
+4. **HTTP smoke** (``--smoke``): starts ``PredictServer`` in-process,
    fires concurrent mixed-size POST /predict + GET /health, then
    asserts p99 recorded, the compile count bounded by the pow2 bucket
-   set (<= ceil(log2(max_batch)) + 1), and a clean shutdown.  This is
-   the ``serve`` leg ``tools/run_suite.py`` runs in CI.
+   set (<= ceil(log2(max_batch)) + 1), zero request loss across the
+   swap leg, and a clean shutdown.  This is the ``serve`` leg
+   ``tools/run_suite.py`` runs in CI.
 
 Writes ``SERVE_r{N}.json`` (``--out``/``--round``; ``--json`` prints the
 record instead) which ``tools/bench_history.py`` folds into the
@@ -45,6 +53,7 @@ import sys
 import tempfile
 import threading
 import time
+from typing import Optional
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
@@ -82,16 +91,19 @@ def knobs(smoke: bool) -> dict:
     )
 
 
-def build_model(k: dict, workdir: str) -> str:
+def build_model(k: dict, workdir: str, name: str = "serve_bench_model.txt",
+                num_leaves: int = 31, trees: Optional[int] = None,
+                seed: int = 7) -> str:
     """Train a small binary model (NaN-heavy + categorical, so the bench
     exercises the full binning surface) and save it; or reuse
-    SERVE_MODEL."""
-    if k["model"]:
+    SERVE_MODEL.  ``name``/``num_leaves``/``trees``/``seed`` let the
+    swap leg train model VARIANTS over the same feature space."""
+    if k["model"] and name == "serve_bench_model.txt":
         return k["model"]
     import numpy as np
 
     import lightgbm_tpu as lgb
-    rng = np.random.default_rng(7)
+    rng = np.random.default_rng(seed)
     F = k["features"]
     Xnum = rng.normal(size=(k["rows"], F - 1))
     Xnum[rng.random(Xnum.shape) < 0.05] = np.nan
@@ -99,11 +111,12 @@ def build_model(k: dict, workdir: str) -> str:
     X = np.hstack([Xnum, Xcat])
     y = ((np.nan_to_num(Xnum[:, 0]) + 0.25 * (Xcat[:, 0] % 3)) > 0
          ).astype(np.float64)
-    params = {"objective": "binary", "num_leaves": 31, "verbose": -1,
-              "min_data_in_leaf": 5}
+    params = {"objective": "binary", "num_leaves": num_leaves,
+              "verbose": -1, "min_data_in_leaf": 5}
     ds = lgb.Dataset(X, label=y, categorical_feature=[F - 1], params=params)
-    bst = lgb.train(params, ds, num_boost_round=k["trees"])
-    path = os.path.join(workdir, "serve_bench_model.txt")
+    bst = lgb.train(params, ds,
+                    num_boost_round=trees if trees else k["trees"])
+    path = os.path.join(workdir, name)
     bst.save_model(path)
     return path
 
@@ -319,6 +332,120 @@ def http_smoke(server, Xpool, k: dict) -> dict:
             "poll_errors": poll["errors"][:5]}
 
 
+def swap_leg(k: dict, workdir: str, model_a: str) -> dict:
+    """Multi-model Poisson mix with a hot-swap mid-run (ROADMAP item 3):
+    two models serve behind the registry, Poisson arrivals split across
+    them, and halfway through model 'a' hot-swaps to a retrained
+    variant.  The artifact records ``swap_blip_p99_ms`` — the p99 of
+    requests completing inside the swap window (pack + canary + flip +
+    fresh-bucket compiles) — against ``steady_p99_ms``, plus the
+    registry's rollback count.  ``bench_history.py`` trends both and
+    flags a blip worse than 2x steady."""
+    import numpy as np
+    from lightgbm_tpu.serve import ModelRegistry, ServeOverloadError
+    model_b = build_model(k, workdir, name="serve_bench_model_b.txt",
+                          num_leaves=15, seed=11)
+    model_a2 = build_model(k, workdir, name="serve_bench_model_a2.txt",
+                           num_leaves=23, seed=13)
+    reps = _env("SERVE_REPLICAS", int, 1)
+    reg = ModelRegistry(n_replicas=reps, max_batch=k["max_batch"],
+                        max_wait_ms=2.0)
+    reg.add_model("a", model_a)
+    reg.add_model("b", model_b)
+    for name in ("a", "b"):
+        reg.resolve(name).router.warmup()
+    rng = np.random.default_rng(23)
+    F = k["features"]
+    Xpool = np.hstack([rng.normal(size=(2048, F - 1)),
+                       rng.integers(-1, 20, size=(2048, 1)
+                                    ).astype(np.float64)])
+    lock = threading.Lock()
+    done = []            # (t_complete, lat_ms, ok)
+    pending = []
+    overloads = 0
+    n_sent = 0
+    by_model = {"a": 0, "b": 0}
+    duration = k["duration_s"] * 2
+    t_begin = time.perf_counter()
+    stop_at = t_begin + duration
+    swap_at = t_begin + duration / 2
+    swap_info = {}
+
+    def do_swap():
+        t0 = time.perf_counter()
+        try:
+            rep = reg.swap("a", model_a2)
+            swap_info.update(ok=bool(rep.get("ok")),
+                             to_version=rep.get("to_version"))
+        except Exception as exc:  # noqa: BLE001 — leg must finish
+            swap_info.update(ok=False,
+                             error=f"{type(exc).__name__}: {exc}")
+        swap_info.update(t0=t0, t1=time.perf_counter())
+
+    swap_thread = None
+    while time.perf_counter() < stop_at:
+        time.sleep(rng.exponential(1.0 / max(k["rate"], 1e-6)))
+        if swap_thread is None and time.perf_counter() >= swap_at:
+            swap_thread = threading.Thread(target=do_swap)
+            swap_thread.start()
+        model = "a" if rng.random() < 0.7 else "b"
+        n = _request_sizes(rng, k["max_batch"])
+        lo = int(rng.integers(0, max(Xpool.shape[0] - n, 1)))
+        t0 = time.perf_counter()
+        try:
+            ticket = reg.submit(Xpool[lo:lo + n], model=model)
+        except ServeOverloadError:
+            overloads += 1
+            continue
+        n_sent += 1
+        by_model[model] += 1
+
+        def cb(fut, t0=t0):
+            with lock:
+                done.append((time.perf_counter(),
+                             (time.perf_counter() - t0) * 1e3,
+                             fut.exception() is None))
+        for fut, _ in ticket.parts:
+            fut.add_done_callback(cb)
+            pending.append(fut)
+    if swap_thread is None:
+        do_swap()
+    else:
+        swap_thread.join(120)
+    deadline = time.time() + 60
+    for fut in pending:
+        try:
+            fut.result(max(deadline - time.time(), 0.1))
+        except Exception:  # noqa: BLE001 — cb already counted it
+            pass
+    s0, s1 = swap_info.get("t0", swap_at), swap_info.get("t1", swap_at)
+    with lock:
+        # steady = completions strictly BEFORE the swap began (a clean
+        # baseline no flip cost can pollute); blip = completions from
+        # swap start until 1s past the flip — where pack/canary/warmup
+        # contention and any leaked compiles would land
+        steady = [lat for t, lat, ok in done if ok and t < s0]
+        blip = [lat for t, lat, ok in done if ok and s0 <= t <= s1 + 1.0]
+        failures = sum(1 for _, _, ok in done if not ok)
+    rollbacks = sum(m["rollbacks"] for m in reg.models())
+    reg.close()
+    sp50, sp99 = _percentiles(steady)
+    _, bp99 = _percentiles(blip)
+    return {
+        "rate_rps": k["rate"], "requests": n_sent,
+        "completed": len(done), "failures": failures,
+        "overloads": overloads, "by_model": by_model,
+        "replicas": reps,
+        "swap_ok": swap_info.get("ok"),
+        "swap_error": swap_info.get("error"),
+        "swap_ms": round((s1 - s0) * 1e3, 1),
+        "swap_window_requests": len(blip),
+        "steady_p50_ms": sp50, "steady_p99_ms": sp99,
+        "swap_blip_p99_ms": bp99,
+        "rollbacks": rollbacks,
+    }
+
+
 def scrape_metrics(server) -> dict:
     """One end-of-run /metrics scrape, parsed (the server-side view
     embedded in SERVE_rN.json next to the client-observed numbers)."""
@@ -451,6 +578,12 @@ def main(argv=None) -> int:
         record["buckets"] = st["buckets"]
         record["degraded"] = st["degraded"]
         record["batcher_alive"] = sess._batcher._thread.is_alive()
+        if _env("SERVE_SWAP", int, 1):
+            # multi-model Poisson mix + hot-swap mid-run: its own
+            # registry/fleet, run AFTER the single-session compile
+            # accounting above (the fleet's packs/warmups must not
+            # count against the session's pow2 bucket budget)
+            record["swap"] = swap_leg(k, workdir, model_path)
 
     if args.smoke:
         checks = {
@@ -490,6 +623,21 @@ def main(argv=None) -> int:
                     len(x.get("explain_buckets") or [])
                     <= x.get("compile_bound", 0),
             })
+        if record.get("swap"):
+            sw = record["swap"]
+            checks.update({
+                # the hot swap completed and cost zero requests: every
+                # Poisson arrival admitted before/during/after the flip
+                # resolved successfully (the zero-in-flight-loss
+                # contract), no rollback fired, and the blip p99 was
+                # measurable
+                "swap_ok": bool(sw.get("swap_ok")),
+                "swap_no_request_loss": sw.get("failures") == 0
+                and sw.get("completed", 0) > 0,
+                "swap_no_rollback": sw.get("rollbacks") == 0,
+                "swap_steady_p99_recorded":
+                    sw.get("steady_p99_ms") is not None,
+            })
         record["checks"] = checks
         record["ok"] = all(checks.values())
         print(json.dumps(record))
@@ -513,6 +661,11 @@ def main(argv=None) -> int:
                       "server_p99_ms": record["server"]["p99_ms"],
                       "slo_burn": record["server"]["slo_burn"],
                       "occupancy": record["occupancy"],
+                      "swap_blip_p99_ms":
+                          (record.get("swap") or {}).get(
+                              "swap_blip_p99_ms"),
+                      "rollbacks":
+                          (record.get("swap") or {}).get("rollbacks"),
                       "compiles": record["compiles"]}))
     return 0
 

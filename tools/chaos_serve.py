@@ -1,0 +1,432 @@
+"""Serving chaos matrix: prove every fleet failure mode's steady state.
+
+The serving twin of ``tools/fault_matrix.py`` (which proves the
+TRAINING recovery branches): each scenario injects a deterministic
+fault into the serving fleet (robust/faults.py points
+``serve_replica_{i}`` / ``serve_canary`` / ``serve_device``) or drives
+an overload, and asserts the documented steady state — on CPU, in one
+process, every suite round (``tools/run_suite.py`` runs this as the
+``chaos`` tier):
+
+- **replica_wedge** — one replica of a 2-replica router wedges; every
+  request still succeeds on the survivor (capacity degrades, not
+  availability), the breaker opens, and after the fault clears the
+  half-open probe closes it again.
+- **swap_mid_flight** — a canary-gated hot swap lands under concurrent
+  mixed /predict + /explain HTTP traffic: zero request loss, no 5xx
+  from the swap itself, every response attributable to exactly one
+  model version (version echoed and predictions bit-match that
+  version's model), ``swap_blip_p99_ms`` recorded vs the steady p99.
+- **canary_fail** — an injected canary fault rejects the push with 409;
+  the old version never stops serving.
+- **rollback_trigger** — a post-swap device wedge degrades the new
+  version; ``check_postswap`` trips the degraded-transition threshold,
+  rolls back to the still-resident previous version, dumps the flight
+  recorder, and traffic keeps succeeding on the restored version.
+- **shed_priority** — a saturated queue sheds LOW-priority requests
+  while HIGH is still admitted; the per-class shed/served counters land
+  in /metrics and the 503 carries ``Retry-After``.
+
+    python tools/chaos_serve.py --json     # one JSON verdict line
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+CHECKS = {}
+
+
+def check(name, ok, detail=""):
+    CHECKS[name] = bool(ok)
+    print(f"# {'ok ' if ok else 'FAIL'} {name}"
+          + (f" — {detail}" if detail and not ok else ""), flush=True)
+
+
+def _post(url, payload, timeout=60):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read()), dict(resp.headers)
+
+
+def _p99(lat):
+    from lightgbm_tpu.obs.report import percentile
+    return percentile(sorted(lat), 0.99)
+
+
+def _build_models(workdir):
+    """Two small models whose predictions DIFFER (so a response is
+    attributable to exactly one of them) + the probe pool."""
+    import lightgbm_tpu as lgb
+    rng = np.random.default_rng(5)
+    X = rng.normal(size=(600, 6))
+    X[rng.random(X.shape) < 0.03] = np.nan
+    y = (np.nan_to_num(X[:, 0]) - 0.4 * np.nan_to_num(X[:, 2]) > 0
+         ).astype(np.float64)
+    P = {"objective": "binary", "num_leaves": 7, "min_data_in_leaf": 5,
+         "verbose": -1}
+    b1 = lgb.train(P, lgb.Dataset(X, label=y, params=P),
+                   num_boost_round=5)
+    P2 = dict(P, num_leaves=5, learning_rate=0.2)
+    b2 = lgb.train(P2, lgb.Dataset(X, label=y, params=P2),
+                   num_boost_round=8)
+    m1 = os.path.join(workdir, "chaos_m1.txt")
+    m2 = os.path.join(workdir, "chaos_m2.txt")
+    b1.save_model(m1)
+    b2.save_model(m2)
+    return (m1, b1), (m2, b2), X, dict(P)
+
+
+def _cfg(P, **over):
+    from lightgbm_tpu.config import Config
+    base = dict(P, tpu_serve_max_batch=64, tpu_serve_max_wait_ms=1.0,
+                tpu_serve_canary_rows=16, tpu_serve_canary_probes=4,
+                tpu_serve_rollback_watch_s=0.0,  # chaos drives the check
+                tpu_serve_reprobe_s=0.05)
+    base.update(over)
+    return Config.from_params(base)
+
+
+# ---------------------------------------------------------------------------
+def scenario_replica_wedge(models, X, P):
+    """One wedged replica degrades capacity, not availability."""
+    from lightgbm_tpu.robust import faults
+    from lightgbm_tpu.serve import ReplicaRouter
+    (m1, b1) = models[0]
+    router = ReplicaRouter(m1, n_replicas=2, config=_cfg(P))
+    ref = b1.predict(X[:8])
+    try:
+        faults.configure("serve_replica_0:raise@n=-1")
+        outs, fails = [], 0
+        for i in range(12):
+            try:
+                t = router.submit(X[:8])
+                outs.append((t.replica.idx, router.result(t, timeout=30)))
+            except Exception:  # noqa: BLE001
+                fails += 1
+        check("wedge.all_served", fails == 0 and len(outs) == 12,
+              f"{fails} failures")
+        check("wedge.correct_on_survivor",
+              all(np.allclose(o, ref, atol=1e-6) for _, o in outs))
+        st = router.stats()
+        r0 = st["replicas"][0]["breaker"]
+        check("wedge.breaker_opened", r0["state"] == "open"
+              and st["failovers"] >= 1, f"breaker {r0}")
+        check("wedge.capacity_degraded",
+              st["routable_replicas"] == 1
+              and not st["degraded"], st)
+        # fault clears -> the half-open probe re-admits replica 0
+        faults.disarm()
+        deadline = time.time() + 10
+        closed = False
+        while time.time() < deadline:
+            t = router.submit(X[:4])
+            router.result(t, timeout=30)
+            if router.replicas[0].breaker.state == "closed":
+                closed = True
+                break
+            time.sleep(0.2)
+        check("wedge.recovered_after_clear", closed
+              and router.routable_count() == 2,
+              router.replicas[0].breaker.snapshot())
+    finally:
+        faults.disarm()
+        router.close()
+
+
+# ---------------------------------------------------------------------------
+def scenario_swap_mid_flight(models, X, P):
+    """Hot swap under concurrent mixed HTTP traffic: zero loss, version
+    attribution, no 5xx from the swap, blip p99 recorded."""
+    from lightgbm_tpu.serve import ModelRegistry, PredictServer
+    (m1, b1), (m2, b2) = models
+    expected = {}   # version -> (predict ref, contrib ref)
+    reg = ModelRegistry(config=_cfg(P), n_replicas=1)
+    reg.add_model("default", m1)
+    server = PredictServer(reg).start()
+    url = server.url
+    results, lock = [], threading.Lock()
+    stop = threading.Event()
+    pool = X[:32]
+    expected[1] = (b1.predict(pool), b1.predict(pool, pred_contrib=True))
+    expected[2] = (b2.predict(pool), b2.predict(pool, pred_contrib=True))
+
+    def client(seed):
+        rng = np.random.default_rng(seed)
+        while not stop.is_set():
+            n = int(rng.integers(1, 9))
+            lo = int(rng.integers(0, pool.shape[0] - n + 1))
+            explain = rng.random() < 0.3
+            path = "/explain" if explain else "/predict"
+            t0 = time.perf_counter()
+            try:
+                code, body, _ = _post(url + path,
+                                      {"rows": pool[lo:lo + n].tolist()})
+            except urllib.error.HTTPError as exc:
+                code, body = exc.code, {}
+            except Exception as exc:  # noqa: BLE001
+                code, body = -1, {"error": repr(exc)}
+            with lock:
+                results.append({
+                    "t0": t0, "t": time.perf_counter(), "code": code,
+                    "lat_ms": (time.perf_counter() - t0) * 1e3,
+                    "version": body.get("version"), "lo": lo, "n": n,
+                    "explain": explain,
+                    "values": body.get("contributions"
+                                       if explain else "predictions")})
+            time.sleep(0.01)
+
+    threads = [threading.Thread(target=client, args=(s,))
+               for s in range(4)]
+    for t in threads:
+        t.start()
+    time.sleep(1.2)
+    t_swap0 = time.perf_counter()
+    code, swap_body, _ = _post(url + "/models/default/swap",
+                               {"model_file": m2}, timeout=120)
+    t_swap1 = time.perf_counter()
+    time.sleep(1.2)
+    stop.set()
+    for t in threads:
+        t.join(30)
+    server.stop(close_session=True)
+
+    ok_rows = [r for r in results if r["code"] == 200]
+    check("swap.http_ok", code == 200 and swap_body.get("ok"), swap_body)
+    check("swap.zero_loss", len(ok_rows) == len(results)
+          and len(results) >= 8,
+          f"{len(results) - len(ok_rows)}/{len(results)} non-200")
+    vers = {r["version"] for r in ok_rows}
+    check("swap.both_versions_observed", vers == {1, 2}, vers)
+    mismatch = 0
+    for r in ok_rows:
+        pref, cref = expected[r["version"]]
+        got = np.asarray(r["values"], dtype=np.float64)
+        want = (cref[r["lo"]:r["lo"] + r["n"]] if r["explain"]
+                else pref[r["lo"]:r["lo"] + r["n"]])
+        if got.shape != np.asarray(want).shape or \
+                not np.allclose(got, want, atol=1e-5):
+            mismatch += 1
+    check("swap.bit_consistent", mismatch == 0,
+          f"{mismatch} responses did not match their echoed version")
+    # ordering: a request STARTED after the swap call returned (flip
+    # complete) must resolve the new version — old-version answers after
+    # the flip can only be in-flight stragglers submitted before it
+    after = [r for r in ok_rows if r["t0"] > t_swap1 + 0.05]
+    check("swap.new_traffic_on_new_version",
+          all(r["version"] == 2 for r in after) and len(after) > 0,
+          {r["version"] for r in after})
+    steady = [r["lat_ms"] for r in ok_rows
+              if r["t"] < t_swap0 or r["t"] > t_swap1 + 0.5]
+    blip = [r["lat_ms"] for r in ok_rows
+            if t_swap0 <= r["t"] <= t_swap1 + 0.5]
+    steady_p99, blip_p99 = _p99(steady), _p99(blip)
+    check("swap.blip_recorded", steady_p99 is not None)
+    return {"swap_blip_p99_ms": blip_p99, "steady_p99_ms": steady_p99,
+            "swap_ms": round((t_swap1 - t_swap0) * 1e3, 1),
+            "requests": len(results)}
+
+
+# ---------------------------------------------------------------------------
+def scenario_canary_fail(models, X, P):
+    """An injected canary fault rejects the push; old model keeps
+    serving."""
+    from lightgbm_tpu.robust import faults
+    from lightgbm_tpu.serve import ModelRegistry, PredictServer
+    (m1, b1), (m2, _) = models
+    reg = ModelRegistry(config=_cfg(P), n_replicas=1)
+    reg.add_model("default", m1)
+    server = PredictServer(reg).start()
+    try:
+        faults.configure("serve_canary:raise@call=1")
+        try:
+            code, body, _ = _post(server.url + "/models/default/swap",
+                                  {"model_file": m2}, timeout=120)
+        except urllib.error.HTTPError as exc:
+            code, body = exc.code, json.loads(exc.read())
+        faults.disarm()
+        check("canary.rejected_409", code == 409
+              and body.get("error") == "swap_rejected", (code, body))
+        listing = reg.models()[0]
+        check("canary.old_still_live", listing["live_version"] == 1
+              and listing["swaps_rejected"] == 1, listing)
+        code, body, _ = _post(server.url + "/predict",
+                              {"rows": X[:4].tolist()})
+        check("canary.serving_after_reject", code == 200
+              and body.get("version") == 1
+              and np.allclose(body["predictions"],
+                              b1.predict(X[:4]), atol=1e-6))
+    finally:
+        faults.disarm()
+        server.stop(close_session=True)
+
+
+# ---------------------------------------------------------------------------
+def scenario_rollback_trigger(models, X, P, art_dir):
+    """Post-swap device wedge -> health regression -> automatic
+    rollback to the resident previous version + flight dump."""
+    from lightgbm_tpu import obs
+    from lightgbm_tpu.robust import faults
+    from lightgbm_tpu.serve import ModelRegistry
+    (m1, b1), (m2, _) = models
+    # one degraded transition must trip the watch (the fleet shares one
+    # metrics instance, so N replicas degrading counts ONE transition)
+    reg = ModelRegistry(config=_cfg(P, tpu_serve_rollback_degraded=1),
+                        n_replicas=1)
+    reg.add_model("default", m1)
+    try:
+        swap = reg.swap("default", m2)
+        check("rollback.swap_ok", swap["ok"], swap)
+        n_flights0 = len(glob.glob(os.path.join(art_dir, "FLIGHT_*.json")))
+        faults.configure("serve_device:raise@n=-1")
+        outs = []
+        for _ in range(4):   # device wedge -> host fallback, not errors
+            t = reg.submit(X[:4])
+            outs.append(reg.result(t, timeout=30))
+        st = reg.resolve(None).router.stats()
+        check("rollback.new_version_degraded", st["any_degraded"], st)
+        out = reg.check_postswap("default")
+        check("rollback.triggered", out is not None
+              and str(out.get("reason", "")).startswith("auto:"), out)
+        faults.disarm()
+        live = reg.resolve(None)
+        check("rollback.live_is_previous", live.version == 1,
+              live.version)
+        listing = reg.models()[0]
+        check("rollback.counted", listing["rollbacks"] == 1, listing)
+        n_flights1 = len(glob.glob(os.path.join(art_dir, "FLIGHT_*.json")))
+        check("rollback.flight_dumped",
+              obs.flight_enabled() and n_flights1 > n_flights0,
+              f"{n_flights0} -> {n_flights1} in {art_dir}")
+        t = reg.submit(X[:4])
+        check("rollback.serving_after_rollback",
+              np.allclose(reg.result(t, timeout=30), b1.predict(X[:4]),
+                          atol=1e-6))
+    finally:
+        faults.disarm()
+        reg.close()
+
+
+# ---------------------------------------------------------------------------
+def scenario_shed_priority(models, X, P):
+    """Saturated queue sheds low first; high still admitted; counters in
+    /metrics; 503 carries Retry-After."""
+    from lightgbm_tpu.serve import (PredictorSession, PredictServer,
+                                    ServeOverloadError, parse_prometheus)
+    (m1, _), _ = models
+    cfg = _cfg(P, tpu_serve_max_batch=16, tpu_serve_queue_depth=64,
+               tpu_serve_max_wait_ms=50.0)
+    sess = PredictorSession(m1, config=cfg)
+    sess.warmup()
+    orig = sess._run_device
+
+    def slow(bins, **kw):
+        time.sleep(0.12)
+        return orig(bins, **kw)
+
+    sess._run_device = slow
+    server = PredictServer(sess).start()
+    tickets = []
+    try:
+        # low cap = 32 rows, normal cap = 54, high cap = 64.  Fill with
+        # 48 normal rows (queue ~48 after the first batch dispatches)…
+        shed_low = admitted_high = False
+        for _ in range(6):
+            tickets.append(sess.submit(X[:8], priority="normal"))
+        # …low is over ITS budget now, high still has headroom
+        try:
+            sess.submit(X[:8], priority="low")
+        except ServeOverloadError as exc:
+            shed_low = exc.shed and exc.priority == "low"
+        try:
+            tickets.append(sess.submit(X[:8], priority="high"))
+            admitted_high = True
+        except ServeOverloadError:
+            pass
+        check("shed.low_shed_first", shed_low)
+        check("shed.high_admitted", admitted_high)
+        # the 503 a shed client sees carries Retry-After
+        code, headers = None, {}
+        try:
+            code, _, headers = _post(
+                server.url + "/predict",
+                {"rows": X[:8].tolist(), "priority": "low"}, timeout=30)
+        except urllib.error.HTTPError as exc:
+            code, headers = exc.code, dict(exc.headers)
+        check("shed.retry_after_on_503", code == 503
+              and "Retry-After" in headers, (code, list(headers)))
+        for t in tickets:
+            sess.result(t, timeout=60)
+        pm = parse_prometheus(
+            urllib.request.urlopen(server.url + "/metrics", timeout=30)
+            .read().decode())
+        check("shed.counters_in_metrics",
+              pm.get('tpu_serve_shed_total{priority="low"}', 0) >= 2
+              and pm.get('tpu_serve_served_total{priority="high"}', 0)
+              >= 1
+              and pm.get('tpu_serve_shed_total{priority="high"}', 0)
+              == 0,
+              {k: v for k, v in pm.items() if "shed" in k or "served" in
+               k})
+    finally:
+        server.stop(close_session=True)
+
+
+# ---------------------------------------------------------------------------
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description="Serving chaos matrix")
+    ap.add_argument("--json", action="store_true",
+                    help="print a machine-readable verdict line")
+    args = ap.parse_args(argv)
+
+    t0 = time.time()
+    art = tempfile.mkdtemp(prefix="chaos_serve_")
+    os.environ["LGBM_TPU_FLIGHT_DIR"] = art
+
+    with tempfile.TemporaryDirectory(prefix="chaos_models_") as workdir:
+        models = _build_models(workdir)
+        (pair1, pair2, X, P) = models
+        models = (pair1, pair2)
+        extra = {}
+        scenario_replica_wedge(models, X, P)
+        extra.update(scenario_swap_mid_flight(models, X, P) or {})
+        scenario_canary_fail(models, X, P)
+        scenario_rollback_trigger(models, X, P, art)
+        scenario_shed_priority(models, X, P)
+
+    record = {
+        "kind": "chaos_serve",
+        "t": round(time.time(), 1),
+        "wall_s": round(time.time() - t0, 1),
+        "checks": CHECKS,
+        "ok": all(CHECKS.values()),
+        "artifacts_dir": art,
+        **extra,
+    }
+    if args.json:
+        print(json.dumps(record))
+    else:
+        print(f"# {sum(CHECKS.values())}/{len(CHECKS)} checks passed "
+              f"({record['wall_s']}s)")
+    return 0 if record["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -174,6 +174,42 @@ def main(argv=None) -> int:
     sess.close()
     faults.disarm()
 
+    # ---- explain-plane wedge: degrade + reprobe ISOLATED from predict
+    # (ISSUE 10: the explain fault points landed after PR 7's matrix) --
+    faults.configure("serve_explain_device:raise@call=1")
+    sess2 = PredictorSession(bst, config=dict(
+        P, tpu_serve_reprobe_s=0.05, tpu_serve_max_batch=128))
+    try:
+        xout = sess2.explain(X[:4])          # wedge fires -> host oracle
+        stx = sess2.stats()
+        # the TreeSHAP wedge degrades ONLY the explain plane; predict
+        # keeps its device path (a shared flag would oscillate)
+        check("serve_explain.degrade_isolated",
+              bool(stx["explain_degraded"]) and not stx["degraded"])
+        pok = np.allclose(sess2.predict(X[:16]), p_ref, atol=1e-6)
+        check("serve_explain.predict_unaffected",
+              pok and not sess2.stats()["degraded"])
+        x_ref = bst.predict(X[:4], pred_contrib=True)
+        check("serve_explain.host_fallback_correct",
+              np.allclose(xout, x_ref, atol=1e-5))
+        faults.disarm()                      # let the reprobe succeed
+        time.sleep(0.11)
+        xout2 = sess2.explain(X[:4])
+        stx2 = sess2.stats()
+        check("serve_explain.reprobe_recovers",
+              not stx2["explain_degraded"]
+              and np.allclose(xout2, x_ref, atol=1e-5))
+    except Exception as exc:  # noqa: BLE001
+        for name in ("serve_explain.degrade_isolated",
+                     "serve_explain.predict_unaffected",
+                     "serve_explain.host_fallback_correct",
+                     "serve_explain.reprobe_recovers"):
+            CHECKS.setdefault(name, False)
+        check("serve_explain.no_crash", False, repr(exc))
+    finally:
+        sess2.close()
+        faults.disarm()
+
     # ---- checkpoint_write fault is survived; corrupt ckpt skipped --
     ckdir2 = os.path.join(art, "ckpt2")
     faults.configure("checkpoint_write:raise@call=2")

@@ -156,14 +156,16 @@ ENV_VARS = [
      "deterministic fault-injection spec (robust/faults.py) — "
      "`point:action[@cond[&cond...]]` legs separated by `;`.  Points: "
      "`device_execute`, `gradients`, `collective`, `serve_device`, "
-     "`checkpoint_write`.  Actions: `raise` (fatal), `transient` (the "
-     "watchdog's retry path), `sleep=S` (stall the step), `hang`.  "
-     "Conds: `iter=N` (boosting iteration), `call=N` (N-th check at "
-     "that point), `p=F` (seeded probability), `n=N` (fire at most N "
-     "times, default 1, -1 = always).  Example: "
+     "`serve_explain_submit`, `serve_explain_device`, `serve_replica` "
+     "(plus per-replica `serve_replica_{i}`), `serve_swap`, "
+     "`serve_canary`, `checkpoint_write`.  Actions: `raise` (fatal), "
+     "`transient` (the watchdog's retry path), `sleep=S` (stall the "
+     "step), `hang`.  Conds: `iter=N` (boosting iteration), `call=N` "
+     "(N-th check at that point), `p=F` (seeded probability), `n=N` "
+     "(fire at most N times, default 1, -1 = always).  Example: "
      "`device_execute:transient@iter=3&n=2;serve_device:raise`.  Used "
-     "by the `tools/fault_matrix.py` suite tier to prove every "
-     "recovery branch on CPU."),
+     "by the `tools/fault_matrix.py` and `tools/chaos_serve.py` suite "
+     "tiers to prove every recovery branch on CPU."),
     ("LGBM_TPU_FAULTS_SEED",
      "seed for the fault harness's probabilistic conds (`p=`); the same "
      "spec + seed replays the identical fault schedule (default 0)."),
@@ -191,6 +193,27 @@ ENV_VARS = [
      "between device re-probes while a session is degraded to the host "
      "predictor; a successful probe flips `/health` back to `ok` "
      "(`0` disables, restoring the old one-way latch)."),
+    ("LGBM_TPU_SERVE_REPLICAS",
+     "serving-fleet override for `tpu_serve_replicas` — how many "
+     "`PredictorSession` replicas each registered model version packs "
+     "behind the failover router (per-device on a multi-chip host, "
+     "thread-pool replicas on CPU).  One wedged replica then costs "
+     "capacity, never availability (its circuit breaker opens and a "
+     "half-open probe re-admits it when it recovers)."),
+    ("LGBM_TPU_SERVE_ROLLBACK_WATCH_S",
+     "serving-fleet override for `tpu_serve_rollback_watch_s` — how "
+     "long after a hot-swap the registry watches the new live version's "
+     "metrics (failed-request rate, degraded transitions, SLO burn) and "
+     "rolls back AUTOMATICALLY to the still-resident previous version "
+     "on a regression (`0` disables the watch; manual "
+     "`POST /models/{name}/rollback` always works)."),
+    ("LGBM_TPU_SERVE_SHED_LOW_FRAC",
+     "serving-engine override for `tpu_serve_shed_low_frac` — the "
+     "fraction of the queue-row budget low-priority requests may fill "
+     "before overload sheds them (`Retry-After` on the 503; per-class "
+     "served/shed counters in `/metrics`).  "
+     "`LGBM_TPU_SERVE_SHED_NORMAL_FRAC` overrides the normal-priority "
+     "budget the same way; high priority always owns the full queue."),
     ("LGBM_TPU_PREDICT_MIN_WORK",
      "CLI `task=predict` routing override: the rows x trees work "
      "threshold above which value predictions go through the serving "

@@ -39,6 +39,17 @@ is re-proved by the same round — ``explain_served``,
 ``explain_no_failures`` and ``explain_buckets_bounded`` join the
 check map.
 
+The ``chaos`` tier (ISSUE 10) runs ``tools/chaos_serve.py --json``: the
+serving chaos matrix — replica wedge (one wedged replica of a routed
+pair costs capacity, never availability; breaker opens, half-open probe
+recovers it), hot-swap under concurrent mixed /predict + /explain
+traffic (zero request loss, no 5xx from the swap, every response
+bit-consistent with its echoed model version), canary-gate rejection
+(409, old version keeps serving), post-swap regression -> automatic
+rollback + flight dump, and priority shedding (low shed first,
+Retry-After on the 503, per-class counters in /metrics) — so every
+suite round re-proves the whole serving resilience plane on CPU.
+
 The ``faults`` tier (ISSUE 7) runs ``tools/fault_matrix.py --json``:
 every ``LGBM_TPU_FAULTS`` injection point x recovery mode — transient
 retry (bit-identical model), fatal abort (wedge checkpoint + flight
@@ -131,6 +142,10 @@ _TOOL_TIERS = {
     # the environment zeroes SERVE_EXPLAIN_FRAC
     "serve": ["bench_serve.py", "--smoke", "--explain-frac", "0.2"],
     "faults": ["fault_matrix.py", "--json"],
+    # serving chaos matrix (ISSUE 10): replica wedge, swap-mid-flight,
+    # canary rejection, post-swap rollback, priority shedding — every
+    # fleet failure mode re-proved on CPU each suite round
+    "chaos": ["chaos_serve.py", "--json"],
 }
 
 
@@ -184,10 +199,11 @@ def run_serve_smoke(timeout: int, runner=subprocess.run,
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="Run the quick/slow test tiers and write SUITE_rN.json")
-    ap.add_argument("--tiers", default="quick,slow,serve,faults",
+    ap.add_argument("--tiers", default="quick,slow,serve,faults,chaos",
                     help="comma list of tiers: pytest markers plus the "
-                         "built-in 'serve' smoke and 'faults' matrix "
-                         "legs (default quick,slow,serve,faults)")
+                         "built-in 'serve' smoke, 'faults' matrix and "
+                         "'chaos' serving-chaos legs (default "
+                         "quick,slow,serve,faults,chaos)")
     ap.add_argument("--select", default="",
                     help="pytest collection target (file or node id) "
                          "instead of the whole tests/ dir")

@@ -29,7 +29,11 @@ capture checklist with health monitoring enabled:
    the ``SERVE_r*.json`` CI rounds).  The leg runs with
    ``LGBM_TPU_TRACE=1`` and a flight capture, so one good window also
    yields a Perfetto-loadable ``serve_trace.json`` (request span trees)
-   and a ``FLIGHT_serve.json`` flight record in the artifacts dir;
+   and a ``FLIGHT_serve.json`` flight record in the artifacts dir.
+   Since ISSUE 10 the leg also exercises ONE registry hot-swap under
+   its Poisson mix (bench_serve's swap leg), and the window record
+   stamps ``swap_blip_p99_ms`` / ``rollbacks`` at top level — a real
+   on-TPU datapoint for "what does a model push cost the p99";
 7. ``tools/bench_serve.py --json --explain-frac 0.5`` — the
    explanation-serving leg (ISSUE 9): half the open-loop Poisson
    arrivals are ``/explain`` TreeSHAP requests, so the window captures
@@ -197,7 +201,10 @@ def checklist_legs(art_dir: str, dry_run: bool, py: str = sys.executable):
         # streams separable
         {"name": "bench_explain",
          "argv": [py, serve, "--json", "--explain-frac", "0.5"],
-         "env": env_for("bench_explain", dry_env=_DRY_SERVE_ENV),
+         # the hot-swap exercise belongs to the bench_serve leg; this
+         # one stays a pure explain-mix measurement
+         "env": env_for("bench_explain", {"SERVE_SWAP": "0"},
+                        dry_env=_DRY_SERVE_ENV),
          "parse_json": True},
         {"name": "trace",
          "argv": [py, "-c", _TRACE_CODE, trace_rows, trace_dir],
@@ -392,6 +399,13 @@ def run_checklist(out_dir: str, n: int, dry_run: bool,
     serve_parsed = (results.get("bench_serve") or {}).get("parsed")
     if serve_parsed:
         serve_parsed = dict(serve_parsed, n=n, dry_run=dry_run)
+        # the leg's hot-swap exercise (ISSUE 10): stamp the blip p99 and
+        # rollback count at top level so one window leaves a trendable
+        # swap datapoint even if the embedded record shape changes
+        sw = serve_parsed.get("swap") or {}
+        serve_parsed["swap_blip_p99_ms"] = sw.get("swap_blip_p99_ms")
+        serve_parsed["swap_steady_p99_ms"] = sw.get("steady_p99_ms")
+        serve_parsed["rollbacks"] = sw.get("rollbacks")
         serve_path = os.path.join(out_dir, f"SERVE_manual_r{n:02d}.json")
         with open(serve_path, "w") as fh:
             json.dump(serve_parsed, fh, indent=1)
