@@ -13,7 +13,11 @@ vs_baseline > 1 means faster than the reference CPU result.
 Env knobs: BENCH_ROWS (default 1_000_000), BENCH_ITERS (default 10),
 BENCH_LEAVES (default 255), BENCH_MAXBIN (default 255 — 63 fills the
 MXU 4x denser via feature packing, see docs/ROOFLINE.md), BENCH_FUSED=0
-(disable in-kernel sibling subtraction — the tpu_window A/B leg).
+(disable in-kernel sibling subtraction — the tpu_window A/B leg),
+BENCH_QUANT=int16|int8 (quantized histogram accumulation — the
+bench_quant A/B leg; same problem, quantization-only delta),
+BENCH_FUSED_GRAD=0 (disable the fused gradient pass — its A/B twin),
+BENCH_OVERLAP=1 (double-buffered wave scheduling).
 BENCH_TASK=rank switches to an
 MSLR-WEB30K-shaped lambdarank run only (ragged queries of 1..1251 docs,
 136 features, NDCG@10) against the reference's published MSLR CPU time
@@ -42,11 +46,29 @@ REF_RANK_ROW_ITERS_PER_SEC = 2_270_296 * 500 / 215.32
 def _telemetry_digest():
     """Machine-readable telemetry summary for the JSON line, when the run
     had LGBM_TPU_TELEMETRY / tpu_telemetry or LGBM_TPU_PROFILE active;
-    None otherwise."""
+    None otherwise.  The live counters digest (obs.digest) is enriched
+    with the event-stream sections (wave_pipeline — waves_per_tree +
+    the hist_mode/fused_sibling/fused_grad/overlap stamps) by reading
+    the sink back through report.summarize: the live digest never
+    carried them, which silently kept the mode stamps OFF the bench
+    line (the ISSUE 8 flatten below read an always-absent key)."""
     try:
         from lightgbm_tpu import obs
-        if obs.enabled() or obs.profile_enabled():
-            return obs.digest()
+        if not (obs.enabled() or obs.profile_enabled()):
+            return None
+        d = obs.digest()
+        try:
+            from lightgbm_tpu.obs.core import sink_path
+            from lightgbm_tpu.obs.report import load_events, summarize
+            sink = sink_path()
+            if sink and os.path.exists(sink):
+                full = summarize(load_events(sink))
+                for key in ("wave_pipeline",):
+                    if full.get(key) is not None:
+                        d[key] = full[key]
+        except Exception:  # stream readback is best-effort
+            pass
+        return d
     except Exception:  # telemetry must never cost the bench its number
         pass
     return None
@@ -91,6 +113,15 @@ def _embed_observability(result: dict) -> None:
         result["hist_mode"] = wave["hist_mode"]
     if wave.get("fused_sibling") is not None:
         result["fused_sibling"] = wave["fused_sibling"]
+    # quantized/fused/overlap pipeline stamps (ISSUE 11): a fused_grad
+    # on->off flip is flagged like a fused_sibling downgrade, and the
+    # per-iteration HBM saving + overlap fraction trend numerically
+    if wave.get("fused_grad") is not None:
+        result["fused_grad"] = wave["fused_grad"]
+    if wave.get("grad_hbm_bytes_saved") is not None:
+        result["grad_hbm_bytes_saved"] = wave["grad_hbm_bytes_saved"]
+    if wave.get("overlap_frac") is not None:
+        result["overlap_frac"] = wave["overlap_frac"]
     counters = td.get("counters") or {}
     if counters.get("health/checks"):
         # health-mode runs carry their verdict in the bench line itself,
@@ -266,6 +297,25 @@ def main() -> None:
     # differs, so value deltas are pure fusion economics
     if os.environ.get("BENCH_FUSED", "") == "0":
         params["tpu_fused_sibling"] = False
+    # BENCH_QUANT=int16|int8 (or the convenience "1" -> int16): the
+    # quantized-accumulation A/B leg (bench_quant) — same problem/trees
+    # shape, quantization-only delta.  Unknown values ABORT rather than
+    # silently pricing the wrong mode into a window record.
+    quant = os.environ.get("BENCH_QUANT", "")
+    if quant in ("int16", "int8"):
+        params["tpu_hist_dtype"] = quant
+    elif quant == "1":
+        params["tpu_hist_dtype"] = "int16"
+    elif quant not in ("", "0"):
+        raise SystemExit(f"BENCH_QUANT must be int16, int8, 1 or 0 "
+                         f"(got {quant!r})")
+    # BENCH_FUSED_GRAD=0: unfused gradient pass (bit-identical trees,
+    # the delta is the [N] g/h HBM round-trip + dispatch)
+    if os.environ.get("BENCH_FUSED_GRAD", "") == "0":
+        params["tpu_fused_grad"] = False
+    # BENCH_OVERLAP=1: double-buffered wave scheduling
+    if os.environ.get("BENCH_OVERLAP", "") == "1":
+        params["tpu_wave_overlap"] = True
     per_iter, compile_time, bin_time, auc_val, _ = _measure(
         params, X, y, None, iters, "auc")
 

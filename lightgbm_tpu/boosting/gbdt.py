@@ -48,6 +48,62 @@ def _cached_jit(key, builder):
     return fn
 
 
+# The fused grow_apply closures capture the OBJECTIVE (its [N]-sized
+# device label/weight arrays included), so they get their own, much
+# smaller cache: 64 pinned folds' labels would be real HBM, where the
+# plain grower closures capture no data arrays at all.  One entry is
+# enough for the repeated-identical-fit case the cache exists for.
+_FUSED_JIT_CACHE: Dict = {}
+
+
+def _cached_fused_jit(key, builder):
+    fn = _FUSED_JIT_CACHE.get(key)
+    if fn is None:
+        if len(_FUSED_JIT_CACHE) >= 4:
+            _FUSED_JIT_CACHE.clear()
+        fn = builder()
+        _FUSED_JIT_CACHE[key] = fn
+    return fn
+
+
+def _objective_content_key(objective) -> str:
+    """Content hash of an objective's data-dependent state — the safe
+    half of the fused-grow-apply cache key.  The whole attribute dict
+    is flattened as a pytree, so arrays held inside lists/dicts/tuples
+    (a future objective's bucket tables, say) can never be silently
+    excluded.  Host numpy leaves are hashed byte-exactly; primitive
+    leaves by repr; DEVICE arrays contribute only shape/dtype — every
+    built-in objective's device state is a `_to_device` mirror of host
+    arrays + config knobs (both already in the key), and hashing the
+    mirrors too would pay a device->host transfer per fit in exactly
+    the cv/grid-search loop the cache exists to speed up.  A miss only
+    costs a compile; this key must never falsely hit."""
+    import hashlib
+
+    import jax
+    h = hashlib.sha1()
+    for leaf in jax.tree_util.tree_leaves(vars(objective)):
+        if isinstance(leaf, np.ndarray):
+            h.update(b"n")
+            h.update(np.ascontiguousarray(leaf).tobytes())
+        elif isinstance(leaf, jax.Array):
+            h.update(f"d{leaf.shape}{leaf.dtype}".encode())
+        elif isinstance(leaf, (bool, int, float, str, bytes, type(None),
+                               np.generic)):
+            h.update(repr(leaf).encode())
+        else:
+            h.update(repr(type(leaf)).encode())
+    return f"{type(objective).__name__}:{h.hexdigest()}"
+
+
+def _ckpt_config_digest(config) -> str:
+    """The checkpoint config digest, reused as the scalar-knob half of
+    the fused cache key (covers every training-relevant field, so an
+    objective hyperparameter like sigmoid can never alias)."""
+    from ..robust.checkpoint import config_digest
+    return config_digest(config)
+
+
 class _DeferredTree:
     """A trained tree still living on device as ``TreeArrays``.
 
@@ -448,6 +504,12 @@ class GBDT(PredictorBase):
     # directly (RF) opt out of the telemetry wave-count third output
     _telemetry_waves = True
 
+    # subclasses whose iteration CONSUMES materialized gradients on the
+    # host side (GOSS builds its top/other mask from |g|, RF freezes
+    # g/h once) opt out of the fused gradient pass (tpu_fused_grad) —
+    # for them the [N] g/h arrays must exist outside the growth jit
+    _fused_grad_capable = True
+
     def __init__(self):
         self.models: List[Tree] = _TreeList(self)
         self._has_deferred = False
@@ -558,6 +620,21 @@ class GBDT(PredictorBase):
         self.class_need_train = [
             objective.class_need_train(k) if objective is not None else True
             for k in range(K)]
+        # fused gradient pass (tpu_fused_grad): gradients computed INSIDE
+        # the growth jit, deleting the per-iteration [N] f32 g/h HBM
+        # round-trip.  Eligible only where it is provably bit-identical:
+        # built-in single-tree-per-iteration objectives on boosters that
+        # never consume materialized gradients host-side (GOSS/RF opt
+        # out via _fused_grad_capable); custom-gradient calls and
+        # health-tap iterations take the unfused path at runtime.
+        self._fused_grad = (
+            bool(getattr(config, "tpu_fused_grad", True))
+            and self._fused_grad_capable
+            and objective is not None
+            and getattr(objective, "supports_fused_grad", True)
+            and K == 1)
+        if self._wave_info is not None:
+            self._wave_info["fused_grad"] = self._fused_grad
         self._jit_helpers()
         self._telem_iters = 0
         self._telem_train_s = 0.0
@@ -628,9 +705,19 @@ class GBDT(PredictorBase):
             getattr(config, "forcedsplits_filename", ""), train_ds,
             self.split_cfg.num_leaves)
 
+        # test hook: LGBM_TPU_FORCE_WAVE=interpret routes the serial
+        # grower through the wave path with the Pallas interpreter, so
+        # CPU CI can train END TO END through the quantized/fused/
+        # overlap pipeline instead of only unit-testing the grower
+        force_wave = os.environ.get("LGBM_TPU_FORCE_WAVE", "").lower()
+        self._wave_interpret = force_wave == "interpret"
         backend_ok = (config.device_type in ("tpu", "gpu")
                       and jax.default_backend() == "tpu"
                       and train_ds.num_features > 0)
+        if self._wave_interpret:
+            backend_ok = train_ds.num_features > 0
+        hist_mode = self._hist_mode(config)
+        overlap_cfg = bool(getattr(config, "tpu_wave_overlap", False))
         narrow_all = (train_ds.X_bin.dtype == np.uint8
                       and self.B_phys <= 256)
         mixed_info = None
@@ -648,6 +735,17 @@ class GBDT(PredictorBase):
                     wide_idx=np.flatnonzero(wide).astype(np.int32),
                     B_narrow=_padded_bin_width(int(phys_bins[~wide].max())))
         self._wave_mixed = mixed_info
+        if (mixed_info is not None or self._bundled) \
+                and hist_mode in ("int16", "int8"):
+            # the wide-column XLA side-pass speaks f32, and the EFB
+            # default-bin reconstruction mixes leaf totals (value units)
+            # with kernel sums (integer units); a silent per-column
+            # precision split would make the accuracy budget unauditable,
+            # so the whole dataset downgrades (stamped in _wave_info —
+            # bench_history flags the downgrade like a mode regression)
+            log.info("tpu_hist_dtype=%s needs the pure-kernel un-bundled "
+                     "wave path; falling back to 2xbf16", hist_mode)
+            hist_mode = "2xbf16"
         wave_ok = backend_ok and (narrow_all or mixed_info is not None)
         if forced is not None and wave_ok:
             log.info("forcedsplits_filename set: using the XLA serial "
@@ -706,14 +804,16 @@ class GBDT(PredictorBase):
             if self.uses_wave and mixed_info is None:
                 wave_kw = dict(
                     wave_capacity=int(config.tpu_wave_capacity),
-                    highest=self._hist_mode(config),
+                    highest=hist_mode,
                     gain_gate=float(config.tpu_wave_gain_gate),
                     block_rows=int(config.tpu_block_rows),
                     batched_apply=bool(
                         getattr(config, "tpu_batched_split_apply", True)),
                     packed=True,
                     fused_sibling=bool(
-                        getattr(config, "tpu_fused_sibling", True)))
+                        getattr(config, "tpu_fused_sibling", True)),
+                    quant_seed=int(config.seed),
+                    overlap=overlap_cfg)
             use_wave = tl == "data" and wave_kw is not None
             self.uses_wave = use_wave
             self._wave_batched = bool(
@@ -728,9 +828,10 @@ class GBDT(PredictorBase):
                     fused_sibling=wave_kw["fused_sibling"],
                     data_parallel=True)
                 self._wave_info = {
-                    "hist_mode": self._hist_mode(config),
+                    "hist_mode": hist_mode,
                     "wave_capacity": cap_eff,
                     "fused_sibling": fused_eff,
+                    "overlap": overlap_cfg,
                 }
             self._grow = make_engine_grower(
                 tl, self.meta, self.split_cfg, self.B, mesh,
@@ -774,35 +875,45 @@ class GBDT(PredictorBase):
                 fused_sibling=fused_knob,
                 mixed=mixed_info is not None, bundled=self._bundled)
             self._wave_info = {
-                "hist_mode": self._hist_mode(config),
+                "hist_mode": hist_mode,
                 "wave_capacity": cap_eff,
                 "fused_sibling": fused_eff,
+                "overlap": overlap_cfg,
             }
 
             def build_wave():
                 return build_wave_grow_fn(
                     self.meta, self.split_cfg, self.B,
                     wave_capacity=int(config.tpu_wave_capacity),
-                    highest=self._hist_mode(config),
+                    highest=hist_mode,
+                    interpret=self._wave_interpret,
                     gain_gate=float(config.tpu_wave_gain_gate),
                     block_rows=int(config.tpu_block_rows),
                     B_phys=self.B_phys, bundled=self._bundled,
                     cegb=cegb_cfg, mixed=mixed_info,
                     report_waves=self._report_waves,
                     batched_apply=batched,
-                    packed=packed, fused_sibling=fused_knob)
+                    packed=packed, fused_sibling=fused_knob,
+                    quant_seed=int(config.seed),
+                    overlap=overlap_cfg)
             if cegb_cfg is None:
                 mixed_key = (None if mixed_info is None else
                              (mixed_info.narrow_idx.tobytes(),
                               mixed_info.wide_idx.tobytes(),
                               mixed_info.B_narrow))
+                # quant_seed is traced into the grower only under the
+                # quantized modes — keying on it otherwise would make
+                # seed-averaged ensembles recompile identical growers
+                seed_key = (int(config.seed)
+                            if hist_mode in ("int16", "int8") else None)
                 key = ("wave", id(self.meta), self.split_cfg, self.B,
                        self.B_phys, self._bundled,
                        int(config.tpu_wave_capacity),
-                       self._hist_mode(config),
+                       hist_mode, self._wave_interpret,
                        float(config.tpu_wave_gain_gate),
                        int(config.tpu_block_rows), mixed_key,
-                       self._report_waves, batched, packed, fused_knob)
+                       self._report_waves, batched, packed, fused_knob,
+                       overlap_cfg, seed_key)
                 self._grow_raw = _cached_jit(key, build_wave)
                 self._raw_cached = True
             else:
@@ -826,7 +937,7 @@ class GBDT(PredictorBase):
                  else int(train_ds.X_bin.shape[1])),
                 (int(mixed_info.B_narrow) if mixed_info is not None
                  else self.B_phys),
-                self._hist_mode(config), packed, fused_eff)
+                hist_mode, packed, fused_eff)
         else:
             from ..core.grower import build_grow_fn
             from ..core.histogram import hist_onehot, hist_scatter
@@ -885,13 +996,20 @@ class GBDT(PredictorBase):
         (the default — hi/lo bf16 split, ~16 mantissa bits on g/h, f32
         accumulation; the reference keeps float histograms even in
         single-precision GPU mode, gpu_tree_learner.h:80-84), "highest"
-        for gpu_use_dp or explicit opt-in, "bf16" on explicit opt-in.
-        ``tpu_hist_dtype`` accepts the kernel-mode names directly;
-        "float32"/"bfloat16" survive as back-compat aliases."""
+        for gpu_use_dp or explicit opt-in, "bf16" on explicit opt-in,
+        "int16"/"int8" for QUANTIZED accumulation (ISSUE 11; gpu_use_dp
+        still wins — an explicit double-precision ask outranks a
+        quantization ask).  ``tpu_hist_dtype`` accepts the kernel-mode
+        names directly; "float32"/"bfloat16" survive as back-compat
+        aliases.  This resolution is also what robust/checkpoint.py
+        config_digest hashes, so alias spellings (and the quantized
+        names) can never refuse a legitimate resume."""
         if config.gpu_use_dp or config.tpu_hist_dtype == "highest":
             return "highest"
         if config.tpu_hist_dtype in ("bfloat16", "bf16"):
             return "bf16"
+        if config.tpu_hist_dtype in ("int16", "int8"):
+            return config.tpu_hist_dtype
         return "2xbf16"  # "2xbf16" or its alias "float32"
 
     def _jit_helpers(self) -> None:
@@ -947,47 +1065,85 @@ class GBDT(PredictorBase):
         bynode_on = getattr(self, "_bynode_on", False)
         report_waves = getattr(self, "_report_waves", False)
 
-        def build_grow_apply():
-            @functools.partial(jax.jit, static_argnames=("k",))
-            def grow_apply(bins, g, h, bag_mask, feature_mask, score, lr, k,
-                           seed=None):
-                """grow + shrink + train-score update for class k, one call.
+        def make_grow_apply(fused: bool):
+            def build():
+                @functools.partial(jax.jit, static_argnames=("k",))
+                def grow_apply(bins, g, h, bag_mask, feature_mask, score,
+                               lr, k, seed=None):
+                    """grow + shrink + train-score update for class k, one
+                    call.
 
-                The leaf values are zeroed ON DEVICE when the tree failed
-                to split (num_leaves <= 1), so the score update is a no-op
-                and the host can check the leaf count one iteration late —
-                that lag-1 check is what lets the next iteration's growth
-                overlap the device->host fetch instead of serializing on
-                it."""
-                if bynode_on:
-                    res = grow_raw(bins, g[:, k], h[:, k],
-                                   bag_mask, feature_mask,
-                                   tree_seed=seed)
-                else:
-                    res = grow_raw(bins, g[:, k], h[:, k],
-                                   bag_mask, feature_mask)
-                if report_waves:
-                    arrs, leaf_id, n_waves = res
-                else:
-                    arrs, leaf_id = res
-                    # sentinel [waves, rows]: not counted
-                    n_waves = jnp.full((2,), -1.0, jnp.float32)
-                grew = arrs.num_leaves > 1
-                lv = jnp.where(grew, arrs.leaf_value * lr, 0.0)
-                arrs = arrs._replace(
-                    leaf_value=lv,
-                    internal_value=jnp.where(grew,
-                                             arrs.internal_value * lr, 0.0))
-                new_score = score.at[:, k].add(lv[leaf_id])
-                return arrs, leaf_id, new_score, n_waves
-            return grow_apply
+                    The leaf values are zeroed ON DEVICE when the tree
+                    failed to split (num_leaves <= 1), so the score update
+                    is a no-op and the host can check the leaf count one
+                    iteration late — that lag-1 check is what lets the next
+                    iteration's growth overlap the device->host fetch
+                    instead of serializing on it.
+
+                    ``fused`` (tpu_fused_grad): g/h arrive as None and the
+                    objective's gradients are computed HERE, inside the
+                    same jit as growth — XLA fuses the elementwise
+                    gradient math into the quantize/pack prologue, so the
+                    two [N] f32 arrays never round-trip HBM between
+                    dispatches.  The math is the same elementwise chain
+                    the unfused _grad_fn runs, so results are
+                    bit-identical (the differential suite pins it)."""
+                    if fused:
+                        s = score[:, 0] if K == 1 else score
+                        g, h = objective.get_gradients(s)
+                        if g.ndim == 1:
+                            g, h = g[:, None], h[:, None]
+                    if bynode_on:
+                        res = grow_raw(bins, g[:, k], h[:, k],
+                                       bag_mask, feature_mask,
+                                       tree_seed=seed)
+                    else:
+                        res = grow_raw(bins, g[:, k], h[:, k],
+                                       bag_mask, feature_mask)
+                    if report_waves:
+                        arrs, leaf_id, n_waves = res
+                    else:
+                        arrs, leaf_id = res
+                        # sentinel [waves, rows, overlap]: not counted
+                        n_waves = jnp.full((3,), -1.0, jnp.float32)
+                    grew = arrs.num_leaves > 1
+                    lv = jnp.where(grew, arrs.leaf_value * lr, 0.0)
+                    arrs = arrs._replace(
+                        leaf_value=lv,
+                        internal_value=jnp.where(grew,
+                                                 arrs.internal_value * lr,
+                                                 0.0))
+                    new_score = score.at[:, k].add(lv[leaf_id])
+                    return arrs, leaf_id, new_score, n_waves
+                return grow_apply
+            return build
 
         if getattr(self, "_raw_cached", False):
             self._grow_apply = _cached_jit(
                 ("grow_apply", id(grow_raw), bynode_on, report_waves),
-                build_grow_apply)
+                make_grow_apply(False))
         else:
-            self._grow_apply = build_grow_apply()
+            self._grow_apply = make_grow_apply(False)()
+        self._grow_apply_fused = None
+        if getattr(self, "_fused_grad", False) and objective is not None:
+            if getattr(self, "_raw_cached", False):
+                # the fused closure bakes the OBJECTIVE's state (label/
+                # weight/query arrays, link-function knobs) into the
+                # trace, so the cache key must be its CONTENT, not the
+                # instance id — identical refits (cv, grid search, the
+                # jit-cache reuse test) construct a fresh objective per
+                # Booster and must still share one compiled grower.
+                # Array state is hashed byte-exactly; scalar knobs ride
+                # the config digest (strict is safe — a miss costs a
+                # compile, a false hit would train on the wrong labels)
+                self._grow_apply_fused = _cached_fused_jit(
+                    ("grow_apply_fused", id(grow_raw), bynode_on,
+                     report_waves, _objective_content_key(objective),
+                     _ckpt_config_digest(self.config)),
+                    make_grow_apply(True))
+                self._fused_pin = grow_raw
+            else:
+                self._grow_apply_fused = make_grow_apply(True)()
 
         def build_valid_apply():
             @functools.partial(jax.jit, static_argnames=("k",))
@@ -1014,6 +1170,9 @@ class GBDT(PredictorBase):
         if getattr(self, "_grow_apply", None) is not None:
             self._grow_apply = obs.profile_wrap("lgbm/grow_apply",
                                                 self._grow_apply)
+        if getattr(self, "_grow_apply_fused", None) is not None:
+            self._grow_apply_fused = obs.profile_wrap(
+                "lgbm/grow_apply_fused", self._grow_apply_fused)
         self._grow = obs.profile_wrap("lgbm/grow", self._grow)
         self._valid_apply = obs.profile_wrap("lgbm/valid_update",
                                              self._valid_apply)
@@ -1335,10 +1494,40 @@ class GBDT(PredictorBase):
             leaves_grown: List[int] = []
             waves_total = None
             kern_rows = None
+            overlap_total = None
 
         health_on = obs.health_enabled()
+        needs_renew = (self.objective is not None
+                       and self.objective.is_renew_tree_output)
+        slow_path = needs_renew or self._cegb_on
+        # fused gradient pass: engages only when nothing this iteration
+        # needs the materialized [N] g/h arrays — custom gradients and
+        # the health tap read them host-side, the slow path refits
+        # between growth and shrinkage.  Profile mode also forces the
+        # unfused path: it exists to ATTRIBUTE time to units, and the
+        # fused jit would collapse lgbm/grad into lgbm/grow_apply —
+        # profile runs already trade pipelining for attribution, so the
+        # round-trip it re-pays is in character (never benchmark with
+        # profile on).  An armed fault harness forces unfused too: its
+        # "gradients" injection point lives on the separate dispatch,
+        # and a fault matrix that silently stopped injecting would pass
+        # vacuously.
+        from ..robust import faults as _faults
+        fused_now = (getattr(self, "_grow_apply_fused", None) is not None
+                     and gradients is None and hessians is None
+                     and not slow_path and not health_on
+                     and not obs.profile_enabled()
+                     and not _faults.armed())
         init_scores = [0.0] * K
-        if gradients is None or hessians is None:
+        if fused_now:
+            for k in range(K):
+                init_scores[k] = self._boost_from_average(k)
+            # gradients are computed INSIDE the growth jit
+            # (tpu_fused_grad) — no separate dispatch, no [N] f32 g/h
+            # materialization; the grad math lands in the "tree growth"
+            # phase timer
+            g = h = None
+        elif gradients is None or hessians is None:
             for k in range(K):
                 init_scores[k] = self._boost_from_average(k)
             with timetag("boosting (grad/hess)"):
@@ -1366,8 +1555,6 @@ class GBDT(PredictorBase):
             # reference is pinning HBM (obs/memory.py)
             obs.expect_released("train_score", self._train_score)
         feature_mask = self._feature_mask()
-        needs_renew = (self.objective is not None
-                       and self.objective.is_renew_tree_output)
 
         # Lag-1 stop check (fast path): grow_apply zeroes a dead tree's
         # values on device, so the host only needs the leaf count to DECIDE
@@ -1377,7 +1564,6 @@ class GBDT(PredictorBase):
         # The first iteration stays synchronous: its no-split case must
         # insert the boost_from_average constant tree immediately
         # (reference: gbdt.cpp:418-436).
-        slow_path = needs_renew or self._cegb_on
         lag_ok = self._lag_stop and not slow_path and self.iter_ >= 1
 
         should_continue = False
@@ -1410,10 +1596,12 @@ class GBDT(PredictorBase):
                         arrs, leaf_id = res
                     nl = int(arrs.num_leaves)
                 else:
+                    apply_fn = (self._grow_apply_fused if fused_now
+                                else self._grow_apply)
                     with timetag("tree growth"):
                         arrs, leaf_id, new_score, n_waves_dev = \
                             self._guard.run(
-                                lambda: self._grow_apply(
+                                lambda: apply_fn(
                                     self._grow_bins, g, h, self._bag_mask,
                                     feature_mask, self._train_score,
                                     jnp.float32(self.shrinkage_rate), k,
@@ -1488,6 +1676,9 @@ class GBDT(PredictorBase):
                         waves_total = (waves_total or 0) + w
                         if stats.size > 1:
                             kern_rows = (kern_rows or 0) + int(stats[1])
+                        if stats.size > 2:
+                            overlap_total = (overlap_total or 0) \
+                                + int(stats[2])
             self.models.append(tree)
         self._model_version += 1
 
@@ -1518,7 +1709,9 @@ class GBDT(PredictorBase):
         if telem:
             self._emit_iteration_record(t_iter0, phase0, compiles0,
                                         compile_s0, leaves_grown,
-                                        waves_total, kern_rows)
+                                        waves_total, kern_rows,
+                                        overlap_waves=overlap_total,
+                                        fused_grad=fused_now)
         self.iter_ += 1
         return False
 
@@ -1541,7 +1734,9 @@ class GBDT(PredictorBase):
             obs.divergence_audit(rec["stats"], iteration=self.iter_)
 
     def _emit_iteration_record(self, t_iter0, phase0, compiles0, compile_s0,
-                               leaves, waves, kern_rows=None) -> None:
+                               leaves, waves, kern_rows=None,
+                               overlap_waves=None,
+                               fused_grad: bool = False) -> None:
         """One structured telemetry record per boosting iteration: phase
         timings, train/valid metric values, counter snapshots, cumulative
         throughput, and a retrace warning when a steady-state iteration
@@ -1577,7 +1772,15 @@ class GBDT(PredictorBase):
             wave_fields = dict(
                 hist_mode=self._wave_info["hist_mode"],
                 wave_capacity=self._wave_info["wave_capacity"],
-                fused_sibling=self._wave_info["fused_sibling"])
+                fused_sibling=self._wave_info["fused_sibling"],
+                overlap=bool(self._wave_info.get("overlap", False)))
+            if (wave_fields["overlap"] and waves
+                    and overlap_waves is not None):
+                # fraction of kernel launches that genuinely co-ran with
+                # a deferred child scan (double-buffered waves) —
+                # bench_history trends it
+                wave_fields["overlap_frac"] = round(
+                    overlap_waves / waves, 4)
         obs.event(
             "iteration",
             iteration=self.iter_,
@@ -1592,6 +1795,12 @@ class GBDT(PredictorBase):
             recompiles=recompiles,
             partition_passes=part_passes,
             partition_batched=part_batched,
+            fused_grad=bool(fused_grad),
+            # HBM bytes the fused gradient pass kept off the bus this
+            # iteration: g and h as [N] f32, written by the objective
+            # and read back by the pack (ops/pallas_hist.
+            # grad_stream_bytes models the same legs)
+            grad_hbm_bytes_saved=(4 * N * 4 if fused_grad else 0),
             cum_row_iters_per_s=round(
                 N * self._telem_iters / max(self._telem_train_s, 1e-9), 1),
             **wave_fields)

@@ -18,6 +18,11 @@ from .gbdt import GBDT
 
 
 class GOSS(GBDT):
+    # the sampler ranks |g*h| host-dispatch-side and AMPLIFIES the
+    # sampled gradients before growth — the [N] g/h arrays must exist
+    # outside the growth jit, so the fused gradient pass cannot apply
+    _fused_grad_capable = False
+
     def init(self, config, train_ds, objective, metrics) -> None:
         super().init(config, train_ds, objective, metrics)
         if config.top_rate + config.other_rate > 1.0:

@@ -20,6 +20,10 @@ class RF(GBDT):
     # keep the grower two-output even when telemetry is on
     _telemetry_waves = False
 
+    # gradients are FROZEN from the constant init score (computed once in
+    # init) — there is nothing to fuse into the per-iteration growth jit
+    _fused_grad_capable = False
+
     def init(self, config, train_ds, objective, metrics) -> None:
         if not (config.bagging_freq > 0 and 0.0 < config.bagging_fraction < 1.0):
             log.fatal("RF mode requires bagging "

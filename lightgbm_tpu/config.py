@@ -294,8 +294,52 @@ class Config:
                                         # passes), highest = exact f32
                                         # (3 passes; also via gpu_use_dp),
                                         # bf16 = 1 pass (~8 bits).
+                                        # int16 / int8 = QUANTIZED
+                                        # accumulation (LightGBM 4.x's
+                                        # quantized-training trick):
+                                        # per-tree symmetric scales
+                                        # computed on device, stochastic-
+                                        # rounded integer g/h, exact
+                                        # integer MXU accumulation (2 / 1
+                                        # passes) with in-kernel f32
+                                        # dequant before the split scan;
+                                        # halves the per-row HBM vector
+                                        # stream.  Wave-kernel path only
+                                        # (mixed-width datasets fall back
+                                        # to 2xbf16); f32 modes stay the
+                                        # bit-exactness oracle.
                                         # Back-compat aliases: float32 ->
                                         # 2xbf16, bfloat16 -> bf16
+    tpu_fused_grad: bool = True         # fold objective.get_gradients
+                                        # into the SAME jit as tree
+                                        # growth, so the per-iteration
+                                        # [N] f32 g/h arrays are never
+                                        # materialized to HBM and read
+                                        # back (and under int16/int8 the
+                                        # quantize+pack fuses with the
+                                        # gradient math).  Bit-identical
+                                        # to the unfused path; engages
+                                        # only where eligible (single
+                                        # tree/iter objectives, plain
+                                        # gbdt/dart — GOSS and RF consume
+                                        # materialized gradients, custom
+                                        # objectives and health taps keep
+                                        # the unfused path).  false =
+                                        # the differential oracle
+    tpu_wave_overlap: bool = False      # double-buffered wave scheduling:
+                                        # defer each wave's child split-
+                                        # scan by one loop body so it
+                                        # executes AFTER the next wave's
+                                        # kernel dispatch (no data
+                                        # dependency between the two), at
+                                        # the cost of the commit phase
+                                        # seeing gains one wave late — a
+                                        # split-ORDER deviation of the
+                                        # kind wave scheduling already
+                                        # tolerates, never wrong
+                                        # histograms.  Off by default
+                                        # until a TPU window prices it
+                                        # (bench A/B: BENCH_OVERLAP=1)
     tpu_block_rows: int = 1024          # Pallas histogram kernel row-block
     tpu_wave_capacity: int = 63         # leaves histogrammed per wave pass
                                         # (<= 63: a g/h lane pair each in
@@ -661,9 +705,15 @@ class Config:
         if not (0.0 <= self.tpu_wave_gain_gate <= 1.0):
             log.fatal("tpu_wave_gain_gate should be in [0.0, 1.0]")
         if self.tpu_hist_dtype not in ("2xbf16", "bf16", "highest",
+                                       "int16", "int8",
                                        "float32", "bfloat16"):
-            log.fatal("tpu_hist_dtype should be 2xbf16, bf16 or highest "
+            log.fatal("tpu_hist_dtype should be 2xbf16, bf16, highest, "
+                      "int16 or int8 "
                       "(aliases: float32 -> 2xbf16, bfloat16 -> bf16)")
+        if self.tpu_hist_dtype in ("int16", "int8") \
+                and self.num_leaves > 32000:
+            log.fatal("quantized histogram modes carry leaf ids in the "
+                      "int16 vector stream: num_leaves must be <= 32000")
         if self.tpu_wave_capacity < 1:
             log.fatal("tpu_wave_capacity should be >= 1")
         if self.tpu_block_rows < 128 or self.tpu_block_rows % 128 != 0:

@@ -341,6 +341,26 @@ def partition_cost(N: int, splits: int = 1, batched: bool = True,
     return flops, nbytes
 
 
+def hist_quant_tolerance(counts, s_g, s_h, headroom: float = 1.01):
+    """Per-bin |Δ| tolerances ``(tol_g, tol_h)`` between a QUANTIZED
+    histogram (``tpu_hist_dtype=int16|int8``, dequantized by the kernel
+    before this scan consumes it) and the f32 oracle histogram.
+
+    The split scan is where the dequantized sums are actually consumed
+    (``best_split`` runs on value units), so this is the layer that owns
+    the accuracy contract: each row's stochastic-rounded g is within one
+    quantization step ``s_g`` of its f32 value and the integer
+    accumulation is exact, so a bin of ``counts`` rows deviates by at
+    most ``counts * s_g`` (ops/pallas_hist.quant_error_bound), times a
+    small ``headroom`` for f32 accumulation rounding past 2^24.  Count
+    channels carry exact 0/1 weights in every mode — zero tolerance.
+    tests/test_hist_quant.py asserts the kernel against these bounds."""
+    from ..ops.pallas_hist import quant_error_bound
+    tol_g = quant_error_bound(counts, s_g) * headroom
+    tol_h = quant_error_bound(counts, s_h) * headroom
+    return tol_g, tol_h
+
+
 def tree_health_stats(tree) -> jnp.ndarray:
     """Device-side reduction of a grown tree's numeric-health invariants
     (obs/health.py's gain/histogram tap — one small fetch per tree).

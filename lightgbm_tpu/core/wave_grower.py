@@ -32,8 +32,9 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ..ops.pallas_hist import (C_MAX, hist_pallas_wave, select_wave_blocks,
-                               wave_capacity_max)
+from ..ops.pallas_hist import (C_MAX, QUANT_MODES, QUANT_QMAX, _resolve_mode,
+                               hist_pallas_wave, select_wave_blocks,
+                               stochastic_round, wave_capacity_max)
 from .grower import TreeArrays, _empty_tree, decode_feature_col, go_left_node
 from .histogram import expand_bundled, fix_default_bins, hist_wave_xla
 from .meta import DeviceMeta, SplitConfig
@@ -190,6 +191,11 @@ class _WaveState(NamedTuple):
     n_rows_kern: jnp.ndarray = None  # f32 rows histogrammed (tier-aware;
     #   f32 so 10M rows x hundreds of passes can't wrap an i32 — the
     #   ~2^-24 relative rounding is irrelevant for cost attribution)
+    scan_small: jnp.ndarray = None  # i32 [P] deferred-scan queue (overlap
+    #   scheduling: the children a wave stored but has not scanned yet)
+    scan_large: jnp.ndarray = None  # i32 [P]
+    n_overlap: jnp.ndarray = None  # i32 bodies where a kernel launch and a
+    #   deferred scan genuinely co-ran (overlap_frac telemetry)
 
 
 def effective_pipeline(wave_capacity: int, packed: bool = True,
@@ -221,7 +227,10 @@ def build_wave_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
                        batched_apply: bool = True,
                        packed: bool = True,
                        fused_sibling: bool = True,
-                       feat_block: int = None):
+                       feat_block: int = None,
+                       reduce_max_fn=None,
+                       quant_seed: int = 0,
+                       overlap=False):
     """Unjitted ``grow(bins_fm, g, h, sample_mask, feature_mask)`` using the
     Pallas wave kernel. Returns (TreeArrays, leaf_id); with
     ``report_waves`` a third output ``stats`` (f32 [2]) carries the
@@ -292,8 +301,46 @@ def build_wave_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
     follow default-bin reconstruction — both keep the post-reduce XLA
     subtraction, which is bit-identical, so the knob is correctness-
     neutral everywhere.
+
+    ``highest`` in ("int16", "int8") turns on QUANTIZED accumulation
+    (ISSUE 11 / LightGBM 4.x quantized training): per-tree symmetric
+    scales s_g = max|g| / QMAX (global maxima via ``reduce_max_fn``
+    under data parallelism, so every shard quantizes identically), g/h
+    stochastic-rounded to integers (``stochastic_round`` — value-based,
+    seeded by ``quant_seed``), exact integer accumulation in the kernel
+    and an in-launch f32 dequant before the split scan.  The f32 modes
+    stay the bit-exactness oracle; the differential suite bounds the
+    histogram deltas analytically (``quant_error_bound``).
+
+    ``overlap`` schedules DOUBLE-BUFFERED waves (``tpu_wave_overlap``):
+    "on" defers each wave's child split-scan by one loop body, so the
+    scan of wave w executes AFTER wave w+1's kernel dispatch in program
+    order — the two have no data dependency (the scan reads wave w's
+    stored histograms, the kernel writes fresh buffers), so the
+    scheduler may overlap the VPU scan with the MXU launch whenever the
+    ready frontier exceeds the wave capacity.  The commit phase
+    consequently sees gains one wave later than the eager schedule — a
+    split-ORDER deviation of exactly the kind wave scheduling already
+    tolerates (accuracy-neutral, never wrong histograms).  "serial" is
+    the differential oracle: the SAME deferred schedule with the scan
+    executed before the kernel dispatch — bit-identical trees, no
+    overlap window.  False/"off" (default) keeps the eager schedule.
     """
     L = cfg.num_leaves
+    mode_r = _resolve_mode(highest)
+    quant = mode_r in QUANT_MODES
+    if quant:
+        assert mixed is None and not bundled, \
+            "quantized histogram modes need the pure-kernel un-bundled " \
+            "wave path (the mixed-width XLA side-pass is f32 and the " \
+            "EFB default-bin fix mixes integer and value units); gbdt " \
+            "downgrades the mode before building the grower"
+        assert reduce_fn is None or reduce_max_fn is not None, \
+            "data-parallel quantized growth needs reduce_max_fn so the " \
+            "quantization scales are global"
+        assert L + 2 < 32768, "quantized vecs carry leaf ids as int16"
+    overlap_mode = {False: "off", True: "on"}.get(overlap, overlap)
+    assert overlap_mode in ("off", "on", "serial"), overlap
     if B_phys is None:
         B_phys = B
     if cegb is not None and cegb.lazy is not None:
@@ -349,7 +396,8 @@ def build_wave_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
         columns (+ XLA side-pass over the wide ones when mixed, merged
         back into physical order).  Returns the kernel's channel-layout
         result — [F, B, C] (triple), (gh, cnt) (packed), and with
-        ``parent`` the (child, sibling) pair of either."""
+        ``parent`` the (child, sibling) pair of either.  Quantized modes
+        return INTEGER-unit sums; the split scan dequantizes."""
         hw = hist_pallas_wave(nb_fm, gvx, hvx, cvx, leafx, slot_leaf,
                               B=B_kern, block_rows=block_rows,
                               feat_block=feat_block,
@@ -364,7 +412,16 @@ def build_wave_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
         return jnp.concatenate([hw, hw_w], axis=0)[inv_perm]
 
     def _scan_leaf(hist_leaf, sg, sh, sc, min_c, max_c, depth, feature_mask,
-                   cegb_coupled):
+                   cegb_coupled, scales):
+        if quant:
+            # f32 dequant at SPLIT-SCAN time — the one place the integer
+            # sums are consumed as values.  Everything upstream (kernel
+            # accumulation, fused/XLA sibling subtraction, psum under
+            # data parallelism) stays in exact integer units, which is
+            # what keeps the packed/triple/fused/unfused layouts
+            # bit-identical under quantization.  Count channel scale 1.
+            hist_leaf = hist_leaf * jnp.stack(
+                [scales[0], scales[1], jnp.float32(1.0)])
         pen = (split_pen * sc + cegb_coupled) if cegb is not None else None
         bs = best_split(hist_leaf, sg, sh, sc, meta, cfg, min_c, max_c,
                         feature_mask=feature_mask, penalty_sub=pen)
@@ -517,7 +574,38 @@ def build_wave_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
             leaf_id=_apply_splits(st.leaf_id, bins_rm, slots))
 
     # ---------------- wave phase ---------------------------------------
-    def _wave(st: _WaveState, bins_fm, bins_rm, gv, hv, cv, feature_mask):
+    def _scan_children(st: _WaveState, smalls, larges, feature_mask,
+                       scales=None):
+        """Best-split scan for one wave's children (both sides) + the
+        [L]-sized ready/best bookkeeping.  Runs inline at wave time on
+        the eager schedule, deferred one loop body under ``overlap``.
+        ``scales`` dequantizes the integer histograms per leaf scan
+        under the quantized modes."""
+        cand = jnp.concatenate([smalls, larges])         # [2P]
+        valid = cand >= 0
+        cl = jnp.where(valid, cand, 0)
+        bs = jax.vmap(
+            _scan_leaf, in_axes=(0, 0, 0, 0, 0, 0, 0, None, None, None))(
+            st.hist[cl], st.leaf_g[cl], st.leaf_h[cl], st.leaf_c[cl],
+            st.leaf_min_c[cl], st.leaf_max_c[cl], st.leaf_depth[cl],
+            feature_mask, st.cegb_coupled, scales)
+        cl_w = jnp.where(valid, cand, L)
+        return st._replace(
+            hist_ready=st.hist_ready.at[cl_w].set(True),
+            best_gain=st.best_gain.at[cl_w].set(bs.gain),
+            best_feat=st.best_feat.at[cl_w].set(bs.feature),
+            best_thr=st.best_thr.at[cl_w].set(bs.threshold),
+            best_dl=st.best_dl.at[cl_w].set(bs.default_left),
+            best_lg=st.best_lg.at[cl_w].set(bs.left_g),
+            best_lh=st.best_lh.at[cl_w].set(bs.left_h),
+            best_lc=st.best_lc.at[cl_w].set(bs.left_c),
+            best_lout=st.best_lout.at[cl_w].set(bs.left_out),
+            best_rout=st.best_rout.at[cl_w].set(bs.right_out),
+            best_cb=st.best_cb.at[cl_w].set(bs.cat_bitset),
+        )
+
+    def _wave(st: _WaveState, bins_fm, bins_rm, gv, hv, cv, feature_mask,
+              scales=None):
         def do(st: _WaveState) -> _WaveState:
             c_idx = jnp.arange(C_MAX) // (2 if packed else 3)
             slot_leaf = jnp.where(c_idx < P, st.pend_small[jnp.minimum(c_idx, P - 1)],
@@ -688,33 +776,21 @@ def build_wave_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
             hist = st.hist.at[smalls_w].set(ws)
             hist = hist.at[larges_w].set(sib)
 
-            # best splits for all children of this wave
-            cand = jnp.concatenate([smalls, larges])     # [2P]
-            valid = cand >= 0
-            cl = jnp.where(valid, cand, 0)
-            bs = jax.vmap(
-                _scan_leaf, in_axes=(0, 0, 0, 0, 0, 0, 0, None, None))(
-                hist[cl], st.leaf_g[cl], st.leaf_h[cl], st.leaf_c[cl],
-                st.leaf_min_c[cl], st.leaf_max_c[cl], st.leaf_depth[cl],
-                feature_mask, st.cegb_coupled)
-            cl_w = jnp.where(valid, cand, L)
             st = st._replace(
                 hist=hist,
-                hist_ready=st.hist_ready.at[cl_w].set(True),
-                best_gain=st.best_gain.at[cl_w].set(bs.gain),
-                best_feat=st.best_feat.at[cl_w].set(bs.feature),
-                best_thr=st.best_thr.at[cl_w].set(bs.threshold),
-                best_dl=st.best_dl.at[cl_w].set(bs.default_left),
-                best_lg=st.best_lg.at[cl_w].set(bs.left_g),
-                best_lh=st.best_lh.at[cl_w].set(bs.left_h),
-                best_lc=st.best_lc.at[cl_w].set(bs.left_c),
-                best_lout=st.best_lout.at[cl_w].set(bs.left_out),
-                best_rout=st.best_rout.at[cl_w].set(bs.right_out),
-                best_cb=st.best_cb.at[cl_w].set(bs.cat_bitset),
                 pend_small=jnp.full((P,), -1, jnp.int32),
                 pend_large=jnp.full((P,), -1, jnp.int32),
                 pend_cnt=jnp.int32(0),
             )
+            if overlap_mode == "off":
+                # eager schedule: scan this wave's children immediately
+                st = _scan_children(st, smalls, larges, feature_mask,
+                                    scales)
+            else:
+                # double-buffered schedule: park the children in the
+                # deferred-scan queue; the loop driver scans them next
+                # body, adjacent to the NEXT wave's kernel dispatch
+                st = st._replace(scan_small=smalls, scan_large=larges)
             if report_waves:
                 st = st._replace(
                     n_waves=st.n_waves + 1,
@@ -736,6 +812,27 @@ def build_wave_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
         gv = (g * sample_mask).astype(jnp.float32)
         hv = (h * sample_mask).astype(jnp.float32)
         cv = sample_mask.astype(jnp.float32)
+        scales = None
+        if quant:
+            # per-tree symmetric scales from the GLOBAL |g|/|h| maxima
+            # (reduce_max_fn under data parallelism — every shard must
+            # quantize with the same step or the psum'd integer sums
+            # would mix units), then value-hash stochastic rounding.
+            # Masked-out rows are exact zeros and stay zeros, so the
+            # bag mask survives quantization bit-exactly.
+            qmax = QUANT_QMAX[mode_r]
+            ag = jnp.max(jnp.abs(gv))
+            ah = jnp.max(jnp.abs(hv))
+            if reduce_max_fn is not None:
+                ag = reduce_max_fn(ag)
+                ah = reduce_max_fn(ah)
+            s_g = jnp.maximum(ag, jnp.float32(1e-30)) / qmax
+            s_h = jnp.maximum(ah, jnp.float32(1e-30)) / qmax
+            gv = stochastic_round(gv / s_g, jnp.uint32(quant_seed))
+            hv = stochastic_round(hv / s_h,
+                                  jnp.uint32(quant_seed) ^
+                                  jnp.uint32(0x9E3779B9))
+            scales = (s_g, s_h)
         sum_g = jnp.sum(gv)
         sum_h = jnp.sum(hv)
         cnt = jnp.sum(cv)
@@ -743,6 +840,11 @@ def build_wave_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
             sum_g = reduce_fn(sum_g)
             sum_h = reduce_fn(sum_h)
             cnt = reduce_fn(cnt)
+        if quant:
+            # root sums back to value units AFTER the global reduce, so
+            # they are s * (exact integer total) on every shard
+            sum_g = sum_g * scales[0]
+            sum_h = sum_h * scales[1]
 
         Lf = jnp.zeros((L + 1,), jnp.float32)
         Li = jnp.zeros((L + 1,), jnp.int32)
@@ -773,17 +875,28 @@ def build_wave_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
             cegb_coupled=cegb_coupled,
             n_waves=jnp.int32(0) if report_waves else None,
             n_rows_kern=jnp.float32(0) if report_waves else None,
+            scan_small=(jnp.full((P,), -1, jnp.int32)
+                        if overlap_mode != "off" else None),
+            scan_large=(jnp.full((P,), -1, jnp.int32)
+                        if overlap_mode != "off" else None),
+            n_overlap=jnp.int32(0) if report_waves else None,
         )
         # Alternate split and wave phases until no ready leaf has positive
         # gain and nothing is pending.  The first body iteration has no
         # ready leaves, so it falls straight through to the root wave.
         # A while_loop (not fori) so a finished tree stops paying for
         # kernel passes — each iteration either splits a leaf or is the
-        # root wave, so it runs at most L times.
+        # root wave, so it runs at most L times.  Under ``overlap`` the
+        # loop additionally drains the deferred-scan queue before it may
+        # exit (an unscanned wave could still hold the best split).
         def loop_cond(st):
             ready = jnp.where(st.hist_ready[:L], st.best_gain[:L], NEG_INF)
             can_split = (jnp.max(ready) > 0.0) & (st.tree.num_leaves < L)
-            return (st.pend_cnt > 0) | can_split
+            cond = (st.pend_cnt > 0) | can_split
+            if overlap_mode != "off":
+                cond = cond | (st.scan_small >= 0).any() \
+                    | (st.scan_large >= 0).any()
+            return cond
 
         # row-major twin of the resident feature-major bins: materialized
         # once per tree (a ~50us transpose at 1M rows), it turns every
@@ -797,7 +910,24 @@ def build_wave_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
             bins_rm = (jnp.transpose(bins_fm)
                        if (compact or batched_apply) else bins_fm)
 
+        def _deferred_scan(st, q_small, q_large):
+            return jax.lax.cond(
+                (q_small >= 0).any() | (q_large >= 0).any(),
+                lambda s: _scan_children(s, q_small, q_large, feature_mask,
+                                         scales),
+                lambda s: s, st)
+
         def loop_body(st):
+            if overlap_mode != "off":
+                # pop the deferred-scan queue up front: the commit phase
+                # below runs on the gains scanned in EARLIER bodies (the
+                # one-wave lookahead), and the popped queue is scanned at
+                # this body's tail — after ("on") or before ("serial")
+                # this body's kernel dispatch
+                q_small, q_large = st.scan_small, st.scan_large
+                st = st._replace(
+                    scan_small=jnp.full((P,), -1, jnp.int32),
+                    scan_large=jnp.full((P,), -1, jnp.int32))
             ready = jnp.where(st.hist_ready[:L], st.best_gain[:L], NEG_INF)
             phase_max = jnp.max(ready)
 
@@ -808,7 +938,22 @@ def build_wave_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
                 def split_body(_, st):
                     return _split_once(st, bins_fm, feature_mask, phase_max)
                 st = jax.lax.fori_loop(0, P, split_body, st)
-            return _wave(st, bins_fm, bins_rm, gv, hv, cv, feature_mask)
+            if overlap_mode == "serial":
+                # the bit-identity oracle: same lookahead data flow, scan
+                # executed BEFORE the kernel dispatch — no overlap window
+                st = _deferred_scan(st, q_small, q_large)
+            had_kernel = st.pend_cnt > 0
+            st = _wave(st, bins_fm, bins_rm, gv, hv, cv, feature_mask,
+                       scales)
+            if overlap_mode == "on":
+                if report_waves:
+                    overlapped = had_kernel & ((q_small >= 0).any()
+                                               | (q_large >= 0).any())
+                    st = st._replace(
+                        n_overlap=st.n_overlap
+                        + overlapped.astype(jnp.int32))
+                st = _deferred_scan(st, q_small, q_large)
+            return st
 
         st = jax.lax.while_loop(loop_cond, loop_body, st)
 
@@ -821,7 +966,8 @@ def build_wave_grow_fn(meta: DeviceMeta, cfg: SplitConfig, B: int,
             return tr, st.leaf_id, st.cegb_coupled
         if report_waves:
             return tr, st.leaf_id, jnp.stack(
-                [st.n_waves.astype(jnp.float32), st.n_rows_kern])
+                [st.n_waves.astype(jnp.float32), st.n_rows_kern,
+                 st.n_overlap.astype(jnp.float32)])
         return tr, st.leaf_id
 
     return grow

@@ -14,6 +14,11 @@ class Objective:
     is_renew_tree_output = False
     need_accurate_prediction = True
     num_tree_per_iteration = 1
+    # get_gradients is pure traced jnp on (score, captured label/weight
+    # arrays) for every built-in objective, so the trainer may fold it
+    # into the growth jit (tpu_fused_grad) — an objective that ever
+    # computes gradients host-side must flip this off
+    supports_fused_grad = True
 
     def __init__(self, config):
         self.config = config

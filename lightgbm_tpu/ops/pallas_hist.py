@@ -26,6 +26,23 @@ once.  Two channel layouts exist:
   differential testing and for the mixed-width XLA side-pass, which
   speaks this layout.
 
+Quantized accumulation (``tpu_hist_dtype=int16|int8`` — LightGBM 4.x's
+quantized-training trick, Shi et al.): g/h arrive as stochastic-rounded
+INTEGERS under per-tree symmetric scales (``stochastic_round`` below;
+the grower computes scales on device from the global |g|/|h| maxima).
+The integer values are fed to the MXU exactly — int16 as an exact hi/lo
+bf16 split (|hi/256| <= 129 and lo in [0, 255] are both exactly
+representable in bf16's 8-bit mantissa), int8 as one exact bf16 pass —
+so accumulation is INTEGER-exact up to f32's 2^24 mantissa, layout- and
+shard-independent, and the fused sibling subtraction runs in integer
+units (bit-identical to the XLA oracle by construction).  The f32
+dequant (value = sum * scale per channel) happens downstream at
+split-scan time in the wave grower, the one place the sums are
+consumed as values.
+The HBM win: the per-row vector stream shrinks from [N, 4] f32 (16 B)
+to [N, 4] int16 (8 B), and with ``tpu_fused_grad`` the f32 g/h arrays
+never round-trip HBM at all (``grad_stream_bytes`` models both legs).
+
 Sibling fusion: with a ``parent`` operand the kernel also emits
 parent-minus-child sibling histograms from the same ``pallas_call`` —
 the parent block is read into VMEM once per feature block and the
@@ -69,6 +86,49 @@ _VMEM_BUDGET = 10 * 2 ** 20
 def wave_capacity_max(packed: bool) -> int:
     """Leaves one kernel launch can histogram under the given layout."""
     return P_MAX_PACKED if packed else P_MAX_TRIPLE
+
+
+# quantized-accumulation modes (tpu_hist_dtype) and their symmetric
+# integer range: q in [-QMAX, QMAX], scale = max|x| / QMAX per tree
+QUANT_MODES = ("int16", "int8")
+QUANT_QMAX = {"int16": 32767.0, "int8": 127.0}
+
+
+def stochastic_round(x, seed=0):
+    """Value-hash stochastic rounding to integers: ``floor(x + u(x))``
+    with ``u`` in [0, 1) derived from the float's own bit pattern mixed
+    with ``seed`` (two rounds of a murmur-style finalizer).
+
+    Properties the quantized path relies on:
+      * deterministic under a fixed seed (the satellite test pins it);
+      * value-based, not position-based — a row's rounding depends only
+        on its gradient VALUE, so data-parallel shards quantize
+        identically to the single-device run (mesh-parity for free);
+      * exact zeros stay zero (``floor(0 + u) == 0`` for u < 1), so
+        bag-masked rows never leak quantization noise;
+      * the result is always floor(x) or ceil(x).
+
+    ``seed`` may be a Python int or a traced uint32 scalar."""
+    xf = x.astype(jnp.float32)
+    bits = jax.lax.bitcast_convert_type(xf, jnp.uint32)
+    z = bits ^ jnp.uint32(seed)
+    z = (z ^ (z >> 16)) * jnp.uint32(0x7FEB352D)
+    z = (z ^ (z >> 15)) * jnp.uint32(0x846CA68B)
+    z = z ^ (z >> 16)
+    u = (z >> 8).astype(jnp.float32) * jnp.float32(2.0 ** -24)
+    return jnp.floor(xf + u)
+
+
+def quant_error_bound(counts, scale):
+    """Analytic per-bin bound on |dequantized − f32| histogram deltas:
+    each row's stochastic-rounded value is within one quantization step
+    of its f32 value, and the integer accumulation is exact, so a bin
+    accumulating ``counts`` rows is off by at most ``counts * scale``
+    (plus f32 accumulation rounding, covered by the 1.01 headroom the
+    differential suite applies).  The contract tests/test_hist_quant.py
+    asserts against the kernel."""
+    import numpy as np
+    return np.asarray(counts, np.float64) * float(scale)
 
 
 def _feat_pack(B: int, FB: int) -> int:
@@ -178,7 +238,19 @@ def _hist_wave_kernel(*refs, B: int, FB: int, mode: str, packed: bool,
     ``fused`` adds parent blocks as inputs and sibling blocks as outputs:
     on the final row step (the accumulators now hold the full child
     histograms for this feature block) the sibling is written as
-    parent - child straight from VMEM."""
+    parent - child straight from VMEM.
+
+    Quantized modes ("int16" / "int8"): vecs arrive as int16 integers;
+    int16 splits each value into an EXACT hi/lo bf16 pair (2 MXU
+    passes, like 2xbf16 but with zero representation error), int8 is
+    one exact bf16 pass.  Everything — accumulators, emitted
+    histograms, the fused sibling subtraction, and the parent operand —
+    stays in INTEGER units: dequantization happens downstream at
+    split-scan time (core/wave_grower.py), which keeps fused and
+    unfused siblings bit-identical (an in-kernel dequant would let the
+    compiler fuse ``parent - child*scale`` into an FMA whose rounding
+    the separate XLA subtraction cannot reproduce)."""
+    quant = mode in QUANT_MODES
     n_out = 2 if packed else 1
     n_par = n_out if fused else 0
     bins_ref, vecs_ref, slot_ref = refs[:3]
@@ -194,25 +266,38 @@ def _hist_wave_kernel(*refs, B: int, FB: int, mode: str, packed: bool,
             r[...] = jnp.zeros_like(r)
 
     vecs = vecs_ref[...]                                  # [BR, 4]
+    if quant:
+        vecs = vecs.astype(jnp.int32)                     # int16 -> i32
     leaf = vecs[:, 3].astype(jnp.int32)                   # [BR]
     slot_leaf = slot_ref[0, :].astype(jnp.int32)          # [C]
     lanes = 2 if packed else 3
     kind = jax.lax.broadcasted_iota(jnp.int32, (1, C_MAX), 1) % lanes
     m = (leaf[:, None] == slot_leaf[None, :]) & (slot_leaf >= 0)[None, :]
+    zero = 0 if quant else 0.0
     if packed:
         vals = jnp.where(kind == 0, vecs[:, 0][:, None], vecs[:, 1][:, None])
         slot_ct = slot_ref[1, :].astype(jnp.int32)        # [C] count lanes
         mc = (leaf[:, None] == slot_ct[None, :]) & (slot_ct >= 0)[None, :]
-        ct_b = jnp.where(mc, vecs[:, 2][:, None], 0.0).astype(jnp.bfloat16)
+        ct_src = vecs[:, 2][:, None]
+        ct_b = jnp.where(mc, ct_src, zero).astype(jnp.bfloat16)
     else:
         vals = jnp.where(kind == 0, vecs[:, 0][:, None],
                          jnp.where(kind == 1, vecs[:, 1][:, None],
                                    vecs[:, 2][:, None]))
-    gh = jnp.where(m, vals, 0.0)                          # [BR, C]
+    gh = jnp.where(m, vals, zero)                         # [BR, C]
     if mode == "2xbf16":
         gh_hi = gh.astype(jnp.bfloat16)
         gh_lo = (gh - gh_hi.astype(jnp.float32)).astype(jnp.bfloat16)
-    elif mode == "bf16":
+    elif mode == "int16":
+        # exact integer hi/lo split: hi is a multiple of 256 with
+        # |hi| <= 33024 (|hi/256| <= 129 fits bf16's 8-bit mantissa),
+        # lo in [0, 255] — both EXACT in bf16, so two passes accumulate
+        # the integer sum with no representation error at all
+        gh_hi_i = (gh >> 8) << 8
+        gh_hi = gh_hi_i.astype(jnp.bfloat16)
+        gh_lo = (gh - gh_hi_i).astype(jnp.bfloat16)
+    elif mode in ("bf16", "int8"):
+        # int8: |q| <= 127 is exact in bf16 — one pass, zero error
         gh_b = gh.astype(jnp.bfloat16)
 
     # Feature packing: with B <= 64 a single feature's one-hot only spans B
@@ -237,7 +322,7 @@ def _hist_wave_kernel(*refs, B: int, FB: int, mode: str, packed: bool,
                 oh, gh, dims,
                 precision=jax.lax.Precision.HIGHEST,
                 preferred_element_type=jnp.float32)
-        elif mode == "2xbf16":
+        elif mode in ("2xbf16", "int16"):
             oh = eq.astype(jnp.bfloat16)
             acc = (jax.lax.dot_general(
                        oh, gh_hi, dims,
@@ -276,20 +361,55 @@ def _hist_wave_kernel(*refs, B: int, FB: int, mode: str, packed: bool,
 
 def _resolve_mode(highest) -> str:
     """Back-compat: bool True -> "highest", False -> "bf16"; strings pass
-    through ("highest" | "2xbf16" | "bf16")."""
+    through ("highest" | "2xbf16" | "bf16" | "int16" | "int8")."""
     if isinstance(highest, str):
-        assert highest in ("highest", "2xbf16", "bf16"), highest
+        assert highest in ("highest", "2xbf16", "bf16") + QUANT_MODES, \
+            highest
         return highest
     return "highest" if highest else "bf16"
 
 
-# MXU passes per precision mode (see _hist_wave_kernel)
-WAVE_MXU_PASSES = {"highest": 3, "2xbf16": 2, "bf16": 1}
+# MXU passes per precision mode (see _hist_wave_kernel): int16 is the
+# exact hi/lo integer split (2 passes, like 2xbf16 but representation-
+# error-free); int8 is one exact bf16 pass
+WAVE_MXU_PASSES = {"highest": 3, "2xbf16": 2, "bf16": 1,
+                   "int16": 2, "int8": 1}
+
+# per-row bytes of the packed vector stream the kernel reads from HBM:
+# [N, 4] f32 (g, h, count-weight, leaf) vs [N, 4] int16 quantized
+_VEC_BYTES = {"highest": 16, "2xbf16": 16, "bf16": 16,
+              "int16": 8, "int8": 8}
+
+
+def grad_stream_bytes(n_rows, rows, mode="2xbf16",
+                      fused_grad: bool = False):
+    """Per-ITERATION HBM bytes of the gradient stream — the [N]-sized
+    legs this pipeline exists to shrink, modeled separately from the
+    bins/histogram legs so the quantized + fused-grad win is a checkable
+    prediction (docs/ROOFLINE.md "gradient stream" table):
+
+      * unfused: the objective writes g and h as [N] f32 (2*4*n), the
+        quantize/pack pass reads them back (2*4*n) and writes the packed
+        [N, 4] vector array (vec_bytes*n);
+      * fused (``tpu_fused_grad``): gradients are computed inside the
+        same jit that quantizes and packs — the only [N] write is the
+        vector array itself;
+      * both pay the kernel's per-histogrammed-row vector read
+        (vec_bytes per row over the tier-compacted ``rows`` total).
+
+    int16+fused vs the PR 8 2xbf16+unfused baseline at the HIGGS bench
+    shape is a ~2.3x byte cut (the >= 1.5x acceptance bar,
+    tests/test_hist_quant.py pins it)."""
+    mode = _resolve_mode(mode)
+    vb = _VEC_BYTES[mode]
+    pack_legs = float(n_rows) * (vb if fused_grad else (8 + 8 + vb))
+    return pack_legs + float(rows) * vb
 
 
 def wave_kernel_cost(rows, F: int, B: int, mode="2xbf16",
                      feat_block: int = _DEF_FB, waves: int = 1,
-                     packed: bool = False, fused: bool = False):
+                     packed: bool = False, fused: bool = False,
+                     fused_grad: bool = False, n_rows=None):
     """Analytical (FLOPs, HBM bytes) of ``hist_pallas_wave`` over ``rows``
     total rows across ``waves`` kernel launches — ``docs/ROOFLINE.md``'s
     hand-written cost model in code, so profile mode and
@@ -312,6 +432,13 @@ def wave_kernel_cost(rows, F: int, B: int, mode="2xbf16",
     re-read of the child).  The one-hot factor lives in VMEM and never
     touches HBM.  ``rows`` is the tier-compacted total (the wave
     grower's ``report_waves`` stats carry exactly this figure).
+
+    Quantized modes ("int16"/"int8") charge their exact-integer MXU
+    passes (2 / 1, see ``WAVE_MXU_PASSES``) and halve the per-row
+    vector-stream bytes ([N, 4] int16 vs f32).  With ``n_rows`` given
+    the model additionally charges the per-iteration gradient legs
+    (``grad_stream_bytes``): the f32 g/h round-trip the unfused path
+    pays and ``fused_grad`` deletes.
     """
     mode = _resolve_mode(mode)
     passes = WAVE_MXU_PASSES[mode] + (1 if packed else 0)
@@ -323,8 +450,13 @@ def wave_kernel_cost(rows, F: int, B: int, mode="2xbf16",
     per_launch = hist_bytes * n_out          # child histogram write(s)
     if fused:
         per_launch += 2 * hist_bytes * n_out  # parent read + sibling write
-    nbytes = (float(rows) * (F * 1 + 4 * 4)
+    nbytes = (float(rows) * (F * 1 + _VEC_BYTES[mode])
               + max(int(waves), 1) * per_launch)
+    if n_rows is not None:
+        # grad_stream_bytes counts the kernel's vector read too — that
+        # leg is already in nbytes above, so only the pack legs add here
+        nbytes += (grad_stream_bytes(n_rows, 0.0, mode,
+                                     fused_grad=fused_grad))
     return flops, nbytes
 
 
@@ -349,7 +481,8 @@ def select_wave_blocks(B: int, mode="2xbf16", packed: bool = True,
         pack = _feat_pack(B, FB)
         oh_bytes = block_rows * max(pack * B, C_MAX) * \
             (4 if mode == "highest" else 2)
-        stream = 2 * (FB * block_rows + block_rows * 4 * 4)  # bins + vecs
+        # bins + vecs double-buffered stream; quantized vecs are int16
+        stream = 2 * (FB * block_rows + block_rows * _VEC_BYTES[mode])
         total = FB * B * C_MAX * 4 * n_big + oh_bytes + stream
         if total <= vmem_budget:
             return block_rows, FB
@@ -388,8 +521,13 @@ def hist_pallas_wave(bins_fm, gv, hv, cv, leaf_id, slot_leaf, B: int,
     on the final row step — no separate XLA subtraction pass, no child
     re-read from HBM.
 
-    ``highest``: precision mode — True/"highest", "2xbf16", or
-    False/"bf16" (see _hist_wave_kernel)."""
+    ``highest``: precision mode — True/"highest", "2xbf16", "int16",
+    "int8", or False/"bf16" (see _hist_wave_kernel).  The quantized
+    modes take gv/hv as INTEGER-valued arrays (``stochastic_round``
+    output) and return histograms in INTEGER units — the caller
+    dequantizes at split-scan time (value = sum * scale).  The vector
+    stream travels as [N, 4] int16 (half the f32 HBM bytes), so leaf
+    ids must fit int16 (config caps ``num_leaves`` accordingly)."""
     F, N = bins_fm.shape
     BR = min(block_rows, max(128, N))
     FB = min(feat_block, max(F, 1))
@@ -409,9 +547,15 @@ def hist_pallas_wave(bins_fm, gv, hv, cv, leaf_id, slot_leaf, B: int,
                     for pa in par_arrs]
     Fp, Np = bins_fm.shape
     mode = _resolve_mode(highest)
+    quant = mode in QUANT_MODES
     # pack row vectors into one [N, 4] array (g, h, count-weight, leaf_id);
-    # leaf ids are exact in f32 up to 2^24
+    # leaf ids are exact in f32 up to 2^24.  Quantized modes carry the
+    # stream as int16 — the values are already integers by construction
+    # (stochastic_round output, 0/1 count weights, leaf ids capped), so
+    # the cast is exact and the HBM read halves.
     vecs = jnp.stack([gv, hv, cv, leaf_id.astype(jnp.float32)], axis=1)
+    if quant:
+        vecs = vecs.astype(jnp.int16)
     nb = Np // BR
 
     if packed:

@@ -74,6 +74,14 @@ def _all_gather(x):
     return jax.lax.all_gather(x, AXIS)
 
 
+def _pmax(x):
+    # global max for the quantized-histogram scale factors (ISSUE 11):
+    # every shard must derive the SAME s_g/s_h or the psum'd integer
+    # histograms would mix quantization units
+    obs.record_collective("pmax", x)
+    return jax.lax.pmax(x, AXIS)
+
+
 def _shard_map(fn, mesh, in_specs, out_specs):
     # jax.shard_map graduated from jax.experimental between the jax
     # versions we run on (TPU image vs CPU CI container); the replication
@@ -260,6 +268,7 @@ def make_data_parallel_wave_grower(meta: DeviceMeta, cfg: SplitConfig, B: int,
     the single-device fused path."""
     from ..core.wave_grower import build_wave_grow_fn
     grow = build_wave_grow_fn(meta, cfg, B, reduce_fn=_psum,
+                              reduce_max_fn=_pmax,
                               batched_apply=batched_apply, **wave_kw)
     return _shard_map(grow, mesh,
                       (P(None, AXIS), P(AXIS), P(AXIS), P(AXIS), P()),

@@ -59,9 +59,11 @@ _DIGEST_SKIP = frozenset((
     "tpu_health", "tpu_fingerprint_freq", "tpu_compile_cache_dir",
     "tpu_watchdog", "tpu_on_device_error", "tpu_device_retries",
     "tpu_wedge_timeout_s",
-    # kernel-pipeline knobs proven bit-identical by the ISSUE 8
-    # differential suite: flipping them must not refuse a resume
-    "tpu_fused_sibling", "tpu_batched_split_apply",
+    # kernel-pipeline knobs proven bit-identical by the ISSUE 8/11
+    # differential suites: flipping them must not refuse a resume.
+    # (tpu_wave_overlap and tpu_hist_dtype are deliberately NOT here —
+    # both change the trees a resumed run would grow.)
+    "tpu_fused_sibling", "tpu_batched_split_apply", "tpu_fused_grad",
 ))
 
 
@@ -76,9 +78,11 @@ def config_digest(config) -> str:
         if isinstance(v, (list, tuple)):
             v = list(v)
         if f.name == "tpu_hist_dtype":
-            # hash the RESOLVED kernel mode so back-compat aliases
-            # ("float32" -> "2xbf16", "bfloat16" -> "bf16") and the
-            # ISSUE 8 default rename don't invalidate old checkpoints
+            # hash the RESOLVED kernel mode — covering the quantized
+            # modes too, the same way — so back-compat aliases
+            # ("float32" -> "2xbf16", "bfloat16" -> "bf16"), the ISSUE 8
+            # default rename and the ISSUE 11 int16/int8 names can never
+            # refuse a resume whose effective mode did not change
             from ..boosting.gbdt import GBDT
             v = GBDT._hist_mode(config)
         items[f.name] = v

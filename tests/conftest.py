@@ -107,6 +107,17 @@ _SLOW = {
     "test_hist_fused.py::test_fused_packed_differential[categorical_bitset-23]",
     "test_hist_fused.py::test_mesh_data_parallel_packed_matches_single",
     "test_hist_fused.py::test_packed_capacity_cuts_waves",
+    "test_hist_quant.py::test_quant_training_auc_budget",
+    "test_hist_quant.py::test_overlap_bit_identical_to_serial_oracle",
+    "test_hist_quant.py::test_quant_grid_differential[nan_default_left-7-int16]",
+    "test_hist_quant.py::test_quant_grid_differential[categorical_bitset-7-int16]",
+    "test_hist_quant.py::test_quant_grid_differential[nan_default_left-7-int8]",
+    "test_hist_quant.py::test_quant_grid_differential[categorical_bitset-23-int8]",
+    "test_hist_quant.py::test_resume_bit_identical_int16",
+    "test_hist_quant.py::test_fused_grad_bit_identical_wave_path",
+    "test_hist_quant.py::test_fused_grad_bit_identical_bagging",
+    "test_hist_quant.py::test_quant_mesh_parity",
+    "test_hist_quant.py::test_fused_grad_ineligible_paths",
     "test_explain.py::test_oracle_matches_brute_force_categorical_nan",
     "test_robust.py::test_resume_bit_identical_dart",
     "test_robust.py::test_resume_bit_identical_two_device_mesh",

@@ -384,7 +384,8 @@ def test_config_defaults_and_dtype_aliases(monkeypatch):
     assert cfg.tpu_wave_capacity == 63
     for val, mode in (("2xbf16", "2xbf16"), ("float32", "2xbf16"),
                       ("bf16", "bf16"), ("bfloat16", "bf16"),
-                      ("highest", "highest")):
+                      ("highest", "highest"), ("int16", "int16"),
+                      ("int8", "int8")):
         c = Config.from_params({"tpu_hist_dtype": val, "verbose": -1})
         assert GBDT._hist_mode(c) == mode, (val, mode)
     with pytest.raises(Exception):
@@ -406,13 +407,17 @@ def test_booster_wave_info_and_fused_gate(monkeypatch):
                                                          params=base))
     info = bst._gbdt._wave_info
     assert info == {"hist_mode": "2xbf16", "wave_capacity": 63,
-                    "fused_sibling": True}
-    off = {**base, "tpu_fused_sibling": False, "tpu_hist_dtype": "highest"}
+                    "fused_sibling": True, "overlap": False,
+                    "fused_grad": True}
+    off = {**base, "tpu_fused_sibling": False, "tpu_hist_dtype": "highest",
+           "tpu_fused_grad": False, "tpu_wave_overlap": True}
     bst2 = lgb.Booster(params=off, train_set=lgb.Dataset(X, label=y,
                                                          params=off))
     info2 = bst2._gbdt._wave_info
     assert info2["fused_sibling"] is False
     assert info2["hist_mode"] == "highest"
+    assert info2["fused_grad"] is False
+    assert info2["overlap"] is True
 
 
 def test_wave_pipeline_digest_and_schema():

@@ -617,13 +617,15 @@ def test_tpu_window_checklist_stubbed(tmp_path):
     assert rec["parsed"]["health_failures"] == 0
     assert set(rec["legs"]) == {"bench", "bench_profile",
                                 "bench_maxbin63", "bench_unfused",
+                                "bench_quant", "bench_nofusedgrad",
                                 "prof_kernels", "bench_serve",
                                 "bench_explain", "trace"}
     assert all(leg["rc"] == 0 for leg in rec["legs"].values())
-    # bench legs ran four times (clean, profile, maxbin63, unfused)
+    # bench legs ran six times (clean, profile, maxbin63, unfused,
+    # quant, nofusedgrad)
     bench_calls = [c for c in fake.calls if any("bench.py" in a
                                                 for a in c)]
-    assert len(bench_calls) == 4
+    assert len(bench_calls) == 6
     # the record is bench_history-compatible: it folds into the
     # trajectory as a canary (cpu-forced), never a baseline
     bh = _import_tool("bench_history")

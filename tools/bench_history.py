@@ -63,6 +63,11 @@ _DIRECTIONS = [
     # noise hides it
     ("waves_per_tree", False),
     ("wave_capacity", True),
+    # quantized/fused/overlap pipeline stamps (ISSUE 11): HBM bytes the
+    # fused gradient pass saved per iteration and the fraction of waves
+    # whose kernel co-ran with a deferred scan — both higher-is-better
+    ("grad_hbm_bytes_saved", True),
+    ("overlap_frac", True),
     ("per_iter_s", False),
     ("rank_per_iter_s", False),
     ("compile_s", False),
@@ -255,7 +260,7 @@ def load_round(path: str) -> dict:
     # the embedded digest's wave_pipeline section as fallback
     wp = (td.get("wave_pipeline") if isinstance(td, dict) else None) or {}
     mode = {}
-    for k in ("hist_mode", "fused_sibling"):
+    for k in ("hist_mode", "fused_sibling", "fused_grad"):
         v = parsed.get(k, wp.get(k))
         if v is not None:
             mode[k] = v
@@ -359,12 +364,21 @@ def find_mode_regressions(rows: List[dict]) -> List[dict]:
         return []
     out = []
     lm, pm = latest["mode"], prior["mode"]
-    if pm.get("fused_sibling") is True and lm.get("fused_sibling") is False:
-        out.append({"metric": "fused_sibling", "round": latest["round"],
-                    "value": "off", "prior": "on",
-                    "prior_round": prior["round"]})
+    for knob in ("fused_sibling", "fused_grad"):
+        # a fused pass silently flipping off is a pipeline downgrade
+        # even when throughput noise hides it (fused_grad joins
+        # fused_sibling in ISSUE 11 — the unfused twin re-pays the [N]
+        # g/h round-trip every iteration)
+        if pm.get(knob) is True and lm.get(knob) is False:
+            out.append({"metric": knob, "round": latest["round"],
+                        "value": "off", "prior": "on",
+                        "prior_round": prior["round"]})
     if (lm.get("hist_mode") and pm.get("hist_mode")
             and lm["hist_mode"] != pm["hist_mode"]):
+        # ANY hist-mode change is flagged — which covers the ISSUE 11
+        # downgrade of interest (a quantized int16/int8 round silently
+        # reverting to an f32-family mode re-pays the full vector
+        # stream and MXU passes)
         out.append({"metric": "hist_mode", "round": latest["round"],
                     "value": lm["hist_mode"], "prior": pm["hist_mode"],
                     "prior_round": prior["round"]})

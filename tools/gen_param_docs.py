@@ -169,6 +169,13 @@ ENV_VARS = [
     ("LGBM_TPU_FAULTS_SEED",
      "seed for the fault harness's probabilistic conds (`p=`); the same "
      "spec + seed replays the identical fault schedule (default 0)."),
+    ("LGBM_TPU_FORCE_WAVE",
+     "test hook: set to `interpret` to route the serial grower through "
+     "the wave pipeline with the Pallas INTERPRETER on any backend, so "
+     "CPU CI trains end to end through the packed/fused/quantized/"
+     "overlap kernel path (tests/test_hist_quant.py's AUC-budget and "
+     "resume differentials ride it).  Orders of magnitude slower than "
+     "both the XLA fallback and a real TPU — never benchmark with it."),
     ("LGBM_TPU_EXPLAIN",
      "serving-engine override for `tpu_explain` — set to `0`/`false` to "
      "remove `POST /explain` and `PredictorSession.explain()` from a "

@@ -16,6 +16,15 @@ Legs (``PROF_LEGS`` comma-list, default all):
   kernelfused  — packed kernel WITH in-kernel sibling subtraction (the
                  shipped fast path; fused-vs-kernelpacked measures the
                  saved XLA subtraction + HBM round-trip)
+  kernelint16  — packed+fused kernel in QUANTIZED int16 mode (ISSUE 11:
+                 stochastic-rounded integer g/h, exact hi/lo bf16
+                 passes, int16 vector stream — vs the same-shape f32
+                 legs the delta is the quantization economics)
+  kernelint8   — same at int8 (one exact bf16 pass)
+  fusedgrad    — gradient-stream microbench: (grad jit -> [N] g/h ->
+                 grow jit) vs ONE jit computing gradients inline
+                 (tpu_fused_grad), against ``grad_stream_bytes`` — the
+                 per-iteration [N] round-trip the fused pass deletes
   full         — ``build_wave_grow_fn`` as shipped (packed + fused +
                  batched split apply)
   nofuse       — ``tpu_fused_sibling=false`` (the separate XLA
@@ -135,14 +144,17 @@ def _report(results: dict, name: str, seconds: float, flops=None,
 
 
 def leg_kernel(p, results, n_rep: int, name="kernel full pass",
-               packed=False, fused=False):
+               packed=False, fused=False, mode=None):
     """Bare wave-kernel full passes vs the analytical MXU roofline AND
     XLA's own cost_analysis of the compiled kernel.  ``packed`` runs the
     lane-pair layout (63 leaves, count folded), ``fused`` additionally
-    feeds a parent operand so the sibling subtraction happens in-kernel
-    — the three variants share one problem, so their deltas ARE the
-    layout economics."""
+    feeds a parent operand so the sibling subtraction happens in-kernel,
+    ``mode`` overrides the precision mode (quantized legs pre-quantize
+    g/h with ``stochastic_round`` exactly as the grower does) — the
+    variants share one problem, so their deltas ARE the layout/precision
+    economics."""
     rows, F, B = p["rows"], p["F"], p["B"]
+    mode = mode or MODE
     rng = np.random.default_rng(1)
     lanes = 2 if packed else 3
     Pcap = max(1, min(p["capacity"], pallas_hist.wave_capacity_max(packed)))
@@ -150,6 +162,13 @@ def leg_kernel(p, results, n_rep: int, name="kernel full pass",
     sl[:lanes * Pcap] = np.repeat(np.arange(Pcap), lanes)
     slot_leaf = jnp.asarray(sl)
     leaf_id = jnp.asarray(rng.integers(0, Pcap, rows, dtype=np.int32))
+    g, h = p["g"], p["h"]
+    if mode in pallas_hist.QUANT_MODES:
+        qmax = pallas_hist.QUANT_QMAX[mode]
+        s_g = jnp.maximum(jnp.max(jnp.abs(g)), 1e-30) / qmax
+        s_h = jnp.maximum(jnp.max(jnp.abs(h)), 1e-30) / qmax
+        g = pallas_hist.stochastic_round(g / s_g, 0)
+        h = pallas_hist.stochastic_round(h / s_h, 0)
     parent = None
     if fused:
         shape = (F, B, pallas_hist.C_MAX)
@@ -158,13 +177,13 @@ def leg_kernel(p, results, n_rep: int, name="kernel full pass",
     # feat_block from the same VMEM model the grower uses — the fused
     # blocks at B=256 don't fit the default FB=32 on a real chip
     _, FBk = pallas_hist.select_wave_blocks(
-        B, mode=MODE, packed=packed, fused=fused,
+        B, mode=mode, packed=packed, fused=fused,
         block_rows=p["block_rows"])
     kf = jax.jit(lambda: pallas_hist.hist_pallas_wave(
-        p["binsT"], p["g"], p["h"], p["mask"], leaf_id, slot_leaf, B=B,
-        block_rows=p["block_rows"], feat_block=FBk, highest=MODE,
+        p["binsT"], g, h, p["mask"], leaf_id, slot_leaf, B=B,
+        block_rows=p["block_rows"], feat_block=FBk, highest=mode,
         interpret=INTERP, packed=packed, parent=parent))
-    flops, nbytes = pallas_hist.wave_kernel_cost(rows, F, B, MODE,
+    flops, nbytes = pallas_hist.wave_kernel_cost(rows, F, B, mode,
                                                  packed=packed, fused=fused)
     extra = {"leaves_per_launch": Pcap}
     try:
@@ -284,7 +303,7 @@ def leg_grow(p, results, name: str, n_rep: int, compact=True,
                                       p["mask"], p["fmask"], n=n_rep)
     finally:
         wave_grower.hist_pallas_wave = real
-    waves, kern_rows = (int(x) for x in np.asarray(stats))
+    waves, kern_rows = (int(x) for x in np.asarray(stats)[:2])
     leaves = int(tr.num_leaves)
     flops = nbytes = None
     if not stub_kernel:
@@ -296,6 +315,61 @@ def leg_grow(p, results, name: str, n_rep: int, compact=True,
              "compile_s": round(compile_s, 1), "packed": packed,
              "fused_sibling": fused,
              "full_pass_equiv": round(kern_rows / rows, 2)})
+
+
+def leg_fusedgrad(p, results, n_rep: int):
+    """Gradient-stream microbench (ISSUE 11): the per-iteration
+    [N]-sized legs ``tpu_fused_grad`` deletes.  "gradstream separate"
+    computes a binary-logloss-shaped gradient in its OWN jit (g/h
+    materialize as device arrays) and consumes them in a second jit —
+    the unfused pipeline's structure; "gradstream fused" runs the SAME
+    math inside one jit so XLA fuses the gradient chain into the
+    consumer.  Both legs report against ``grad_stream_bytes``.  The
+    consumer is the quantize+pack prologue (int16), the exact fusion
+    partner the quantized wave path feeds.  Both legs pay the same
+    score/label reads, which grad_stream_bytes deliberately leaves out
+    — the modeled DELTA between the legs is the round-trip, and the
+    delta is what the A/B arbitrates."""
+    rows = p["rows"]
+    rng = np.random.default_rng(3)
+    score = jnp.asarray(rng.normal(size=rows).astype(np.float32))
+    label = jnp.asarray((rng.random(rows) < 0.5).astype(np.float32))
+    qmax = pallas_hist.QUANT_QMAX["int16"]
+
+    def grad(score):
+        prob = 1.0 / (1.0 + jnp.exp(-score))
+        return prob - label, prob * (1.0 - prob)
+
+    # the REAL quantize+pack prologue shape: all four vector lanes
+    # (g, h, count-weight, leaf) as [N, 4] int16 — so the measured
+    # write stream is the same 8 B/row grad_stream_bytes charges
+    leaf = jnp.zeros((rows,), jnp.float32)
+    cv = jnp.ones((rows,), jnp.float32)
+
+    def pack(g, h):
+        s_g = jnp.maximum(jnp.max(jnp.abs(g)), 1e-30) / qmax
+        s_h = jnp.maximum(jnp.max(jnp.abs(h)), 1e-30) / qmax
+        gq = pallas_hist.stochastic_round(g / s_g, 0)
+        hq = pallas_hist.stochastic_round(h / s_h, 0)
+        return jnp.stack([gq, hq, cv, leaf], axis=1).astype(jnp.int16)
+
+    grad_jit = jax.jit(grad)
+    pack_jit = jax.jit(pack)
+
+    def separate(score):
+        g, h = grad_jit(score)          # [N] f32 g/h materialize
+        return pack_jit(g, h)           # ...and are read back
+
+    fused_jit = jax.jit(lambda s: pack(*grad(s)))
+    nb_sep = pallas_hist.grad_stream_bytes(rows, 0.0, "int16",
+                                           fused_grad=False)
+    nb_fus = pallas_hist.grad_stream_bytes(rows, 0.0, "int16",
+                                           fused_grad=True)
+    dt, _ = timeit(separate, score, n=n_rep)
+    _report(results, "gradstream separate", dt, 8.0 * rows, nb_sep)
+    dt2, _ = timeit(fused_jit, score, n=n_rep)
+    _report(results, "gradstream fused", dt2, 8.0 * rows, nb_fus,
+            {"speedup_fused": round(dt / dt2, 2) if dt2 else None})
 
 
 def leg_gathers(p, results, n_rep: int):
@@ -334,8 +408,8 @@ def main() -> int:
     n_rep = _env_int("PROF_REPEAT", 3)
     legs = [s for s in os.environ.get(
         "PROF_LEGS",
-        "kernel,kernelpacked,kernelfused,full,nofuse,triple,seqapply,"
-        "nokernel,nocompact,gathers,partition"
+        "kernel,kernelpacked,kernelfused,kernelint16,kernelint8,fusedgrad,"
+        "full,nofuse,triple,seqapply,nokernel,nocompact,gathers,partition"
     ).split(",") if s]
     pf, pb = device_peaks()
     print(f"backend: {jax.default_backend()}  interpret: {INTERP}  "
@@ -350,6 +424,14 @@ def main() -> int:
     if "kernelfused" in legs:
         leg_kernel(p, results, n_rep, name="kernel packed+fused",
                    packed=True, fused=True)
+    if "kernelint16" in legs:
+        leg_kernel(p, results, n_rep, name="kernel int16",
+                   packed=True, fused=True, mode="int16")
+    if "kernelint8" in legs:
+        leg_kernel(p, results, n_rep, name="kernel int8",
+                   packed=True, fused=True, mode="int8")
+    if "fusedgrad" in legs:
+        leg_fusedgrad(p, results, n_rep)
     if "full" in legs:
         leg_grow(p, results, "grow full", n_rep)
     if "nofuse" in legs:

@@ -22,7 +22,13 @@ It also carries the fused-kernel smoke (ISSUE 8,
 ``tests/test_hist_fused.py::test_fused_packed_smoke``): the packed
 lane-pair + in-kernel-sibling wave kernel, run in Pallas interpret mode
 on CPU, bit-matches the triple-layout unfused oracle — so a histogram-
-pipeline regression can never hide behind a green perf round.
+pipeline regression can never hide behind a green perf round.  Since
+ISSUE 11 it additionally carries the quantized + fused-grad smoke
+(``tests/test_hist_quant.py::test_quant_fused_smoke``): int16
+stochastic-rounded accumulation within its analytic error bound,
+bit-identical across the packed/fused layout grid, and the fused
+gradient pass bit-identical to its unfused oracle — the new modes
+can't rot between TPU windows.
 
 The ``serve`` tier is not a pytest marker: it runs
 ``tools/bench_serve.py --smoke`` — start the HTTP server in-process,

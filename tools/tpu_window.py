@@ -18,6 +18,12 @@ capture checklist with health monitoring enabled:
 3b. ``python bench.py`` with ``BENCH_FUSED=0`` — the unfused-sibling
    A/B (ISSUE 8): same trees, separate XLA subtraction pass, so the
    delta vs leg 1 is the in-kernel fusion win, end to end;
+3c. ``python bench.py`` with ``BENCH_QUANT=int16`` — the quantized-
+   accumulation A/B (ISSUE 11): same problem, quantization-only delta,
+   so one window prices the int16 grad/hess lanes against leg 1;
+3d. ``python bench.py`` with ``BENCH_FUSED_GRAD=0`` — the fused-
+   gradient A/B twin: bit-identical trees, the delta is the per-
+   iteration [N] g/h HBM round-trip the fused pass deletes;
 4. ``tools/prof_kernels.py`` (``PROF_JSON=1``) — the leg decomposition,
    including the wave-partition legs (batched one-pass split apply vs
    the sequential per-split oracle, against ``partition_cost``) and the
@@ -81,7 +87,8 @@ _DRY_PROF_ENV = {
     "JAX_PLATFORMS": "cpu",
     "PROF_INTERPRET": "1", "PROF_ROWS": "4096", "PROF_FEATURES": "6",
     "PROF_LEAVES": "7", "PROF_MAXBIN": "63", "PROF_REPEAT": "1",
-    "PROF_LEGS": "kernel,kernelpacked,kernelfused,gathers,partition",
+    "PROF_LEGS": "kernel,kernelpacked,kernelfused,kernelint16,"
+                 "kernelint8,fusedgrad,gathers,partition",
 }
 _DRY_SERVE_ENV = {
     "JAX_PLATFORMS": "cpu",
@@ -180,6 +187,18 @@ def checklist_legs(art_dir: str, dry_run: bool, py: str = sys.executable):
         # fused_sibling stamp so the legs trend separately
         {"name": "bench_unfused", "argv": [py, bench],
          "env": env_for("bench_unfused", {"BENCH_FUSED": "0"}),
+         "parse_json": True},
+        # the quantized-accumulation A/B (ISSUE 11): same problem,
+        # quantization-only delta — bench_history reads the hist_mode
+        # stamp so the legs trend separately and a silent downgrade to
+        # f32 is flagged like a fused_sibling flip
+        {"name": "bench_quant", "argv": [py, bench],
+         "env": env_for("bench_quant", {"BENCH_QUANT": "int16"}),
+         "parse_json": True},
+        # the fused-gradient A/B twin: bit-identical trees, the delta
+        # is the per-iteration [N] g/h HBM round-trip
+        {"name": "bench_nofusedgrad", "argv": [py, bench],
+         "env": env_for("bench_nofusedgrad", {"BENCH_FUSED_GRAD": "0"}),
          "parse_json": True},
         {"name": "prof_kernels", "argv": [py, prof],
          "env": env_for("prof_kernels", {"PROF_JSON": "1"},
@@ -469,7 +488,9 @@ def main(argv=None) -> int:
     ap.add_argument("--legs", default="",
                     help="comma list restricting which checklist legs "
                          "run (bench,bench_profile,bench_maxbin63,"
-                         "prof_kernels,trace); default all")
+                         "bench_unfused,bench_quant,bench_nofusedgrad,"
+                         "prof_kernels,bench_serve,bench_explain,trace); "
+                         "default all")
     ap.add_argument("--wedge-retries", type=int, default=1,
                     help="times a wedge-shaped leg failure (timeout / "
                          "transient runtime error) is retried with "
