@@ -276,10 +276,16 @@ def main(argv=None) -> None:
         run_predict(cfg, params)
     elif task == "serve":
         run_serve(cfg, params)
+    elif task == "online":
+        # closed-loop learning service (online/loop.py): serve
+        # input_model behind the registry fleet AND consume the labeled
+        # stream back into refreshed versions via canary-gated swaps
+        from .online import run_online
+        run_online(cfg, params)
     elif task == "refit":
         run_refit(cfg, params)
     elif task == "convert_model":
         run_convert_model(cfg, params)
     else:
         log.fatal(f"Unknown task {task!r} (supported: train, predict, "
-                  "serve, convert_model, refit)")
+                  "serve, online, convert_model, refit)")

@@ -395,6 +395,13 @@ class Booster:
         params = dict(self.params or {})
         params["refit_decay_rate"] = decay_rate
         params.update(kwargs)
+        # file-loaded boosters carry no params: seed the objective from
+        # the model's minimal config, or the refit trainer would compute
+        # REGRESSION gradients for a binary/multiclass forest
+        if "objective" not in params and self.config is not None:
+            params["objective"] = self.config.objective
+            if self.config.num_class > 1:
+                params["num_class"] = self.config.num_class
         new_set = Dataset(data, label=label, params=params)
         nb = Booster(params=params, train_set=new_set)
         nb._gbdt.load_initial_models(

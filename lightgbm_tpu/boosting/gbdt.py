@@ -1921,6 +1921,11 @@ class GBDT(PredictorBase):
         list.extend(self.models, models)
         self._model_version += 1
         self.iter_ = len(models) // K
+        # the engine numbers checkpoints by its OWN loop counter (new
+        # rounds only); recording the seed size here keeps the wedge
+        # hook's iteration arithmetic right under init_model continue
+        # (restore_checkpoint_state overwrites this on resume)
+        self.num_init_iteration = self.iter_
         if not replay_scores:
             return
         for i, tree in enumerate(models):
@@ -2048,16 +2053,45 @@ class GBDT(PredictorBase):
                         type(hook_exc).__name__, hook_exc)
 
     # ------------------------------------------------------------------
-    def refit_models(self, decay_rate: Optional[float] = None) -> None:
+    def refit_models(self, decay_rate: Optional[float] = None,
+                     device: Optional[bool] = None) -> None:
         """Refit the existing tree STRUCTURES to this trainer's (new) data:
-        sequentially recompute each tree's leaf outputs from the current
-        gradients, mixing old and new by ``refit_decay_rate`` (reference:
+        recompute each tree's leaf outputs from the current gradients,
+        mixing old and new by ``refit_decay_rate`` (reference:
         GBDT::RefitTree gbdt.cpp:298-321 +
         SerialTreeLearner::FitByExistingTree serial_tree_learner.cpp:239-264).
-        Call load_initial_models first; scores are rebuilt from scratch."""
-        import jax.numpy as jnp
+        Call load_initial_models first; scores are rebuilt from scratch.
+
+        The default path is the DEVICE refit kernel (online/refit.py):
+        one stacked leaf-index scan plus a jitted per-iteration
+        segment-sum/closed-form/score-update step.  ``device=False`` (or
+        ``tpu_refit_device=false``) keeps the host per-tree bincount
+        loop — the retained differential oracle the parity tests pin the
+        kernel against (per-leaf 1e-6, tests/test_online.py)."""
+        import time as _time
         decay = float(self.config.refit_decay_rate
                       if decay_rate is None else decay_rate)
+        use_device = (bool(getattr(self.config, "tpu_refit_device", True))
+                      if device is None else bool(device))
+        t0 = _time.perf_counter()
+        if use_device and self._grad_fn is not None and self.models:
+            from ..online.refit import device_refit_models
+            device_refit_models(self, decay)
+            mode = "device"
+        else:
+            self._refit_models_host(decay)
+            mode = "host"
+        if obs.enabled():
+            obs.event("refit", trees=len(self.models),
+                      rows=int(self.train_ds.num_data), decay=decay,
+                      wall_s=round(_time.perf_counter() - t0, 4),
+                      mode=mode,
+                      iterations=len(self.models) // max(self.num_tpi, 1))
+
+    def _refit_models_host(self, decay: float) -> None:
+        """The host per-tree bincount refit loop — the differential
+        oracle for the device kernel (f64 sums, one dispatch per tree)."""
+        import jax.numpy as jnp
         K = self.num_tpi
         cfg = self.split_cfg
         trees = list(self.models)  # materialize

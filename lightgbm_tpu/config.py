@@ -158,7 +158,7 @@ class Config:
     """
     # ---- Core ----
     config: str = ""
-    task: str = "train"                 # train, predict, convert_model, refit
+    task: str = "train"                 # train, predict, serve, online, convert_model, refit
     objective: str = "regression"
     boosting: str = "gbdt"              # gbdt, rf, dart, goss
     data: str = ""
@@ -614,6 +614,63 @@ class Config:
                                         # (LGBM_TPU_EXPLAIN_MAX_WAIT_MS
                                         # env)
 
+    # ---- Online learning (online/ subsystem) ----
+    tpu_refit_device: bool = True       # leaf-refit path: true = the
+                                        # device refit kernel (one
+                                        # stacked leaf-index scan + a
+                                        # jitted per-iteration segment-
+                                        # sum/closed-form step,
+                                        # online/refit.py); false = the
+                                        # host per-tree bincount loop,
+                                        # retained as the differential
+                                        # oracle (per-leaf 1e-6 parity
+                                        # pinned in tests/test_online.py)
+    tpu_online_mode: str = "refit"      # task=online refresh strategy:
+                                        # refit = re-estimate the frozen
+                                        # forest's leaves over the
+                                        # window (decay-mixed), continue
+                                        # = boost tpu_online_trees NEW
+                                        # trees in the model's own bin
+                                        # space (no training-data
+                                        # rebinning either way)
+    tpu_online_window: int = 50000      # bounded ingest window: the
+                                        # freshest labeled rows kept for
+                                        # the next refresh; older rows
+                                        # fall out (memory-bounded, like
+                                        # the serve queue)
+                                        # (LGBM_TPU_ONLINE_WINDOW env)
+    tpu_online_refit_every: int = 5000  # row cadence: refresh after
+                                        # this many newly ingested rows;
+                                        # 0 = rows never trigger
+                                        # (LGBM_TPU_ONLINE_REFIT_EVERY
+                                        # env)
+    tpu_online_refit_every_s: float = 0.0  # time cadence in seconds
+                                        # (OR-composed with the row
+                                        # cadence); a firing with no
+                                        # fresh rows is an ingest stall:
+                                        # skipped + logged + telemetry-
+                                        # stamped, never a stale refit;
+                                        # 0 = time never triggers
+    tpu_online_trees: int = 10          # boosting rounds added per
+                                        # refresh in continue mode
+    tpu_online_decay: float = -1.0      # refit decay for the online
+                                        # loop (new leaf = decay*old +
+                                        # (1-decay)*refit); negative =
+                                        # inherit refit_decay_rate
+    tpu_online_model: str = "default"   # registry model name the loop
+                                        # pushes refreshed versions to
+                                        # (POST /models/{name}/swap)
+    tpu_online_source: str = ""         # label stream for task=online: a
+                                        # JSONL file of {"x": [...],
+                                        # "y": <label>} lines ("" falls
+                                        # back to data)
+    tpu_online_follow: bool = False     # tail the stream for appended
+                                        # lines instead of stopping at
+                                        # EOF (the feeder-process mode)
+    tpu_online_dir: str = ""            # where refreshed model versions
+                                        # are written ("" = a fresh temp
+                                        # directory)
+
     # ---- derived (not user-settable) ----
     is_parallel: bool = dataclasses.field(default=False, repr=False)
 
@@ -771,6 +828,24 @@ class Config:
             log.fatal("tpu_wedge_timeout_s should be >= 0")
         if self.tpu_serve_reprobe_s < 0:
             log.fatal("tpu_serve_reprobe_s should be >= 0")
+        if self.tpu_online_mode not in ("refit", "continue"):
+            log.fatal("tpu_online_mode should be refit or continue")
+        if self.tpu_online_window < 1:
+            log.fatal("tpu_online_window should be >= 1")
+        if self.tpu_online_refit_every < 0:
+            log.fatal("tpu_online_refit_every should be >= 0")
+        if self.tpu_online_refit_every_s < 0:
+            log.fatal("tpu_online_refit_every_s should be >= 0")
+        if self.tpu_online_trees < 1:
+            log.fatal("tpu_online_trees should be >= 1")
+        if self.tpu_online_decay > 1.0:
+            log.fatal("tpu_online_decay should be <= 1 (negative = "
+                      "inherit refit_decay_rate)")
+        if (self.task == "online" and self.tpu_online_refit_every <= 0
+                and self.tpu_online_refit_every_s <= 0):
+            log.fatal("task=online needs a refresh cadence: set "
+                      "tpu_online_refit_every (rows) and/or "
+                      "tpu_online_refit_every_s (seconds)")
 
     # ------------------------------------------------------------------
     def num_model_per_iteration(self) -> int:

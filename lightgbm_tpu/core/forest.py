@@ -148,12 +148,16 @@ def forest_predict_fn(meta: DeviceMeta, K: int, early_stop: Optional[dict] = Non
     return jax.jit(predict)
 
 
-def forest_leaf_fn(meta: DeviceMeta):
+def forest_leaf_fn(meta: DeviceMeta, phys: bool = False):
     """Build ``leaves(forest, bins) -> [T, N] i32`` — the device analog
     of per-tree ``Tree.predict_leaf`` (reference: Predictor's leaf-index
     mode, src/application/predictor.hpp:110-125).  One scan over the
     stacked forest emits every tree's leaf index for every row; callers
-    transpose to the ``[N, T]`` layout ``predict_leaf`` returns."""
+    transpose to the ``[N, T]`` layout ``predict_leaf`` returns.
+
+    ``phys=True`` reads EFB physical-column bins (a bundled training
+    dataset's ``X_bin``) — the online/ device refit scans the TRAINING
+    bin matrix, which keeps the bundled layout serving never sees."""
     import jax
     import jax.numpy as jnp
 
@@ -172,7 +176,7 @@ def forest_leaf_fn(meta: DeviceMeta):
                 leaf_value=tree.leaf_value, leaf_count=None,
                 leaf_weight=None,
                 num_leaves=tree.num_leaves, cat_bitset=tree.cat_bitset)
-            return carry, predict_leaf_bins(arrs, bins, meta)
+            return carry, predict_leaf_bins(arrs, bins, meta, phys=phys)
 
         _, out = jax.lax.scan(body, jnp.int32(0), forest)
         return out

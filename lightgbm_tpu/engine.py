@@ -48,16 +48,20 @@ def train(params: Dict[str, Any], train_set: Dataset,
         train_set.categorical_feature = categorical_feature
 
     init_trees = None
+    init_model_desc = None
     if init_model is not None:
         # continued training (reference: boosting.cpp:35-69 — a model file
         # or Booster seeds the forest and scores before the first iteration)
         if isinstance(init_model, Booster):
             init_trees = list(init_model._gbdt.models)
+            init_model_desc = (f"<in-memory Booster, {len(init_trees)} "
+                               "tree(s)>")
         elif isinstance(init_model, (str, bytes)) or hasattr(init_model,
                                                              "__fspath__"):
             import os
             from .io.model_io import load_model_file
-            loaded, _ = load_model_file(os.fsdecode(init_model))
+            init_model_desc = os.fsdecode(init_model)
+            loaded, _ = load_model_file(init_model_desc)
             init_trees = list(loaded.models)
         else:
             raise TypeError("init_model should be a Booster or a model "
@@ -74,8 +78,16 @@ def train(params: Dict[str, Any], train_set: Dataset,
     ckpt_peeked = ckpt_mgr.peek(booster.config) if ckpt_mgr else None
     if init_trees:
         if ckpt_peeked is not None:
-            log.warning("init_model ignored: resuming from checkpoint %s",
-                        ckpt_peeked[0])
+            # both paths in ONE line: a stale-refresh incident (online
+            # loop resuming over a leftover checkpoint when a fresher
+            # init_model exists) is only debuggable if the log says
+            # WHICH init model lost to WHICH checkpoint
+            log.warning("init_model %s ignored: resuming from checkpoint "
+                        "%s (a checkpoint is this run's own progress and "
+                        "supersedes the init model it was seeded from; "
+                        "delete the checkpoint directory to restart from "
+                        "the init model)",
+                        init_model_desc, ckpt_peeked[0])
         else:
             booster._gbdt.load_initial_models(init_trees)
     is_valid_contain_train = False
@@ -181,10 +193,17 @@ def train(params: Dict[str, Any], train_set: Dataset,
     if ckpt_mgr is not None:
         # the wedge hook: a fatal device error mid-iteration rolls back
         # to the iteration boundary and checkpoints it (eval_history is
-        # captured by reference, so the hook always sees the latest)
+        # captured by reference, so the hook always sees the latest).
+        # Checkpoints are numbered by the ENGINE loop counter — under
+        # init_model continue the trainer's iter_ includes the seeded
+        # iterations, and saving under that number would shadow the
+        # periodic checkpoints and make the resume skip the remaining
+        # rounds (found by the fault matrix's crash-mid-continue leg)
+        num_init = booster._gbdt.iter_ - start_round
         booster._gbdt._ckpt_hook = (
-            lambda reason: ckpt_mgr.save(booster, booster._gbdt.iter_,
-                                         eval_history, reason=reason))
+            lambda reason: ckpt_mgr.save(
+                booster, booster._gbdt.iter_ - num_init,
+                eval_history, reason=reason))
     try:
         for i in range(start_round, num_boost_round):
             if stopped_in_replay or preempted:

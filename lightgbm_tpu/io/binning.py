@@ -499,6 +499,43 @@ class BinMapper:
         return m
 
     @classmethod
+    def categorical_from_categories(cls, categories) -> "BinMapper":
+        """Model-derived CATEGORICAL mapper for the online
+        train-continue path (online/binspace.py): the bins are exactly
+        the category values the forest's bitsets reference, plus a
+        trailing NaN/unseen bin that no node bitset can contain — so
+        NaN, negatives and categories the model never saw all land in a
+        bin whose bit is set nowhere and route right, exactly like the
+        reference's CategoricalDecision (tree.h:262-303).
+
+        Follows ``_find_bin_categorical``'s conventions: bin 0 must not
+        be category 0 (0 is the default/elided value; find_bin swaps it
+        out of bin 0, and ``find_bin`` checks ``default_bin > 0``), and
+        the NaN catch-all is category ``-1`` at the LAST bin (which is
+        also where ``value_to_bin`` sends unmatched categories)."""
+        m = cls()
+        cats = sorted({int(c) for c in categories if int(c) >= 0})
+        if not cats:
+            return m  # trivial: the model references no category
+        if cats[0] == 0:
+            if len(cats) == 1:
+                cats.append(1)
+            cats[0], cats[1] = cats[1], cats[0]
+        cats.append(-1)  # NaN / unseen catch-all, never in a bitset
+        m.bin_2_categorical = cats
+        m.categorical_2_bin = {c: i for i, c in enumerate(cats)}
+        m.num_bin = len(cats)
+        m.bin_type = BIN_CATEGORICAL
+        m.missing_type = MISSING_NAN
+        m.is_trivial = False
+        m.sparse_rate = 0.0
+        m.min_val = float(min(c for c in cats if c >= 0))
+        m.max_val = float(max(cats))
+        m.default_bin = int(m.value_to_bin(0.0))
+        m.most_freq_bin = m.default_bin
+        return m
+
+    @classmethod
     def from_dict(cls, d: dict) -> "BinMapper":
         m = cls()
         m.num_bin = int(d["num_bin"])

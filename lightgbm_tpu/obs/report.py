@@ -182,6 +182,9 @@ def summarize(events: List[dict]) -> dict:
     robust = robust_summary(events)
     if robust:
         out["robust"] = robust
+    online = online_summary(events)
+    if online:
+        out["online"] = online
     return out
 
 
@@ -442,6 +445,52 @@ def robust_summary(events: List[dict]) -> dict:
     return out
 
 
+def online_summary(events: List[dict]) -> dict:
+    """Fold the online-learning events (``refit`` from
+    boosting/gbdt.py's leaf re-estimation, ``online_refresh`` from
+    online/loop.py's cadence firings) into one closed-loop digest: how
+    many refreshed versions were produced/pushed, what was rejected or
+    skipped, and what the refits cost.  Empty when the run neither
+    refit nor ran the online loop."""
+    refits = [e for e in events if e.get("event") == "refit"]
+    refreshes = [e for e in events if e.get("event") == "online_refresh"]
+    if not (refits or refreshes):
+        return {}
+    out = {
+        "refits": len(refits),
+        "refreshes": len(refreshes),
+        "refreshes_ok": sum(1 for e in refreshes if e.get("ok")),
+        "refreshes_failed": sum(1 for e in refreshes
+                                if not e.get("ok", True)
+                                and not e.get("skipped")),
+        "refreshes_skipped": sum(1 for e in refreshes if e.get("skipped")),
+        "rows_refreshed": sum(int(e.get("rows", 0) or 0)
+                              for e in refreshes if e.get("ok")),
+    }
+    if refits:
+        last = refits[-1]
+        out["refit_rows"] = sum(int(e.get("rows", 0) or 0) for e in refits)
+        out["refit_wall_s"] = round(sum(float(e.get("wall_s", 0.0) or 0.0)
+                                        for e in refits), 4)
+        out["last_refit"] = {k: last.get(k) for k in
+                             ("trees", "rows", "decay", "mode")}
+    if refreshes:
+        lat = sorted(float(e.get("ms", 0.0) or 0.0)
+                     for e in refreshes if e.get("ok"))
+        out["refresh_p50_ms"] = percentile(lat, 0.50)
+        versions = [int(e.get("version", 0) or 0) for e in refreshes
+                    if e.get("ok")]
+        if versions:
+            out["last_version"] = max(versions)
+        skips = defaultdict(int)
+        for e in refreshes:
+            if e.get("skipped"):
+                skips[str(e["skipped"])] += 1
+        if skips:
+            out["skipped_by_reason"] = dict(sorted(skips.items()))
+    return out
+
+
 def trace_summary(events: List[dict]) -> dict:
     """Fold ``span`` events (obs/spans.py) into the trace digest:
     span/trace counts and per-name call/duration aggregates.  Empty when
@@ -672,6 +721,26 @@ EVENT_SCHEMAS = {
     "serve_recovered": {
         "plane": (str, False),
     },
+    # online learning (boosting/gbdt.py refit_models + online/loop.py)
+    "refit": {
+        "trees": (int, True),
+        "rows": (int, True),
+        "decay": (_NUM, True),
+        "wall_s": (_NUM, True),
+        "mode": (str, True),       # device (the jitted kernel) | host
+                                   # (the retained bincount oracle)
+        "iterations": (int, False),
+    },
+    "online_refresh": {
+        "mode": (str, True),       # refit | continue
+        "ok": (bool, True),
+        "rows": (int, False),
+        "ms": (_NUM, False),
+        "version": (int, False),   # successful pushes only
+        "skipped": (str, False),   # e.g. "ingest_stall" — the cadence
+                                   # fired but no fresh rows arrived
+        "error": (str, False),
+    },
 }
 
 
@@ -867,6 +936,25 @@ def render(digest: dict) -> str:
             out.append(f"  retries at {point:<20} {v.get('retries', 0)} "
                        f"({v.get('transient', 0)} transient, "
                        f"{v.get('fatal', 0)} fatal)")
+    if digest.get("online"):
+        o = digest["online"]
+        out.append("")
+        line = (f"online loop: {o.get('refreshes_ok', 0)} refresh(es) "
+                f"pushed, {o.get('refreshes_failed', 0)} failed, "
+                f"{o.get('refreshes_skipped', 0)} skipped, "
+                f"{o['refits']} refit(s)")
+        if o.get("last_version"):
+            line += f" — live at v{o['last_version']}"
+        out.append(line)
+        if o.get("last_refit"):
+            lr = o["last_refit"]
+            out.append(f"  last refit: {lr.get('trees')} tree(s) over "
+                       f"{lr.get('rows')} row(s), decay "
+                       f"{lr.get('decay')}, {lr.get('mode')} path "
+                       f"({o.get('refit_wall_s', 0)}s total)")
+        if o.get("skipped_by_reason"):
+            out.append("  skipped: " + ", ".join(
+                f"{k}={v}" for k, v in o["skipped_by_reason"].items()))
     if digest.get("trace"):
         t = digest["trace"]
         out.append("")

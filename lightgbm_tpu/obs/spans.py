@@ -75,6 +75,10 @@ _FLIGHT_EVENTS = frozenset((
     # bounces
     "serve_swap", "serve_canary", "serve_rollback", "serve_failover",
     "serve_drain",
+    # online learning (online/loop.py + refit_models): a bounced or
+    # skipped refresh is the first thing a stale-model post-mortem
+    # needs beside the swap/canary records it produced
+    "online_refresh", "refit",
 ))
 
 

@@ -1,0 +1,26 @@
+"""online/ — the closed-loop learning service (ROADMAP direction 4).
+
+Four layers turn the resilient serving fleet into a daily-fresh-model
+system: the device refit kernel (refit.py — jitted leaf re-estimation
+over the frozen forest), the model-own bin space (binspace.py —
+``train_continue``/``refit_from_model`` work from a model file alone,
+binning new rows through ``BinMapper.from_thresholds``), the streaming
+driver (loop.py — ``task=online``: ingest window, refresh cadence,
+registry push), and the faults/obs wiring that makes a bad refresh a
+rejected swap instead of an incident.
+"""
+from .binspace import (continue_dataset, model_bin_mappers,
+                       refit_from_model, train_continue)
+from .loop import OnlineLoop, read_label_stream, run_online
+from .refit import device_refit_models
+
+__all__ = [
+    "OnlineLoop",
+    "continue_dataset",
+    "device_refit_models",
+    "model_bin_mappers",
+    "read_label_stream",
+    "refit_from_model",
+    "run_online",
+    "train_continue",
+]

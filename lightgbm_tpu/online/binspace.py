@@ -1,0 +1,169 @@
+"""Model-own training bin space: incremental training without the
+original training data.
+
+Continued training normally rebins the NEW data from scratch, which
+(a) needs a big enough sample to find good quantiles and (b) produces a
+bin space unrelated to the one the serving fleet rebuilt from the model
+(serve/packing.py).  The online loop instead bins new rows through the
+MODEL'S OWN bin space — ``BinMapper.from_thresholds`` for numerical
+features (the sorted distinct split thresholds become the bin bounds,
+so every node decision is reproduced exactly) and
+``BinMapper.categorical_from_categories`` for categorical ones (the
+bitset categories become the bins, plus a NaN/unseen catch-all) — so
+``train_continue`` works from a ``model_file`` alone, exactly like
+``serve/`` does, and the replayed forest routes every row identically
+to the host's value-space traversal (tests/test_online.py pins the
+round trip on categorical-bitset and NaN-default-left features).
+
+New trees then grow IN that space: their split thresholds are existing
+model thresholds (numerical splits pick a bin upper bound), which keeps
+every downstream serving bin space stable across refreshes.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..io.binning import BinMapper
+from ..serve.packing import collect_split_state
+from ..utils import log
+
+
+def model_bin_mappers(models, num_features: int) -> List[BinMapper]:
+    """One training ``BinMapper`` per original feature, derived from
+    the forest's own split state.  Features the model never splits on
+    get a trivial mapper (excluded from the constructed dataset — the
+    replayed trees never read them, and new trees cannot split on what
+    the bin space cannot distinguish)."""
+    thr_vals, miss, is_cat, cats, _ = collect_split_state(
+        models, num_features, want_cats=True)
+    mappers: List[BinMapper] = []
+    for f in range(num_features):
+        if is_cat[f]:
+            mappers.append(BinMapper.categorical_from_categories(cats[f]))
+        elif thr_vals[f]:
+            mappers.append(BinMapper.from_thresholds(thr_vals[f],
+                                                     int(miss[f])))
+        else:
+            mappers.append(BinMapper())  # trivial
+    return mappers
+
+
+def continue_dataset(models, X, label=None, weight=None,
+                     params: Optional[dict] = None,
+                     num_features: Optional[int] = None,
+                     feature_names: Optional[List[str]] = None):
+    """A constructed :class:`~lightgbm_tpu.basic.Dataset` whose bin
+    space is the MODEL'S, not the data's — the train-continue analog of
+    ``serve.packing.ServeBinSpace.bin_matrix``.  ``models`` is the
+    loaded forest (list of host ``Tree``); ``X`` raw float rows."""
+    from ..basic import Dataset
+    from ..io.dataset import BinnedDataset, Metadata
+
+    X = np.ascontiguousarray(np.asarray(X, dtype=np.float64))
+    if X.ndim != 2:
+        raise ValueError("continue_dataset needs a 2-D feature matrix")
+    F = X.shape[1]
+    need = max((int(t.split_feature[i]) + 1 for t in models
+                for i in range(max(t.num_leaves - 1, 0))), default=0)
+    if F < need:
+        raise ValueError(f"continue data has {F} features, the model "
+                         f"splits on feature {need - 1}")
+    binned = BinnedDataset()
+    binned.num_data = int(X.shape[0])
+    binned.num_total_features = F
+    binned.metadata = Metadata(binned.num_data)
+    binned.bin_mappers = model_bin_mappers(models, F)
+    binned.max_bin = int(max((m.num_bin for m in binned.bin_mappers),
+                             default=1))
+    binned.feature_names = (list(feature_names)
+                            if feature_names and len(feature_names) == F
+                            else [f"Column_{i}" for i in range(F)])
+    binned._finalize_features()
+    binned._binarize(X)
+    ds = Dataset(None, params=dict(params or {}))
+    ds._handle = binned
+    if label is not None:
+        ds.set_label(np.asarray(label, dtype=np.float64).ravel())
+    if weight is not None:
+        ds.set_weight(np.asarray(weight, dtype=np.float64).ravel())
+    return ds
+
+
+def train_continue(model, X, label, params: Optional[dict] = None,
+                   num_boost_round: int = 10, weight=None, **train_kw):
+    """Boost ``num_boost_round`` additional trees onto ``model`` using
+    ONLY the model file and the new rows: the new data is binned in the
+    model's own bin space (no training-data rebinning) and the existing
+    ``init_model`` warm-start path replays the forest before the first
+    new iteration.  ``model`` is a model-file path or a ``Booster``;
+    the model's objective/num_class seed the params (explicit ``params``
+    entries win).  Returns the continued :class:`Booster`."""
+    from ..basic import Booster
+    from ..engine import train as train_api
+
+    if not (isinstance(model, (Booster, str, bytes))
+            or hasattr(model, "__fspath__")):
+        raise TypeError("train_continue needs a Booster or a model file "
+                        f"path, met {type(model).__name__}")
+    models, base_params, feature_names = _load_models_and_params(model)
+    merged = dict(base_params)
+    merged.update(params or {})
+    if not models:
+        raise ValueError("cannot continue an empty model")
+    log.info("train_continue: %d new rows binned in the model's own bin "
+             "space, boosting %d more round(s) onto %d tree(s)",
+             int(np.asarray(X).shape[0]), num_boost_round, len(models))
+    ds = continue_dataset(models, X, label=label, weight=weight,
+                          params=merged, feature_names=feature_names)
+    return train_api(merged, ds, num_boost_round=num_boost_round,
+                     init_model=model, verbose_eval=False, **train_kw)
+
+
+def _load_models_and_params(model):
+    """(models, base_params, feature_names) from a Booster or file."""
+    import os as _os
+
+    from ..basic import Booster
+
+    if isinstance(model, Booster):
+        return (list(model._gbdt.models), dict(model.params or {}), None)
+    from ..io.model_io import load_model_file
+    loaded, model_cfg = load_model_file(_os.fsdecode(model))
+    base = {"objective": model_cfg.objective}
+    if model_cfg.num_class > 1:
+        base["num_class"] = model_cfg.num_class
+    return (list(loaded.models), base,
+            loaded.feature_names if loaded.feature_names else None)
+
+
+def refit_from_model(model, X, label, params: Optional[dict] = None,
+                     decay_rate: Optional[float] = None, weight=None):
+    """Leaf re-estimation from a model FILE over new rows, binned in the
+    model's own bin space — the online loop's refit leg.
+
+    ``Booster.refit`` rebins the new data from scratch, which quantizes
+    the frozen split thresholds to the NEW data's bins and can misroute
+    rows that fall inside the same new bin as a threshold.  Binning in
+    the model's threshold space instead reproduces every node decision
+    exactly (the ``from_thresholds`` contract), so the refit re-estimates
+    leaves over precisely the rows the serving forest would route there."""
+    import copy
+
+    from ..basic import Booster
+
+    models, base_params, feature_names = _load_models_and_params(model)
+    if not models:
+        raise ValueError("cannot refit an empty model")
+    merged = dict(base_params)
+    merged.update(params or {})
+    if decay_rate is not None:
+        merged["refit_decay_rate"] = float(decay_rate)
+    ds = continue_dataset(models, X, label=label, weight=weight,
+                          params=merged, feature_names=feature_names)
+    bst = Booster(params=merged, train_set=ds)
+    bst._gbdt.load_initial_models([copy.deepcopy(t) for t in models],
+                                  replay_scores=False)
+    bst._gbdt.refit_models(decay_rate)
+    return bst

@@ -1,0 +1,354 @@
+"""``task=online``: the closed loop from served traffic to fresh models.
+
+The serving fleet (serve/) answers predictions; ground-truth labels for
+those predictions arrive later as a stream.  This driver consumes that
+prediction+label stream (JSONL lines ``{"x": [...], "y": <label>}``),
+accumulates a bounded window of the freshest rows, and on a cadence —
+every ``tpu_online_refit_every`` rows and/or
+``tpu_online_refit_every_s`` seconds — produces a refreshed model:
+
+- ``tpu_online_mode=refit``: leaf re-estimation over the frozen forest
+  (the device refit kernel, online/refit.py), decay-mixed by
+  ``tpu_online_decay``;
+- ``tpu_online_mode=continue``: ``tpu_online_trees`` NEW trees boosted
+  in the model's own bin space (online/binspace.py).
+
+Both run from the current model FILE alone — no training data is kept.
+Each refreshed version is then pushed through the registry's
+``POST /models/{name}/swap``, so the canary gate (parity/finite/latency
+checks), the post-swap rollback watch, and the chaos matrix stand
+between a bad refit and traffic: a poisoned refresh is a rejected swap,
+not an incident.  A rejected push leaves the previous model as the
+refresh base, so one bad window cannot poison every later refresh.
+
+Fault injection points (robust/faults.py): ``online_ingest`` on every
+ingest batch, ``online_refit`` at the top of a refresh,
+``online_swap`` before the push.  Telemetry: one ``online_refresh``
+event per cadence firing (including skipped ones — an ingest stall is
+an event, not silence); ``obs/report.py`` folds them into the digest.
+"""
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import time
+from typing import Callable, Iterator, Optional, Tuple
+
+import numpy as np
+
+from .. import obs
+from ..robust import faults
+from ..utils import log
+
+
+def _knob(config, name, cast, default, env=None):
+    """Config attr with an optional env-var override (env wins, like the
+    serving knobs in serve/session.py)."""
+    v = getattr(config, name, default) if config is not None else default
+    if isinstance(config, dict):
+        v = config.get(name, default)
+    if env:
+        raw = os.environ.get(env, "")
+        if raw:
+            try:
+                return cast(raw)
+            except ValueError:
+                log.warning("ignoring non-numeric %s=%r", env, raw)
+    try:
+        return cast(v)
+    except (TypeError, ValueError):
+        return default
+
+
+def read_label_stream(path: str, follow: bool = False,
+                      poll_s: float = 0.2, batch_rows: int = 256,
+                      stop: Optional[Callable[[], bool]] = None
+                      ) -> Iterator[Optional[Tuple[np.ndarray, np.ndarray]]]:
+    """Yield ``(X, y)`` batches from a JSONL prediction+label stream.
+
+    Each line is ``{"x": [floats], "y": label}`` (``"features"`` /
+    ``"label"`` accepted as synonyms); malformed lines — bad JSON,
+    non-numeric fields, or a row whose width disagrees with the
+    stream's first row — are counted and skipped, like obs/report.py's
+    loader.  ``follow=True`` tails the file for appended lines (the
+    socket-less streaming mode — a feeder process appends, this
+    generator never ends until ``stop()``); while idle it yields
+    ``None`` heartbeats each poll so the consumer's TIME cadence (and
+    ingest-stall detection) keeps firing with no data flowing.  A
+    partially-written trailing line (no newline yet) is buffered and
+    re-joined with the next read, never parsed as two fragments."""
+    rows, labels, bad = [], [], 0
+    width = None
+    pending = ""
+
+    def flush():
+        nonlocal rows, labels
+        if not rows:
+            return None
+        out = (np.asarray(rows, np.float64), np.asarray(labels, np.float64))
+        rows, labels = [], []
+        return out
+
+    def parse(line):
+        nonlocal bad, width
+        line = line.strip()
+        if not line:
+            return
+        try:
+            rec = json.loads(line)
+            x = [float(v) for v in rec.get("x", rec.get("features"))]
+            y = float(rec.get("y", rec.get("label")))
+        except (ValueError, TypeError, AttributeError):
+            bad += 1
+            return
+        if width is None:
+            width = len(x)
+        elif len(x) != width:
+            bad += 1
+            return
+        rows.append(x)
+        labels.append(y)
+
+    with open(path) as fh:
+        while True:
+            chunk = fh.readline()
+            if not chunk:
+                batch = flush()
+                if batch is not None:
+                    yield batch
+                if not follow or (stop is not None and stop()):
+                    break
+                time.sleep(poll_s)
+                yield None   # heartbeat: let the consumer's cadence tick
+                continue
+            if follow and not chunk.endswith("\n"):
+                # a feeder's write landed mid-line: hold the fragment
+                pending += chunk
+                continue
+            parse(pending + chunk)
+            pending = ""
+            if len(rows) >= batch_rows:
+                yield flush()
+    if pending:
+        parse(pending)
+        batch = flush()
+        if batch is not None:
+            yield batch
+    if bad:
+        log.warning("label stream %s: skipped %d malformed line(s)",
+                    path, bad)
+
+
+class OnlineLoop:
+    """Bounded-window ingest + cadence-driven refresh + registry push.
+
+    ``push`` is a callable ``(model_path) -> report dict`` (HTTP POST to
+    ``/models/{name}/swap`` in the CLI driver, ``registry.swap`` in
+    in-process tests); it must raise on a rejected swap.  The loop never
+    dies for a failed refresh — the old model keeps serving AND stays
+    the base for the next refresh."""
+
+    def __init__(self, model_file: str, config=None,
+                 push: Optional[Callable[[str], dict]] = None,
+                 workdir: Optional[str] = None,
+                 params: Optional[dict] = None):
+        self.base = str(model_file)
+        self.config = config
+        self.push = push
+        self.params = dict(params or {})
+        self.mode = str(_knob(config, "tpu_online_mode", str, "refit"))
+        self.window_cap = max(int(_knob(config, "tpu_online_window", int,
+                                        50000, "LGBM_TPU_ONLINE_WINDOW")), 1)
+        self.refresh_rows = int(_knob(config, "tpu_online_refit_every", int,
+                                      5000, "LGBM_TPU_ONLINE_REFIT_EVERY"))
+        self.refresh_s = float(_knob(config, "tpu_online_refit_every_s",
+                                     float, 0.0))
+        self.trees = max(int(_knob(config, "tpu_online_trees", int, 10)), 1)
+        decay = float(_knob(config, "tpu_online_decay", float, -1.0))
+        self.decay = (decay if decay >= 0.0 else
+                      float(_knob(config, "refit_decay_rate", float, 0.9)))
+        self.workdir = workdir or tempfile.mkdtemp(prefix="lgbm_online_")
+        os.makedirs(self.workdir, exist_ok=True)
+        self._X: list = []          # window rows (list of [F] arrays)
+        self._y: list = []
+        self._rows_since = 0        # rows ingested since the last refresh
+        self._last_refresh_t = time.monotonic()
+        self.versions = 0           # successful pushes
+        self.rejected = 0           # pushes the canary gate bounced
+        self.failed = 0             # refreshes that died before the push
+        self.skipped = 0            # cadence firings with no fresh rows
+        self.rows_ingested = 0
+
+    # ------------------------------------------------------------------
+    def ingest(self, X, y) -> int:
+        """Append labeled rows to the bounded window (oldest rows fall
+        out past ``tpu_online_window``).  Returns rows accepted."""
+        faults.check("online_ingest")
+        X = np.atleast_2d(np.asarray(X, np.float64))
+        y = np.atleast_1d(np.asarray(y, np.float64))
+        if X.shape[0] != y.shape[0]:
+            raise ValueError("ingest rows/labels length mismatch")
+        self._X.extend(X)
+        self._y.extend(y)
+        if len(self._X) > self.window_cap:
+            drop = len(self._X) - self.window_cap
+            del self._X[:drop]
+            del self._y[:drop]
+        self._rows_since += X.shape[0]
+        self.rows_ingested += X.shape[0]
+        return X.shape[0]
+
+    def due(self, now: Optional[float] = None) -> bool:
+        """Has the refresh cadence fired?  Row cadence and time cadence
+        compose as OR; both disabled means never."""
+        now = time.monotonic() if now is None else now
+        if self.refresh_rows > 0 and self._rows_since >= self.refresh_rows:
+            return True
+        return (self.refresh_s > 0
+                and now - self._last_refresh_t >= self.refresh_s)
+
+    def tick(self, now: Optional[float] = None) -> Optional[dict]:
+        """Refresh when due; None when the cadence hasn't fired.  A due
+        tick with NO fresh rows is an ingest stall: the refresh is
+        SKIPPED with a logged + telemetry-stamped event (refitting to a
+        stale window would only launder old data as fresh)."""
+        if not self.due(now):
+            return None
+        if self._rows_since == 0 or not self._X:
+            self.skipped += 1
+            self._last_refresh_t = time.monotonic()
+            log.warning("online: refresh cadence fired with no fresh "
+                        "rows (ingest stall) — skipping this cycle "
+                        "(window holds %d stale row(s))", len(self._X))
+            obs.event("online_refresh", mode=self.mode, ok=False,
+                      skipped="ingest_stall", rows=0)
+            return {"ok": False, "skipped": "ingest_stall"}
+        return self.refresh()
+
+    # ------------------------------------------------------------------
+    def refresh(self) -> dict:
+        """One refresh: refit/continue from the current model FILE over
+        the window, save the candidate, push it through the registry.
+        Never raises — the report (and the ``online_refresh`` event)
+        carries the outcome."""
+        t0 = time.perf_counter()
+        rows = len(self._X)
+        report = {"ok": False, "mode": self.mode, "rows": rows}
+        attempt = self.versions + self.rejected + self.failed + 2
+        out_path = os.path.join(self.workdir, f"model_v{attempt}.txt")
+        try:
+            faults.check("online_refit")
+            Xw = np.asarray(self._X, np.float64)
+            yw = np.asarray(self._y, np.float64)
+            if self.mode == "continue":
+                from .binspace import train_continue
+                bst = train_continue(self.base, Xw, yw, params=self.params,
+                                     num_boost_round=self.trees)
+            else:
+                from .binspace import refit_from_model
+                bst = refit_from_model(self.base, Xw, yw,
+                                       params=self.params,
+                                       decay_rate=self.decay)
+            bst.save_model(out_path)
+            faults.check("online_swap")
+            if self.push is not None:
+                report["push"] = self.push(out_path)
+            self.base = out_path        # adopted: next refresh's base
+            self.versions += 1
+            report.update(ok=True, version=self.versions, path=out_path)
+        except Exception as exc:  # noqa: BLE001 — a bad refresh is a
+            # non-event by design: the canary/rollback plane already
+            # decided traffic never sees it, so the loop records and
+            # moves on with the OLD base
+            import urllib.error
+
+            from ..serve.registry import SwapRejected
+            rejected = isinstance(exc, SwapRejected) or (
+                isinstance(exc, urllib.error.HTTPError)
+                and exc.code == 409)
+            if rejected:
+                self.rejected += 1
+            else:
+                self.failed += 1
+            report["error"] = f"{type(exc).__name__}: {exc}"
+            log.warning("online: refresh %s — previous model keeps "
+                        "serving and stays the refresh base (%s)",
+                        "rejected by the canary gate" if rejected
+                        else "FAILED", report["error"])
+        ms = round((time.perf_counter() - t0) * 1e3, 1)
+        report["ms"] = ms
+        self._rows_since = 0
+        self._last_refresh_t = time.monotonic()
+        obs.event("online_refresh", mode=self.mode, ok=bool(report["ok"]),
+                  rows=rows, ms=ms,
+                  **({"version": self.versions} if report["ok"] else {}),
+                  **({"error": report["error"][:200]}
+                     if report.get("error") else {}))
+        return report
+
+    def stats(self) -> dict:
+        return {"mode": self.mode, "versions": self.versions,
+                "rejected": self.rejected, "failed": self.failed,
+                "skipped": self.skipped,
+                "rows_ingested": self.rows_ingested,
+                "window_rows": len(self._X), "base": self.base}
+
+
+def run_online(cfg, params: dict) -> None:
+    """CLI driver: serve ``input_model`` behind the registry-managed
+    fleet AND feed the label stream back into it — daily-fresh models
+    with zero downtime, one process.  The push goes through the HTTP
+    ``POST /models/{name}/swap`` endpoint of this process's own server,
+    so every refresh rides the exact path an external pusher would."""
+    import urllib.request
+
+    from ..serve import ModelRegistry, PredictServer
+
+    if not cfg.input_model:
+        log.fatal("task=online needs input_model (alias: model_file)")
+    source = getattr(cfg, "tpu_online_source", "") or cfg.data
+    if not source:
+        log.fatal("task=online needs a label stream: tpu_online_source "
+                  "(or data) pointing at a JSONL file of "
+                  '{"x": [...], "y": <label>} lines')
+    name = getattr(cfg, "tpu_online_model", "default") or "default"
+    reg = ModelRegistry(config=cfg)
+    reg.add_model(name, cfg.input_model)
+    server = PredictServer(reg, host=cfg.tpu_serve_host,
+                           port=cfg.tpu_serve_port).start()
+
+    def push(model_path: str) -> dict:
+        req = urllib.request.Request(
+            f"{server.url}/models/{name}/swap",
+            data=json.dumps({"model_file": model_path}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=300) as resp:
+            return json.loads(resp.read())
+
+    loop = OnlineLoop(cfg.input_model, config=cfg, push=push,
+                      workdir=getattr(cfg, "tpu_online_dir", "") or None,
+                      params=dict(params))
+    follow = bool(getattr(cfg, "tpu_online_follow", False))
+    log.info("online: serving %r on %s, ingesting %s (mode=%s, cadence "
+             "%d rows / %gs, window %d)", name, server.url, source,
+             loop.mode, loop.refresh_rows, loop.refresh_s,
+             loop.window_cap)
+    try:
+        for batch in read_label_stream(source, follow=follow):
+            if batch is not None:
+                loop.ingest(*batch)
+            # a None heartbeat still ticks: the time cadence and the
+            # ingest-stall skip must fire while the stream is quiet
+            loop.tick()
+        if loop._rows_since > 0:
+            loop.refresh()   # drain: the tail of a finite stream counts
+    except KeyboardInterrupt:
+        log.warning("online: interrupted — shutting down")
+    finally:
+        st = loop.stats()
+        log.info("online: %d refreshed version(s) pushed, %d rejected, "
+                 "%d failed, %d skipped, %d row(s) ingested",
+                 st["versions"], st["rejected"], st["failed"],
+                 st["skipped"], st["rows_ingested"])
+        server.stop(close_session=True)

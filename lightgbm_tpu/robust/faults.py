@@ -12,6 +12,7 @@ CPU-only container.  The spec grammar (env var ``LGBM_TPU_FAULTS`` or
                  | serve_explain_submit | serve_explain_device
                  | serve_replica | serve_replica_N | serve_swap
                  | serve_canary | checkpoint_write
+                 | online_ingest | online_refit | online_swap
                  (free-form: any check() name)
     action    := raise | transient | sleep=SECONDS | hang
     cond      := iter=N     fire only during boosting iteration N
@@ -41,8 +42,12 @@ Injection points live in the trainer's guarded device dispatch
 (serve/router.py: ``serve_replica`` plus per-replica
 ``serve_replica_{i}`` so a chaos run can wedge exactly one replica),
 the model registry's swap/canary path (serve/registry.py:
-``serve_swap``, ``serve_canary``), and the checkpoint writer.  When no
-plan is configured every :func:`check` call is one ``None`` test.
+``serve_swap``, ``serve_canary``), the checkpoint writer, and the
+online learning loop (online/loop.py: ``online_ingest`` per ingest
+batch, ``online_refit`` at the top of a refresh, ``online_swap``
+before the registry push — ``tools/fault_matrix.py`` proves a refit
+fault leaves the old version serving).  When no plan is configured
+every :func:`check` call is one ``None`` test.
 """
 from __future__ import annotations
 

@@ -33,6 +33,53 @@ from ..core.meta import DeviceMeta
 from ..io.binning import MISSING_NONE, BinMapper
 
 
+def collect_split_state(models, num_features: int,
+                        want_cats: bool = False):
+    """Walk a forest once and gather everything a model-derived bin
+    space needs, per ORIGINAL feature: the numerical split thresholds,
+    the worst missing type, the categorical flag, and the widest node
+    bitset word count.
+
+    Shared by :class:`ServeBinSpace` (serving-side bin space) and
+    ``online/binspace.py`` (the train-continue path).  Only the latter
+    needs the SET of category values the bitsets reference (to rebuild
+    TRAINING categorical mappers) — ``want_cats=True`` decodes the
+    bitsets bit by bit; the default keeps the serving-side rebuild at
+    its original cost (word counts only, empty sets returned).
+    Returns ``(thr_vals, miss, is_cat, cats, words)``."""
+    F = int(num_features)
+    thr_vals: List[List[float]] = [[] for _ in range(F)]
+    miss = np.zeros(F, np.int32)
+    is_cat = np.zeros(F, bool)
+    cats = [set() for _ in range(F)]
+    words = 0
+    for tree in models:
+        nn = max(tree.num_leaves - 1, 0)
+        for i in range(nn):
+            f = int(tree.split_feature[i])
+            if f < 0 or f >= F:
+                raise ValueError(
+                    f"model splits on feature {f} outside the declared "
+                    f"feature space [0, {F})")
+            if tree.is_categorical(i):
+                is_cat[f] = True
+                ci = int(tree.threshold[i])
+                lo = int(tree.cat_boundaries[ci])
+                hi = int(tree.cat_boundaries[ci + 1])
+                words = max(words, hi - lo)
+                if want_cats:
+                    for w in range(hi - lo):
+                        bits = int(tree.cat_threshold[lo + w])
+                        while bits:
+                            b = bits & -bits
+                            cats[f].add(w * 32 + b.bit_length() - 1)
+                            bits ^= b
+            else:
+                thr_vals[f].append(float(tree.threshold[i]))
+                miss[f] = max(miss[f], tree.missing_type(i))
+    return thr_vals, miss, is_cat, cats, words
+
+
 class ServeBinSpace:
     """Per-feature value->bin mapping + ``DeviceMeta`` rebuilt from the
     forest's own split state (no dataset required)."""
@@ -40,26 +87,7 @@ class ServeBinSpace:
     def __init__(self, models, num_features: int):
         F = max(int(num_features), 1)
         self.num_features = F
-        thr_vals: List[List[float]] = [[] for _ in range(F)]
-        miss = np.zeros(F, np.int32)
-        is_cat = np.zeros(F, bool)
-        words = 0
-        for tree in models:
-            nn = max(tree.num_leaves - 1, 0)
-            for i in range(nn):
-                f = int(tree.split_feature[i])
-                if f < 0 or f >= F:
-                    raise ValueError(
-                        f"model splits on feature {f} outside the declared "
-                        f"feature space [0, {F})")
-                if tree.is_categorical(i):
-                    is_cat[f] = True
-                    ci = int(tree.threshold[i])
-                    words = max(words, int(tree.cat_boundaries[ci + 1])
-                                - int(tree.cat_boundaries[ci]))
-                else:
-                    thr_vals[f].append(float(tree.threshold[i]))
-                    miss[f] = max(miss[f], tree.missing_type(i))
+        thr_vals, miss, is_cat, _, words = collect_split_state(models, F)
 
         # one zero word past the widest node bitset: the sentinel bin's
         # word gathers 0, so unseen/NaN categories route right everywhere

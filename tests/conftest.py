@@ -122,6 +122,9 @@ _SLOW = {
     "test_robust.py::test_resume_bit_identical_dart",
     "test_robust.py::test_resume_bit_identical_two_device_mesh",
     "test_robust.py::test_sigterm_checkpoints_and_resumes",
+    "test_online.py::test_device_refit_matches_host_multiclass",
+    "test_online.py::test_device_refit_matches_host_mesh_2dev",
+    "test_online.py::test_device_refit_matches_host_binary[0.0]",
 }
 
 

@@ -1,0 +1,399 @@
+"""online/ — device leaf refit, in-bin-space train-continue, refresh
+loop (ISSUE 12; reference: GBDT::RefitTree gbdt.cpp:298-321)."""
+import os
+import time
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import obs
+from lightgbm_tpu.online import (OnlineLoop, continue_dataset,
+                                 train_continue)
+from lightgbm_tpu.robust import faults
+
+PARAMS = {"objective": "binary", "num_leaves": 15, "learning_rate": 0.1,
+          "min_data_in_leaf": 5, "verbose": -1}
+
+REFIT_ATOL = 1e-6  # per-leaf device-vs-host bound (acceptance-pinned)
+
+
+def _problem(n=1200, seed=0, f=6):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    y = (X[:, 0] + X[:, 1] * X[:, 2] + 0.2 * rng.normal(size=n) > 0)
+    return X, y.astype(np.float64)
+
+
+def _cat_nan_problem(n=1000, seed=3, unseen=False):
+    """Categorical feature 3 + NaNs everywhere — the fixtures that
+    exercise category bitsets and default-left-both-ways routing.
+    ``unseen=True`` adds category values the model never saw."""
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 5))
+    hi = 12 if unseen else 8
+    X[:, 3] = rng.integers(0, hi, size=n).astype(np.float64)
+    mask = rng.random((n, 5)) < 0.08
+    X[mask] = np.nan
+    y = (np.nan_to_num(X[:, 0]) + (X[:, 3] == 3) > 0.3)
+    return X, y.astype(np.float64)
+
+
+def _leaf_parity(host_bst, dev_bst):
+    worst = 0.0
+    for th, td in zip(host_bst._gbdt.models, dev_bst._gbdt.models):
+        assert th.num_leaves == td.num_leaves
+        worst = max(worst, float(np.max(np.abs(th.leaf_value
+                                               - td.leaf_value))))
+    return worst
+
+
+# ---------------------------------------------------------------------
+# device refit kernel vs the retained host oracle
+# ---------------------------------------------------------------------
+
+@pytest.mark.parametrize("decay", [0.0, 0.9])
+def test_device_refit_matches_host_binary(decay):
+    X, y = _problem()
+    ds = lgb.Dataset(X, label=y, params=PARAMS)
+    bst = lgb.train(PARAMS, ds, num_boost_round=8, verbose_eval=False)
+    Xn, yn = _problem(n=900, seed=7)
+    host = bst.refit(Xn, yn, decay_rate=decay, tpu_refit_device=False)
+    dev = bst.refit(Xn, yn, decay_rate=decay, tpu_refit_device=True)
+    assert _leaf_parity(host, dev) <= REFIT_ATOL
+    np.testing.assert_allclose(dev.predict(Xn), host.predict(Xn),
+                               atol=1e-6)
+
+
+def test_device_refit_matches_host_l1_l2_max_delta():
+    """The closed form's regularization branches (sign/soft-threshold,
+    L2 shrink, max_delta_step clip) must agree too."""
+    p = dict(PARAMS, lambda_l1=0.3, lambda_l2=2.0, max_delta_step=0.05)
+    X, y = _problem(seed=11)
+    ds = lgb.Dataset(X, label=y, params=p)
+    bst = lgb.train(p, ds, num_boost_round=6, verbose_eval=False)
+    Xn, yn = _problem(n=800, seed=13)
+    host = bst.refit(Xn, yn, decay_rate=0.4, tpu_refit_device=False)
+    dev = bst.refit(Xn, yn, decay_rate=0.4, tpu_refit_device=True)
+    assert _leaf_parity(host, dev) <= REFIT_ATOL
+
+
+def test_device_refit_matches_host_categorical_nan():
+    X, y = _cat_nan_problem()
+    p = dict(PARAMS, num_leaves=12, categorical_feature="3")
+    ds = lgb.Dataset(X, label=y, params=p)
+    bst = lgb.train(p, ds, num_boost_round=6, verbose_eval=False)
+    Xn, yn = _cat_nan_problem(n=800, seed=5)
+    host = bst.refit(Xn, yn, decay_rate=0.7, tpu_refit_device=False,
+                     categorical_feature="3")
+    dev = bst.refit(Xn, yn, decay_rate=0.7, tpu_refit_device=True,
+                    categorical_feature="3")
+    assert _leaf_parity(host, dev) <= REFIT_ATOL
+
+
+def test_device_refit_matches_host_multiclass():
+    rng = np.random.default_rng(2)
+    X = rng.normal(size=(900, 5))
+    y = ((X[:, 0] > 0).astype(int) + (X[:, 1] > 0.5).astype(int))
+    p = {"objective": "multiclass", "num_class": 3, "num_leaves": 7,
+         "min_data_in_leaf": 5, "verbose": -1}
+    ds = lgb.Dataset(X, label=y.astype(float), params=p)
+    bst = lgb.train(p, ds, num_boost_round=5, verbose_eval=False)
+    Xn = rng.normal(size=(700, 5))
+    yn = ((Xn[:, 0] > 0).astype(int) + (Xn[:, 1] > 0.5).astype(int))
+    host = bst.refit(Xn, yn.astype(float), decay_rate=0.5,
+                     tpu_refit_device=False)
+    dev = bst.refit(Xn, yn.astype(float), decay_rate=0.5,
+                    tpu_refit_device=True)
+    assert _leaf_parity(host, dev) <= REFIT_ATOL
+
+
+def test_device_refit_matches_host_mesh_2dev():
+    """The 2-device mesh leg: refit under a data-sharded trainer must
+    match the host oracle exactly like the single-device path."""
+    p = dict(PARAMS, tree_learner="data", tpu_mesh_shape="data:2")
+    X, y = _problem(n=1024, seed=9)
+    ds = lgb.Dataset(X, label=y, params=p)
+    bst = lgb.train(p, ds, num_boost_round=5, verbose_eval=False)
+    Xn, yn = _problem(n=512, seed=10)
+    host = bst.refit(Xn, yn, decay_rate=0.6, tpu_refit_device=False,
+                     tree_learner="data", tpu_mesh_shape="data:2")
+    dev = bst.refit(Xn, yn, decay_rate=0.6, tpu_refit_device=True,
+                    tree_learner="data", tpu_mesh_shape="data:2")
+    assert _leaf_parity(host, dev) <= REFIT_ATOL
+
+
+def test_refit_event_emitted_both_paths(tmp_path):
+    """Satellite: refit_models emits one ``refit`` telemetry event
+    (trees, rows, decay, wall time, mode) from BOTH paths, and the
+    stream validates against the schema."""
+    from lightgbm_tpu.obs.report import load_events, validate_events
+    X, y = _problem(n=600, seed=4)
+    ds = lgb.Dataset(X, label=y, params=PARAMS)
+    bst = lgb.train(PARAMS, ds, num_boost_round=4, verbose_eval=False)
+    sink = tmp_path / "t"
+    obs.reset()
+    obs.enable(str(sink))
+    try:
+        bst.refit(X, y, decay_rate=0.8, tpu_refit_device=True)
+        bst.refit(X, y, decay_rate=0.8, tpu_refit_device=False)
+    finally:
+        obs.reset()
+    events = load_events(str(sink))
+    refits = [e for e in events if e.get("event") == "refit"]
+    assert [e["mode"] for e in refits] == ["device", "host"]
+    for e in refits:
+        assert e["trees"] == 4 and e["rows"] == 600
+        assert e["decay"] == pytest.approx(0.8)
+        assert e["wall_s"] >= 0
+    assert validate_events(events) == []
+
+
+# ---------------------------------------------------------------------
+# in-bin-space train-continue (model-own bin space)
+# ---------------------------------------------------------------------
+
+def test_continue_replay_roundtrip_categorical_nan(tmp_path):
+    """Satellite: BinMapper.from_thresholds round trip on the continue
+    path — new rows (with NaNs, default-left both ways, and UNSEEN
+    categories) binned in the model's own bin space must route exactly
+    like the host's value-space traversal.  Replaying the forest onto
+    the continue dataset (0 new rounds) and comparing raw scores pins
+    the whole decision chain, bitsets included."""
+    X, y = _cat_nan_problem()
+    p = dict(PARAMS, num_leaves=12, categorical_feature="3")
+    ds = lgb.Dataset(X, label=y, params=p)
+    bst = lgb.train(p, ds, num_boost_round=6, verbose_eval=False)
+    path = str(tmp_path / "m.txt")
+    bst.save_model(path)
+
+    Xn, yn = _cat_nan_problem(n=700, seed=6, unseen=True)
+    b = train_continue(path, Xn, yn, params=dict(p), num_boost_round=0,
+                       keep_training_booster=True)
+    replayed = b._raw_train_score()
+    host = lgb.Booster(model_file=path).predict(Xn, raw_score=True)
+    np.testing.assert_allclose(replayed, host, atol=1e-5)
+
+
+def test_train_continue_adds_trees_and_learns(tmp_path):
+    X, y = _problem()
+    ds = lgb.Dataset(X, label=y, params=PARAMS)
+    bst = lgb.train(PARAMS, ds, num_boost_round=6, verbose_eval=False)
+    path = str(tmp_path / "m.txt")
+    bst.save_model(path)
+    Xn, yn = _problem(n=900, seed=21)
+    cont = train_continue(path, Xn, yn,
+                          params=dict(PARAMS, num_leaves=7),
+                          num_boost_round=5)
+    assert cont.num_trees() == 11
+    # the new trees must actually fit the new window: logloss improves
+    # over the frozen base model on the continue data
+    def logloss(p_):
+        p_ = np.clip(p_, 1e-9, 1 - 1e-9)
+        return -np.mean(yn * np.log(p_) + (1 - yn) * np.log(1 - p_))
+    assert logloss(cont.predict(Xn)) < logloss(bst.predict(Xn))
+    # and every new-tree threshold already existed in the model's bin
+    # space (the stable-bin-space contract): continue never invents a
+    # threshold serving's from_thresholds space couldn't represent
+    base_thr = {float(t) for tr in bst._gbdt.models
+                for t in tr.threshold[:max(tr.num_leaves - 1, 0)]}
+    for tr in cont._gbdt.models[6:]:
+        for i in range(max(tr.num_leaves - 1, 0)):
+            assert (float(tr.threshold[i]) in base_thr
+                    or not np.isfinite(tr.threshold[i]))
+
+
+def test_continue_dataset_unused_features_trivial():
+    X, y = _problem(n=400, seed=30, f=8)
+    p = dict(PARAMS, num_leaves=4)
+    ds = lgb.Dataset(X[:, :3], label=y, params=p)
+    bst = lgb.train(p, ds, num_boost_round=2, verbose_eval=False)
+    d = continue_dataset(list(bst._gbdt.models), X, label=y, params=p)
+    h = d._handle
+    assert h.num_total_features == 8
+    # only features the model splits on survive as inner columns
+    assert h.num_features <= 3
+    assert h.num_data == 400
+
+
+# ---------------------------------------------------------------------
+# resume-vs-init_model interaction (engine.py)
+# ---------------------------------------------------------------------
+
+def test_resume_supersedes_init_model_and_warns_both_paths(
+        tmp_path, capsys):
+    """Satellite: when a checkpoint and an init_model both exist the
+    checkpoint wins, and the WARNING names BOTH paths — the context a
+    stale-refresh incident needs."""
+    X, y = _problem(n=600, seed=8)
+    ckdir = str(tmp_path / "ck")
+    # verbose=0: the warning under test must not be gated off
+    p = dict(PARAMS, verbose=0, tpu_checkpoint_dir=ckdir,
+             tpu_checkpoint_freq=2)
+    ds = lgb.Dataset(X, label=y, params=p)
+    b1 = lgb.train(p, ds, num_boost_round=4, verbose_eval=False)
+    init_path = str(tmp_path / "init_model.txt")
+    b1.save_model(init_path)
+
+    capsys.readouterr()
+    ds2 = lgb.Dataset(X, label=y, params=p)
+    b2 = lgb.train(p, ds2, num_boost_round=4, init_model=init_path,
+                   verbose_eval=False)
+    err = capsys.readouterr().err
+    assert "init_model" in err and init_path in err
+    assert ckdir in err          # the checkpoint path that won
+    # resumed from the completed checkpoint: no extra trees beyond the
+    # original 4 rounds (the init model was NOT stacked on top)
+    assert b2.num_trees() == b1.num_trees()
+
+
+# ---------------------------------------------------------------------
+# the refresh loop
+# ---------------------------------------------------------------------
+
+class _Cfg:
+    tpu_online_mode = "refit"
+    tpu_online_window = 500
+    tpu_online_refit_every = 300
+    tpu_online_refit_every_s = 0.0
+    tpu_online_trees = 3
+    tpu_online_decay = 0.6
+    refit_decay_rate = 0.9
+
+
+def _loop_fixture(tmp_path, push):
+    X, y = _problem(n=800, seed=14)
+    ds = lgb.Dataset(X, label=y, params=PARAMS)
+    bst = lgb.train(PARAMS, ds, num_boost_round=4, verbose_eval=False)
+    path = str(tmp_path / "base.txt")
+    bst.save_model(path)
+    loop = OnlineLoop(path, config=_Cfg(), push=push,
+                      workdir=str(tmp_path / "v"), params=dict(PARAMS))
+    os.makedirs(loop.workdir, exist_ok=True)
+    return loop, X, y
+
+
+def test_online_loop_cadence_window_and_stall(tmp_path):
+    pushed = []
+    loop, X, y = _loop_fixture(tmp_path,
+                               lambda p: pushed.append(p) or {"ok": True})
+    loop.ingest(X[:200], y[:200])
+    assert loop.tick() is None           # cadence not due yet
+    loop.ingest(X[200:800], y[200:800])
+    assert len(loop._X) == 500           # window bounded: oldest fell out
+    rep = loop.tick()
+    assert rep["ok"] and rep["version"] == 1 and len(pushed) == 1
+    assert loop.base == pushed[0]        # adopted as the next base
+    # time cadence with no fresh rows = ingest stall -> skipped + event
+    loop.refresh_rows, loop.refresh_s = 0, 0.01
+    time.sleep(0.02)
+    obs.enable_flight(32)
+    try:
+        rep2 = loop.tick()
+        stamped = [e for e in obs.flight_snapshot()
+                   if e.get("event") == "online_refresh"
+                   and e.get("skipped") == "ingest_stall"]
+    finally:
+        obs.enable_flight(0)
+    assert rep2 == {"ok": False, "skipped": "ingest_stall"}
+    assert loop.versions == 1 and loop.skipped == 1
+    assert len(stamped) == 1
+
+
+def test_online_loop_refit_fault_keeps_old_base(tmp_path):
+    pushed = []
+    loop, X, y = _loop_fixture(tmp_path,
+                               lambda p: pushed.append(p) or {"ok": True})
+    base = loop.base
+    loop.ingest(X[:400], y[:400])
+    faults.configure("online_refit:raise")
+    try:
+        rep = loop.tick()
+    finally:
+        faults.disarm()
+    assert rep is not None and not rep["ok"] and "FaultInjected" in \
+        rep["error"]
+    assert loop.base == base and not pushed and loop.failed == 1
+    # the next (un-faulted) cycle recovers with the SAME base
+    loop.ingest(X[400:800], y[400:800])
+    rep2 = loop.tick()
+    assert rep2["ok"] and len(pushed) == 1
+
+
+def test_online_loop_continue_mode(tmp_path):
+    cfg = _Cfg()
+    cfg.tpu_online_mode = "continue"
+    X, y = _problem(n=800, seed=15)
+    ds = lgb.Dataset(X, label=y, params=PARAMS)
+    bst = lgb.train(PARAMS, ds, num_boost_round=4, verbose_eval=False)
+    path = str(tmp_path / "base.txt")
+    bst.save_model(path)
+    loop = OnlineLoop(path, config=cfg, push=None,
+                      workdir=str(tmp_path / "v"),
+                      params=dict(PARAMS, num_leaves=7))
+    os.makedirs(loop.workdir, exist_ok=True)
+    loop.ingest(X[:400], y[:400])
+    rep = loop.tick()
+    assert rep["ok"]
+    cont = lgb.Booster(model_file=loop.base)
+    assert cont.num_trees() == 4 + cfg.tpu_online_trees
+
+
+def test_read_label_stream(tmp_path):
+    import json as _json
+
+    from lightgbm_tpu.online import read_label_stream
+    path = str(tmp_path / "s.jsonl")
+    with open(path, "w") as fh:
+        for i in range(5):
+            fh.write(_json.dumps({"x": [float(i), 2.0], "y": i % 2})
+                     + "\n")
+        fh.write("not json\n")
+        fh.write(_json.dumps({"features": [9.0, 9.0], "label": 1.0})
+                 + "\n")
+    batches = list(read_label_stream(path, batch_rows=4))
+    X = np.concatenate([b[0] for b in batches])
+    y = np.concatenate([b[1] for b in batches])
+    assert X.shape == (6, 2) and y.shape == (6,)
+    assert X[-1, 0] == 9.0 and y[0] == 0.0
+
+
+def test_read_label_stream_follow_heartbeats_and_fragments(tmp_path):
+    """follow=True yields None heartbeats while idle (so the consumer's
+    time cadence / stall detection keeps firing), re-joins a partially
+    written trailing line instead of parsing two fragments, and skips a
+    ragged-width row instead of crashing the batch."""
+    import json as _json
+    import threading
+    import time as _time
+
+    from lightgbm_tpu.online import read_label_stream
+    path = str(tmp_path / "s.jsonl")
+    open(path, "w").close()
+
+    def feeder():
+        _time.sleep(0.2)
+        with open(path, "a") as fh:
+            fh.write(_json.dumps({"x": [1.0, 2.0], "y": 1.0}) + "\n")
+            line = _json.dumps({"x": [7.0, 7.0], "y": 0.0}) + "\n"
+            fh.write(line[:9])
+            fh.flush()
+            _time.sleep(0.3)
+            fh.write(line[9:])
+            fh.write(_json.dumps({"x": [1.0], "y": 0.0}) + "\n")  # ragged
+
+    t = threading.Thread(target=feeder)
+    t.start()
+    stop_at = _time.monotonic() + 1.6
+    hb = rows = 0
+    for batch in read_label_stream(
+            path, follow=True, poll_s=0.05,
+            stop=lambda: _time.monotonic() > stop_at):
+        if batch is None:
+            hb += 1
+        else:
+            assert batch[0].shape[1] == 2
+            rows += batch[0].shape[0]
+    t.join()
+    assert hb >= 3          # idle polls produced heartbeats
+    assert rows == 2        # 1 whole line + the rejoined fragment

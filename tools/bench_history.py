@@ -10,9 +10,11 @@ the wrong way".  This tool does:
                                   [--fail-on-regression]
 
 ``path`` entries are bench-round JSON files, serving-round files
-(``SERVE_r*.json`` from ``tools/bench_serve.py``), telemetry digest JSON
-files (``telemetry_report.py --json`` output), or directories to glob
-for ``BENCH_r*.json`` + ``SERVE_r*.json`` (default: the repo root).
+(``SERVE_r*.json`` from ``tools/bench_serve.py``), online-loop rounds
+(``ONLINE_r*.json`` from ``tools/online_smoke.py``), telemetry digest
+JSON files (``telemetry_report.py --json`` output), or directories to
+glob for ``BENCH_r*.json`` + ``SERVE_r*.json`` + ``ONLINE_r*.json``
+(default: the repo root).
 Rounds whose bench produced no parseable line (``"parsed": null`` —
 e.g. round 1's empty tail) are listed but carry no metrics.  Serving
 rounds trend rows/s + p50/p99 + batch occupancy under their own
@@ -93,6 +95,11 @@ _DIRECTIONS = [
     ("serve_swap_blip_p99_ms", False),
     ("serve_steady_p99_ms", False),
     ("serve_rollbacks", False),
+    # online-loop rounds (ONLINE_r*.json, tools/online_smoke.py): how
+    # long a refresh takes end to end (refit + save + canary-gated
+    # swap) and how many refreshed versions made it through the gate
+    ("online_refresh_s", False),
+    ("online_swap_ok", True),
 ]
 
 # a swap blip worse than this multiple of the steady p99 is flagged: the
@@ -147,6 +154,20 @@ def load_round(path: str) -> dict:
     if parsed is None:
         row["note"] = "no parsed bench line"
         row["context"] = None
+        return row
+    if parsed.get("kind") == "online":  # a tools/online_smoke.py round
+        row["context"] = ("online", parsed.get("backend"))
+        for name in ("online_refresh_s", "online_swap_ok",
+                     "online_swap_rejected", "rows_ingested"):
+            v = parsed.get(name)
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                row["metrics"][name] = float(v)
+        checks = parsed.get("checks") or {}
+        failed = [k for k, v in checks.items() if not v]
+        if failed:
+            row["note"] = ("online checks FAILED: " + ", ".join(failed)
+                           + " — excluded from baselines")
+            row["canary"] = "online-failed"
         return row
     if parsed.get("kind") == "serve":  # a bench_serve.py round
         row["context"] = ("serve", parsed.get("backend"),
@@ -292,6 +313,7 @@ def collect(paths: List[str]) -> List[dict]:
         if os.path.isdir(p):
             files.extend(sorted(glob.glob(os.path.join(p, "BENCH_r*.json"))))
             files.extend(sorted(glob.glob(os.path.join(p, "SERVE_r*.json"))))
+            files.extend(sorted(glob.glob(os.path.join(p, "ONLINE_r*.json"))))
         else:
             files.append(p)
     rows = []

@@ -239,6 +239,97 @@ def main(argv=None) -> int:
         check("checkpoint.corrupt_newest_skipped", False,
               "no checkpoints written")
 
+    # ---- online loop (ISSUE 12): refit fault / poisoned canary -----
+    # a refresh that dies (injected fault) or produces garbage (NaN
+    # leaves) must be a NON-event: no swap, old version still serving
+    from lightgbm_tpu.config import Config as _Cfg
+    from lightgbm_tpu.online import OnlineLoop, train_continue
+    from lightgbm_tpu.serve import ModelRegistry
+    from lightgbm_tpu.serve.registry import SwapRejected
+
+    base_path = os.path.join(art, "online_base.txt")
+    bst.save_model(base_path)
+    ocfg = _Cfg.from_params(dict(
+        P, tpu_serve_replicas=1, tpu_serve_rollback_watch_s=0.0,
+        tpu_online_refit_every=100, tpu_online_window=400,
+        tpu_online_decay=0.5))
+    reg2 = ModelRegistry(config=ocfg)
+    reg2.add_model("m", base_path)
+    oloop = OnlineLoop(base_path, config=ocfg,
+                       push=lambda p: reg2.swap("m", p), params=dict(P))
+    faults.configure("online_refit:raise")
+    oloop.ingest(X[:200], y[:200])
+    rep = oloop.tick()
+    faults.disarm()
+    live = reg2.resolve("m").version
+    check("online.refit_fault_no_swap",
+          rep is not None and not rep["ok"] and oloop.versions == 0, rep)
+    check("online.refit_fault_old_serving", live == 1, f"live v{live}")
+    # poisoned candidate: NaN leaves bounce off the canary's finite gate
+    import re as _re
+    with open(base_path) as fh:
+        txt = fh.read()
+    poisoned = os.path.join(art, "online_poisoned.txt")
+    with open(poisoned, "w") as fh:
+        fh.write(_re.sub(
+            r"^leaf_value=.*$",
+            lambda m: "leaf_value=" + " ".join(
+                ["nan"] * len(m.group(0).split("=")[1].split())),
+            txt, flags=_re.MULTILINE))
+    try:
+        reg2.swap("m", poisoned)
+        check("online.poisoned_canary_rejects", False, "swap accepted")
+    except SwapRejected as exc:
+        checks_map = (exc.report or {}).get("checks") or {}
+        check("online.poisoned_canary_rejects",
+              checks_map.get("finite") is False
+              or checks_map.get("gate") is False, exc.report)
+    check("online.poisoned_old_serving", reg2.resolve("m").version == 1)
+    reg2.close()
+
+    # ---- crash mid-train-continue -> bit-exact resume --------------
+    Xn = rng.normal(size=(400, 6))
+    yn = (Xn[:, 0] - 0.3 * Xn[:, 2] > 0).astype(np.float64)
+    cont_p = {"objective": "binary", "num_leaves": 7,
+              "min_data_in_leaf": 5, "verbose": -1}
+    ref_cont = train_continue(base_path, Xn, yn, params=cont_p,
+                              num_boost_round=4).model_to_string(
+        num_iteration=-1).split("\nparameters:")[0]
+    ckdir3 = os.path.join(art, "online_ckpt")
+    crash_p = dict(cont_p, tpu_on_device_error="abort",
+                   tpu_checkpoint_dir=ckdir3, tpu_checkpoint_freq=1)
+    faults.configure("device_execute:raise@iter=8")  # 6 init + 2 new
+    crashed = False
+    try:
+        train_continue(base_path, Xn, yn, params=crash_p,
+                       num_boost_round=4)
+    except DeviceWedgedError:
+        crashed = True
+    except SystemExit:
+        pass
+    faults.disarm()
+    check("online.continue_crash_raises", crashed)
+    try:
+        m = train_continue(base_path, Xn, yn, params=crash_p,
+                           num_boost_round=4).model_to_string(
+            num_iteration=-1).split("\nparameters:")[0]
+        check("online.continue_resume_bit_exact", m == ref_cont)
+    except Exception as exc:  # noqa: BLE001
+        check("online.continue_resume_bit_exact", False, repr(exc))
+
+    # ---- ingest stall: cadence fires, no fresh rows -> skipped -----
+    sloop = OnlineLoop(base_path, config=ocfg, push=None, params=dict(P))
+    sloop.refresh_rows, sloop.refresh_s = 0, 0.01
+    time.sleep(0.03)
+    srep = sloop.tick()
+    stall_events = [e for e in obs.flight_snapshot()
+                    if e.get("event") == "online_refresh"
+                    and e.get("skipped") == "ingest_stall"]
+    check("online.ingest_stall_skipped",
+          srep is not None and srep.get("skipped") == "ingest_stall"
+          and sloop.versions == 0, srep)
+    check("online.ingest_stall_stamped", len(stall_events) >= 1)
+
     record = {
         "kind": "fault_matrix",
         "t": round(time.time(), 1),

@@ -158,7 +158,8 @@ ENV_VARS = [
      "`device_execute`, `gradients`, `collective`, `serve_device`, "
      "`serve_explain_submit`, `serve_explain_device`, `serve_replica` "
      "(plus per-replica `serve_replica_{i}`), `serve_swap`, "
-     "`serve_canary`, `checkpoint_write`.  Actions: `raise` (fatal), "
+     "`serve_canary`, `checkpoint_write`, `online_ingest`, "
+     "`online_refit`, `online_swap`.  Actions: `raise` (fatal), "
      "`transient` (the watchdog's retry path), `sleep=S` (stall the "
      "step), `hang`.  Conds: `iter=N` (boosting iteration), `call=N` "
      "(N-th check at that point), `p=F` (seeded probability), `n=N` "
@@ -221,6 +222,18 @@ ENV_VARS = [
      "served/shed counters in `/metrics`).  "
      "`LGBM_TPU_SERVE_SHED_NORMAL_FRAC` overrides the normal-priority "
      "budget the same way; high priority always owns the full queue."),
+    ("LGBM_TPU_ONLINE_REFIT_EVERY",
+     "online-loop override for `tpu_online_refit_every` — the row "
+     "cadence of `task=online`'s refresh cycle (refit/continue + "
+     "canary-gated swap every N freshly ingested labeled rows); lets "
+     "an operator retune a running loop's refresh rate without "
+     "editing config files.  `LGBM_TPU_ONLINE_WINDOW` overrides "
+     "`tpu_online_window` the same way."),
+    ("LGBM_TPU_ONLINE_WINDOW",
+     "online-loop override for `tpu_online_window` — the bounded "
+     "ingest window: how many of the freshest labeled rows the loop "
+     "keeps for the next refresh (older rows fall out; memory-bounded "
+     "like the serve queue)."),
     ("LGBM_TPU_PREDICT_MIN_WORK",
      "CLI `task=predict` routing override: the rows x trees work "
      "threshold above which value predictions go through the serving "

@@ -1,0 +1,244 @@
+"""CPU-smokeable end-to-end check of the online learning loop (ISSUE 12).
+
+One process, one minute: train a base model, serve it behind the
+registry fleet over HTTP, stream labeled (drifting) rows through the
+``OnlineLoop``, and prove the whole closed loop on CPU:
+
+- **ingest → refit → swap**: the loop produces >= 2 refreshed versions,
+  each pushed through ``POST /models/{name}/swap`` (the same endpoint an
+  external pusher would hit), each passing the canary gate;
+- **zero request loss**: concurrent ``POST /predict`` traffic runs
+  through every swap — no failed request, every response finite and
+  attributable to exactly one model version;
+- **fresh models actually move**: post-refresh predictions differ from
+  the base model's (the drifted window changed the leaves);
+- **poisoned refit is a NON-EVENT**: a deliberately poisoned candidate
+  (NaN leaf values) is REJECTED by the canary gate's finite check with
+  a 409, and the old version keeps serving.
+
+``tools/run_suite.py`` runs this as the ``online`` tier; the JSON line
+carries the per-check verdict map plus ``online_refresh_s`` (mean
+refresh wall seconds) and ``online_swap_ok`` (successful pushes) —
+``tools/bench_history.py`` trends both from the ``ONLINE_r*.json``
+artifact this tool writes.
+
+    python tools/online_smoke.py --json      # one JSON verdict line
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+CHECKS = {}
+
+
+def check(name, ok, detail=""):
+    CHECKS[name] = bool(ok)
+    print(f"# {'ok ' if ok else 'FAIL'} {name}"
+          + (f" — {detail}" if detail and not ok else ""), flush=True)
+
+
+def _post(url, payload, timeout=120):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def _get(url, timeout=30):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def _next_round(out_dir):
+    n = 0
+    for f in glob.glob(os.path.join(out_dir, "ONLINE_r*.json")):
+        m = re.search(r"ONLINE_r(\d+)\.json$", os.path.basename(f))
+        if m:
+            n = max(n, int(m.group(1)))
+    return n + 1
+
+
+def _chunk(rng, n, drift):
+    """Labeled rows whose decision boundary shifts with ``drift`` — so a
+    refit over a fresh window MUST move the leaf values."""
+    X = rng.normal(size=(n, 6))
+    y = (X[:, 0] + drift * X[:, 1] - 0.3 * X[:, 2] > drift * 0.5)
+    return X, y.astype(np.float64)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description="Online-loop end-to-end smoke")
+    ap.add_argument("--json", action="store_true",
+                    help="print a machine-readable verdict line")
+    ap.add_argument("--out", default=REPO,
+                    help="ONLINE_rN.json artifact dir (default: repo root)")
+    ap.add_argument("--no-write", action="store_true",
+                    help="skip writing the ONLINE_rN.json artifact")
+    args = ap.parse_args(argv)
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.online import OnlineLoop
+    from lightgbm_tpu.serve import ModelRegistry, PredictServer
+
+    t0 = time.time()
+    art = tempfile.mkdtemp(prefix="online_smoke_")
+    rng = np.random.default_rng(12)
+
+    P = {"objective": "binary", "num_leaves": 7, "min_data_in_leaf": 5,
+         "verbose": -1, "tpu_serve_replicas": 1, "tpu_serve_max_batch": 128,
+         "tpu_serve_rollback_watch_s": 0.0, "tpu_online_mode": "refit",
+         "tpu_online_window": 1200, "tpu_online_refit_every": 600,
+         "tpu_online_decay": 0.5}
+    cfg = Config.from_params(P)
+
+    # ---- base model + fleet ----------------------------------------
+    X0, y0 = _chunk(rng, 800, drift=0.0)
+    ds = lgb.Dataset(X0, label=y0, params=P)
+    bst = lgb.train(P, ds, num_boost_round=6, verbose_eval=False)
+    base_path = os.path.join(art, "base.txt")
+    bst.save_model(base_path)
+
+    reg = ModelRegistry(config=cfg)
+    reg.add_model("default", base_path)
+    server = PredictServer(reg).start()
+    url = server.url
+    check("base_deployed", _get(url + "/models")[0] == 200)
+    probe = X0[:16]
+    base_pred = np.asarray(bst.predict(probe))
+
+    # ---- concurrent traffic through every swap ---------------------
+    results, stop = [], threading.Event()
+
+    def traffic():
+        while not stop.is_set():
+            try:
+                code, body = _post(url + "/predict",
+                                   {"rows": probe.tolist()}, timeout=60)
+                results.append((code, body))
+            except Exception as exc:  # noqa: BLE001
+                results.append((0, {"error": repr(exc)}))
+            time.sleep(0.02)
+
+    t = threading.Thread(target=traffic, daemon=True)
+    t.start()
+
+    # ---- the loop: drifting stream -> >= 2 refreshed versions ------
+    def push(model_path):
+        code, body = _post(f"{url}/models/default/swap",
+                           {"model_file": model_path}, timeout=300)
+        if code != 200 or not body.get("ok"):
+            raise RuntimeError(f"swap bounced: {body}")
+        return body
+
+    loop = OnlineLoop(base_path, config=cfg, push=push,
+                      workdir=os.path.join(art, "versions"),
+                      params=dict(P))
+    os.makedirs(loop.workdir, exist_ok=True)
+    refresh_s = []
+    for round_i, drift in enumerate((0.6, 1.2, 1.8)):
+        Xc, yc = _chunk(rng, 600, drift=drift)
+        loop.ingest(Xc, yc)
+        rep = loop.tick()
+        if rep and rep.get("ok"):
+            refresh_s.append(rep["ms"] / 1e3)
+    stop.set()
+    t.join(timeout=10)
+
+    st = loop.stats()
+    check("refreshed_at_least_2", st["versions"] >= 2, st)
+    code, models = _get(url + "/models")
+    live = next((m for m in models["models"]
+                 if m["name"] == "default"), {})
+    check("registry_live_advanced",
+          (live.get("live_version") or 0) >= 3, live)
+    bad = [r for r in results if r[0] != 200]
+    check("zero_request_loss", len(bad) == 0 and len(results) > 0,
+          f"{len(bad)}/{len(results)} failed: {bad[:2]}")
+    vals = [np.asarray(b.get("predictions")) for c, b in results if c == 200]
+    check("predictions_finite",
+          all(np.isfinite(v).all() for v in vals))
+    versions_seen = {b.get("version") for c, b in results if c == 200}
+    check("versions_attributed", None not in versions_seen
+          and len(versions_seen) >= 2, versions_seen)
+    moved = float(np.max(np.abs(np.asarray(vals[-1]) - base_pred))) \
+        if vals else 0.0
+    check("fresh_model_moved", moved > 1e-6, f"max delta {moved}")
+
+    # ---- poisoned refit: canary gate rejects, old version serves ---
+    with open(loop.base) as fh:
+        txt = fh.read()
+    poisoned = os.path.join(art, "poisoned.txt")
+    with open(poisoned, "w") as fh:
+        fh.write(re.sub(r"^leaf_value=.*$",
+                        lambda m: "leaf_value=" + " ".join(
+                            ["nan"] * len(m.group(0).split("=")[1].split())),
+                        txt, flags=re.MULTILINE))
+    live_before = _get(url + "/models")[1]["models"][0]["live_version"]
+    try:
+        code, body = _post(f"{url}/models/default/swap",
+                           {"model_file": poisoned}, timeout=300)
+        check("poisoned_rejected_409", False, f"swap answered {code}")
+    except urllib.error.HTTPError as exc:
+        body = json.loads(exc.read())
+        check("poisoned_rejected_409", exc.code == 409, body)
+        rep = (body.get("report") or {}).get("checks") or {}
+        check("poisoned_canary_finite_false",
+              rep.get("finite") is False or rep.get("gate") is False, body)
+    live_after = _get(url + "/models")[1]["models"][0]["live_version"]
+    check("old_version_still_serving", live_after == live_before,
+          f"{live_before} -> {live_after}")
+    code, body = _post(url + "/predict", {"rows": probe.tolist()})
+    check("serving_after_poison", code == 200
+          and np.isfinite(body["predictions"]).all())
+
+    server.stop(close_session=True)
+
+    record = {
+        "kind": "online",
+        "t": round(time.time(), 1),
+        "wall_s": round(time.time() - t0, 1),
+        "backend": "cpu",
+        "checks": CHECKS,
+        "ok": all(CHECKS.values()),
+        "online_refresh_s": (round(sum(refresh_s) / len(refresh_s), 3)
+                             if refresh_s else None),
+        "online_swap_ok": st["versions"],
+        "online_swap_rejected": st["rejected"] + 1,  # + the poisoned push
+        "rows_ingested": st["rows_ingested"],
+        "artifacts_dir": art,
+    }
+    if not args.no_write:
+        n = _next_round(args.out)
+        path = os.path.join(args.out, f"ONLINE_r{n:02d}.json")
+        with open(path, "w") as fh:
+            json.dump(record, fh, indent=1)
+        print(f"# wrote {path}")
+    if args.json:
+        print(json.dumps(record))
+    else:
+        print(f"# {sum(CHECKS.values())}/{len(CHECKS)} checks passed "
+              f"({record['wall_s']}s)")
+    return 0 if record["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -62,7 +62,19 @@ retry (bit-identical model), fatal abort (wedge checkpoint + flight
 dump + bit-exact resume), CPU fallback, collective retry, stall
 stamping, serve degrade-and-reprobe, checkpoint-write faults and
 corrupt-checkpoint fallback — so every suite round re-proves the whole
-fault-tolerance plane on CPU.
+fault-tolerance plane on CPU.  Since ISSUE 12 it also covers the
+online loop: a refit fault leaves the old version serving, a crash
+mid-train-continue resumes bit-exactly, and an ingest stall skips the
+cadence with a logged + telemetry-stamped event.
+
+The ``online`` tier (ISSUE 12) runs ``tools/online_smoke.py --json``:
+the closed-loop end-to-end check — a drifting labeled stream drives
+the OnlineLoop to >= 2 refreshed versions through
+``POST /models/{name}/swap`` under concurrent zero-loss /predict
+traffic, and a deliberately poisoned refit bounces off the canary
+gate with the old version still serving.  Its JSON carries
+``online_refresh_s`` / ``online_swap_ok``, trended by
+``tools/bench_history.py`` from the ``ONLINE_r*.json`` artifact.
 """
 from __future__ import annotations
 
@@ -152,6 +164,10 @@ _TOOL_TIERS = {
     # canary rejection, post-swap rollback, priority shedding — every
     # fleet failure mode re-proved on CPU each suite round
     "chaos": ["chaos_serve.py", "--json"],
+    # online loop end-to-end (ISSUE 12): ingest -> refit -> canary-gated
+    # swap under live traffic, poisoned refit rejected — the closed loop
+    # re-proved on CPU each suite round
+    "online": ["online_smoke.py", "--json"],
 }
 
 
@@ -205,11 +221,13 @@ def run_serve_smoke(timeout: int, runner=subprocess.run,
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="Run the quick/slow test tiers and write SUITE_rN.json")
-    ap.add_argument("--tiers", default="quick,slow,serve,faults,chaos",
+    ap.add_argument("--tiers", default="quick,slow,serve,faults,chaos,"
+                                       "online",
                     help="comma list of tiers: pytest markers plus the "
-                         "built-in 'serve' smoke, 'faults' matrix and "
-                         "'chaos' serving-chaos legs (default "
-                         "quick,slow,serve,faults,chaos)")
+                         "built-in 'serve' smoke, 'faults' matrix, "
+                         "'chaos' serving-chaos and 'online' closed-"
+                         "loop legs (default "
+                         "quick,slow,serve,faults,chaos,online)")
     ap.add_argument("--select", default="",
                     help="pytest collection target (file or node id) "
                          "instead of the whole tests/ dir")
