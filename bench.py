@@ -21,7 +21,10 @@ BENCH_OVERLAP=1 (double-buffered wave scheduling).
 BENCH_TASK=rank switches to an
 MSLR-WEB30K-shaped lambdarank run only (ragged queries of 1..1251 docs,
 136 features, NDCG@10) against the reference's published MSLR CPU time
-(BASELINE.md: 215.32 s for 500 iters over 2.27M rows).
+(BASELINE.md: 215.32 s for 500 iters over 2.27M rows).  The rank legs
+ride the SAME pipeline A/B knobs as the headline (BENCH_QUANT /
+BENCH_FUSED / BENCH_FUSED_GRAD / BENCH_OVERLAP) and stamp the effective
+hist_mode / fused_grad into the rank_* line.
 
 The DEFAULT run also appends the rank numbers (prefixed rank_*) to the
 single JSON line, sized by BENCH_RANK_ROWS (default 200_000) /
@@ -132,15 +135,13 @@ def _embed_observability(result: dict) -> None:
 
 def _rank_data(rows: int):
     """MSLR-shaped synthetic: ragged queries (1..1251 docs, mean ~72),
-    136 features, graded 0-4 relevance correlated with a feature blend."""
+    136 features, graded 0-4 relevance correlated with a feature blend.
+    Query sizes come from the shared ``ops/rank.py mslr_like_sizes``
+    generator, so the ROOFLINE ranking-plane numbers price exactly this
+    shape."""
+    from lightgbm_tpu.ops.rank import mslr_like_sizes
     rng = np.random.default_rng(0)
-    qsizes = []
-    total = 0
-    while total < rows:
-        s = int(min(max(1, rng.lognormal(3.8, 1.0)), 1251))
-        s = min(s, rows - total)
-        qsizes.append(s)
-        total += s
+    qsizes = mslr_like_sizes(rows, rng=rng).tolist()
     n = sum(qsizes)
     X = rng.normal(size=(n, 136)).astype(np.float64)
     w = rng.normal(size=12)
@@ -156,10 +157,46 @@ def _rank_data(rows: int):
     return X, y, np.asarray(qsizes, np.int64)
 
 
+def _mode_params() -> dict:
+    """Pipeline-mode params from the BENCH_* A/B env knobs — shared by
+    the headline AND rank legs, so the rank bench rides the quantized
+    pipeline (BENCH_QUANT=int16) instead of silently clamping to f32
+    defaults."""
+    params = {}
+    # BENCH_FUSED=0: the unfused-sibling A/B leg (tools/tpu_window.py
+    # bench_unfused) — trees are bit-identical, only the kernel pipeline
+    # differs, so value deltas are pure fusion economics
+    if os.environ.get("BENCH_FUSED", "") == "0":
+        params["tpu_fused_sibling"] = False
+    # BENCH_QUANT=int16|int8 (or the convenience "1" -> int16): the
+    # quantized-accumulation A/B leg (bench_quant) — same problem/trees
+    # shape, quantization-only delta.  Unknown values ABORT rather than
+    # silently pricing the wrong mode into a window record.
+    quant = os.environ.get("BENCH_QUANT", "")
+    if quant in ("int16", "int8"):
+        params["tpu_hist_dtype"] = quant
+    elif quant == "1":
+        params["tpu_hist_dtype"] = "int16"
+    elif quant not in ("", "0"):
+        raise SystemExit(f"BENCH_QUANT must be int16, int8, 1 or 0 "
+                         f"(got {quant!r})")
+    # BENCH_FUSED_GRAD=0: unfused gradient pass (bit-identical trees,
+    # the delta is the [N] g/h HBM round-trip + dispatch)
+    if os.environ.get("BENCH_FUSED_GRAD", "") == "0":
+        params["tpu_fused_grad"] = False
+    # BENCH_OVERLAP=1: double-buffered wave scheduling
+    if os.environ.get("BENCH_OVERLAP", "") == "1":
+        params["tpu_wave_overlap"] = True
+    return params
+
+
 def _measure(params: dict, X, y, group, iters: int, metric_prefix: str):
     """Shared protocol for both benches: bin, one compile-warmup update,
     (iters-1) steady-state updates, then read the train metric.
-    Returns (per_iter_s, compile_s, bin_s, metric_value, num_rows)."""
+    Returns (per_iter_s, compile_s, bin_s, metric_value, num_rows,
+    mode_stamps) — mode_stamps carries the EFFECTIVE hist_mode (None
+    when the run never hit the wave kernel) and fused_grad flag read
+    off the trainer, so legs can stamp what actually ran."""
     import lightgbm_tpu as lgb
 
     import jax
@@ -182,7 +219,19 @@ def _measure(params: dict, X, y, group, iters: int, metric_prefix: str):
     per_iter = (time.time() - t1) / max(iters - 1, 1)
     mval = next((v for (_, m, v, _) in booster.eval_train()
                  if m.startswith(metric_prefix)), None)
-    return per_iter, compile_time, bin_time, mval, len(y)
+    gbdt = booster._gbdt
+    # fused_grad is stamped with its RUNTIME truth (the trainer's own
+    # fused_grad_active predicate, the same one the training loop's
+    # fused_now reads), matching the telemetry digest's wave_pipeline
+    # section (which overrides these at embed time when a sink is
+    # armed): health/profile/fault modes force the unfused path per
+    # iteration even when the fused closure is armed, and a window leg
+    # under LGBM_TPU_HEALTH must not claim a fused number it didn't run
+    stamps = {
+        "hist_mode": (gbdt._wave_info or {}).get("hist_mode"),
+        "fused_grad": bool(gbdt.fused_grad_active()),
+    }
+    return per_iter, compile_time, bin_time, mval, len(y), stamps
 
 
 def _run_rank(iters: int, leaves: int, rows: int) -> dict:
@@ -191,7 +240,10 @@ def _run_rank(iters: int, leaves: int, rows: int) -> dict:
               "eval_at": [10], "num_leaves": leaves, "learning_rate": 0.1,
               "max_bin": 255, "min_data_in_leaf": 50,
               "min_sum_hessian_in_leaf": 5.0, "verbose": -1}
-    per_iter, compile_time, bin_time, ndcg, n = _measure(
+    # the rank leg rides the SAME pipeline A/B knobs as the headline
+    # (BENCH_QUANT / BENCH_FUSED / BENCH_FUSED_GRAD / BENCH_OVERLAP)
+    params.update(_mode_params())
+    per_iter, compile_time, bin_time, ndcg, n, stamps = _measure(
         params, X, y, q, iters, "ndcg")
     rps = n / per_iter
     return {
@@ -206,6 +258,11 @@ def _run_rank(iters: int, leaves: int, rows: int) -> dict:
         "binning_s": round(bin_time, 1),
         "train_ndcg10": None if ndcg is None else round(float(ndcg), 5),
         "implied_mslr_500iter_s": round(2_270_296 * 500 / rps, 1),
+        # mode stamps, like the headline leg's: which histogram kernel
+        # the rank trees were grown with and whether the gradient pass
+        # was fused — bench_history flags a silent downgrade
+        "hist_mode": stamps["hist_mode"],
+        "fused_grad": stamps["fused_grad"],
     }
 
 
@@ -292,31 +349,8 @@ def main() -> None:
     params = {"objective": "binary", "metric": "auc", "num_leaves": leaves,
               "learning_rate": 0.1, "max_bin": max_bin,
               "min_data_in_leaf": 100, "verbose": -1}
-    # BENCH_FUSED=0: the unfused-sibling A/B leg (tools/tpu_window.py
-    # bench_unfused) — trees are bit-identical, only the kernel pipeline
-    # differs, so value deltas are pure fusion economics
-    if os.environ.get("BENCH_FUSED", "") == "0":
-        params["tpu_fused_sibling"] = False
-    # BENCH_QUANT=int16|int8 (or the convenience "1" -> int16): the
-    # quantized-accumulation A/B leg (bench_quant) — same problem/trees
-    # shape, quantization-only delta.  Unknown values ABORT rather than
-    # silently pricing the wrong mode into a window record.
-    quant = os.environ.get("BENCH_QUANT", "")
-    if quant in ("int16", "int8"):
-        params["tpu_hist_dtype"] = quant
-    elif quant == "1":
-        params["tpu_hist_dtype"] = "int16"
-    elif quant not in ("", "0"):
-        raise SystemExit(f"BENCH_QUANT must be int16, int8, 1 or 0 "
-                         f"(got {quant!r})")
-    # BENCH_FUSED_GRAD=0: unfused gradient pass (bit-identical trees,
-    # the delta is the [N] g/h HBM round-trip + dispatch)
-    if os.environ.get("BENCH_FUSED_GRAD", "") == "0":
-        params["tpu_fused_grad"] = False
-    # BENCH_OVERLAP=1: double-buffered wave scheduling
-    if os.environ.get("BENCH_OVERLAP", "") == "1":
-        params["tpu_wave_overlap"] = True
-    per_iter, compile_time, bin_time, auc_val, _ = _measure(
+    params.update(_mode_params())
+    per_iter, compile_time, bin_time, auc_val, _, _ = _measure(
         params, X, y, None, iters, "auc")
 
     row_iters_per_sec = rows / per_iter
@@ -364,6 +398,8 @@ def main() -> None:
                 "rank_compile_s": rr["compile_s"],
                 "rank_binning_s": rr["binning_s"],
                 "rank_train_ndcg10": rr["train_ndcg10"],
+                "rank_hist_mode": rr["hist_mode"],
+                "rank_fused_grad": rr["fused_grad"],
                 "implied_mslr_500iter_s": rr["implied_mslr_500iter_s"],
             })
         except Exception as exc:  # rank failure must not lose the main number

@@ -667,6 +667,8 @@ class GBDT(PredictorBase):
         self._wave_batched = False  # wave path applies splits one-pass
         self._wave_info = None  # telemetry: {hist_mode, wave_capacity,
         #                         fused_sibling} when the wave path runs
+        self._rank_sharded = False  # query-aligned lambdarank sharding
+        #                             armed (parallel/rank_shard.py)
 
         # ---- CEGB (reference: cost_effective_gradient_boosting.hpp) -----
         self._cegb_on = False
@@ -798,6 +800,20 @@ class GBDT(PredictorBase):
                                      "local_listen_port", 12400)),
                                  time_out=NETWORK.get("time_out"))
             mesh = build_mesh(config.tpu_mesh_shape)
+            # query-aligned lambdarank sharding (tpu_rank_sharded_grad):
+            # snap the pair pass to query-boundary row shards so the
+            # per-query O(P^2) lambdas run INSIDE the mesh instead of
+            # globally on the dispatch side; bit-identical to the
+            # single-device oracle (every query lives wholly on one
+            # shard), pinned by tests/test_rank_device.py
+            if (tl == "data" and mesh.devices.size > 1
+                    and getattr(self.objective, "supports_query_sharding",
+                                False)
+                    and bool(getattr(config, "tpu_rank_sharded_grad",
+                                     True))):
+                from ..parallel.rank_shard import enable_query_sharded_grads
+                enable_query_sharded_grads(self.objective, mesh)
+                self._rank_sharded = True
             wave_kw = None
             # engine growers shard one bins array; mixed-width stays
             # serial-only and parallel uint16 keeps the XLA path
@@ -989,6 +1005,24 @@ class GBDT(PredictorBase):
                          if cegb_cfg.lazy is not None
                          else np.zeros((1, 1), np.uint8))
                 self._cegb_state.append(jnp.asarray(rows0))
+
+    def fused_grad_active(self) -> bool:
+        """Runtime truth of the fused gradient pass for a steady-state
+        iteration (no custom gradients): the ``_fused_grad`` arming,
+        minus every per-iteration force-unfused condition — the renew/
+        CEGB slow path, health taps, profile attribution, and an armed
+        fault harness.  The training loop's ``fused_now`` and bench.py's
+        ``fused_grad`` stamp both read THIS predicate, so a leg under
+        ``LGBM_TPU_HEALTH`` can never claim a fused number it didn't
+        run."""
+        from ..robust import faults as _faults
+        needs_renew = (self.objective is not None
+                       and self.objective.is_renew_tree_output)
+        return (getattr(self, "_grow_apply_fused", None) is not None
+                and not (needs_renew or self._cegb_on)
+                and not obs.health_enabled()
+                and not obs.profile_enabled()
+                and not _faults.armed())
 
     @staticmethod
     def _hist_mode(config: Config) -> str:
@@ -1512,12 +1546,8 @@ class GBDT(PredictorBase):
         # "gradients" injection point lives on the separate dispatch,
         # and a fault matrix that silently stopped injecting would pass
         # vacuously.
-        from ..robust import faults as _faults
-        fused_now = (getattr(self, "_grow_apply_fused", None) is not None
-                     and gradients is None and hessians is None
-                     and not slow_path and not health_on
-                     and not obs.profile_enabled()
-                     and not _faults.armed())
+        fused_now = (gradients is None and hessians is None
+                     and self.fused_grad_active())
         init_scores = [0.0] * K
         if fused_now:
             for k in range(K):
@@ -2158,15 +2188,35 @@ class GBDT(PredictorBase):
         (reference: GBDT::OutputMetric, gbdt.cpp:513-571)."""
         out = []
         if include_train and self.metrics:
-            score = self._score_for_metrics(self._train_score)
-            for m in self.metrics:
-                for name, value, hib in m.eval(score, self.objective):
-                    out.append(("training", name, value, hib))
+            out.extend(self._eval_metric_set("training", self.metrics,
+                                             self._train_score))
         for i, name in enumerate(self.valid_names):
-            vscore = self._score_for_metrics(self._valid_scores[i])
-            for m in self.valid_metrics[i]:
-                for mname, value, hib in m.eval(vscore, self.objective):
-                    out.append((name, mname, value, hib))
+            out.extend(self._eval_metric_set(name, self.valid_metrics[i],
+                                             self._valid_scores[i]))
+        return out
+
+    def _eval_metric_set(self, ds_name: str, metrics, dev_score) -> List[Tuple]:
+        """Evaluate one metric list against one score buffer.  Metrics
+        that accept the device score (the device NDCG kernel) get the
+        raw device array — the eval round then costs one tiny
+        [len(eval_at)] transfer instead of the full [N] score copy; the
+        host f64 conversion happens at most once, and only when some
+        metric in the list still needs it."""
+        out = []
+        host_score = None
+        dev = None
+        for m in metrics:
+            if getattr(m, "accepts_device_score", False):
+                if dev is None:
+                    dev = (dev_score[:, 0] if self.num_tpi == 1
+                           else dev_score)
+                s = dev
+            else:
+                if host_score is None:
+                    host_score = self._score_for_metrics(dev_score)
+                s = host_score
+            for name, value, hib in m.eval(s, self.objective):
+                out.append((ds_name, name, value, hib))
         return out
 
     def _score_for_metrics(self, score):

@@ -326,6 +326,31 @@ class Config:
                                         # objectives and health taps keep
                                         # the unfused path).  false =
                                         # the differential oracle
+    tpu_rank_device_eval: bool = True   # ranking eval path: true = the
+                                        # device NDCG@k kernel over the
+                                        # shared padded query blocks
+                                        # (metric/rank.py — stable sort
+                                        # per block, gain-discount
+                                        # cumsum, per-k gather; one tiny
+                                        # [len(eval_at)] D2H per eval
+                                        # instead of the full [N] score
+                                        # copy + ~per-query host loop);
+                                        # false = the host per-query
+                                        # loop (the differential oracle)
+    tpu_rank_sharded_grad: bool = True  # under tree_learner=data with
+                                        # >1 mesh device, compute the
+                                        # lambdarank pair lambdas INSIDE
+                                        # the mesh over query-aligned
+                                        # row shards (parallel/
+                                        # rank_shard.py): shard
+                                        # boundaries snap to query
+                                        # boundaries so every pair stays
+                                        # shard-local, instead of the
+                                        # whole pair pass running
+                                        # globally on one device.
+                                        # Per-row lambdas are the same
+                                        # per-query sums, so results
+                                        # match the single-device oracle
     tpu_wave_overlap: bool = False      # double-buffered wave scheduling:
                                         # defer each wave's child split-
                                         # scan by one loop body so it

@@ -1,0 +1,224 @@
+"""Padded query blocks — the shared device side of the ranking plane.
+
+Ranking work is ragged (MSLR-WEB30K queries span 1..1251 documents) and
+the reference walks it with per-query host loops (rank_objective.hpp
+GetGradientsForOneQuery, dcg_calculator.cpp).  On TPU every consumer
+reshapes the raggedness the same way ONCE at init: queries are grouped
+into power-of-two padded-length buckets, each bucket holding static
+``[Q, P]`` doc-index/label/gain tensors plus per-query scalars (inverse
+max DCG, query weight, per-``eval_at``-k NDCG lookup tables).  Invalid
+slots carry index ``sentinel`` so device gathers clamp and scatters
+drop them.
+
+Consumers:
+
+- the lambdarank objective (objective/rank.py) evaluates its
+  ``[qc, P, P]`` pair tensors over these blocks (``lax.map`` over query
+  chunks bounds the pair-tensor memory);
+- the device NDCG kernel (metric/rank.py) stable-sorts and cumsums the
+  same ``[Q, P]`` tensors, gathering DCG at each ``eval_at`` k;
+- the query-aligned data-parallel path (parallel/rank_shard.py) builds
+  one ``QueryBlocks`` per mesh shard with LOCAL row indices, so every
+  pair stays shard-local (the reference keeps query boundaries in
+  ``Metadata`` for the same reason).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..utils import log
+
+# pair tensor budget per lax.map step (elements): q_chunk * P * P
+CHUNK_ELEMS = 1 << 19
+MIN_PAD = 8
+# hard cap on one query's padded length: a single [P, P] pair matrix is
+# materialized per query, so P=4096 already costs ~64MB per f32 temporary
+# (MSLR's largest query is 1251 docs — well inside).  Queries beyond this
+# would need a tiled pair scan; fail loudly instead of OOMing the device.
+MAX_PAD = 4096
+MAX_LABEL = 31
+
+
+def default_label_gain(n: int = MAX_LABEL) -> np.ndarray:
+    """2^label - 1 (reference: DCGCalculator::DefaultLabelGain)."""
+    return np.asarray([(1 << i) - 1 for i in range(n)], dtype=np.float64)
+
+
+def max_dcg_at_k(k: int, labels: np.ndarray, gains: np.ndarray) -> float:
+    """Ideal DCG truncated at k (reference: DCGCalculator::CalMaxDCGAtK)."""
+    top = np.sort(labels)[::-1][:k]
+    disc = 1.0 / np.log2(np.arange(len(top)) + 2.0)
+    return float((gains[top.astype(np.int64)] * disc).sum())
+
+
+def query_pads(sizes: np.ndarray, min_pad: int = MIN_PAD) -> np.ndarray:
+    """Per-query pow2-padded length; fatal past MAX_PAD."""
+    if sizes.max(initial=0) > MAX_PAD:
+        log.fatal(f"Query with {int(sizes.max())} documents exceeds the "
+                  f"supported maximum of {MAX_PAD} for lambdarank")
+    return np.maximum(min_pad, 2 ** np.ceil(
+        np.log2(np.maximum(sizes, 1))).astype(np.int64))
+
+
+def chunk_queries(P: int, chunk_elems: int = CHUNK_ELEMS) -> int:
+    """Queries per ``lax.map`` chunk at pad ``P`` — bounds the
+    objective's ``[qc, P, P]`` pair tensor to ``chunk_elems``."""
+    return max(1, chunk_elems // (P * P))
+
+
+def bucket_shapes(sizes, chunk_elems: int = CHUNK_ELEMS,
+                  min_pad: int = MIN_PAD):
+    """``[(P, Qp, qc)]`` padded bucket geometry for a query-size vector
+    — THE authority on the shapes ``build_query_blocks`` materializes
+    (pow2 pads, query counts padded to a chunk multiple).  The ranking
+    cost models (``ops/rank.py``) and the shard stacking
+    (``parallel/rank_shard.py``) consume the same helper so the priced
+    shapes can never drift from the built ones."""
+    sizes = np.asarray(sizes, dtype=np.int64)
+    pads = query_pads(sizes, min_pad=min_pad)
+    out = []
+    for P in np.unique(pads):
+        Q = int((pads == P).sum())
+        P = int(P)
+        qc = chunk_queries(P, chunk_elems)
+        Qp = -(-Q // qc) * qc
+        out.append((P, Qp, qc))
+    return out
+
+
+class QueryBucket:
+    """One padded-length bucket: every query whose pow2 pad is ``P``.
+
+    Arrays are chunk-reshaped ``[nc, qc, ...]`` so the objective's
+    ``lax.map`` over chunks bounds its ``[qc, P, P]`` pair tensor; the
+    flat ``[nc*qc, ...]`` view is a free reshape for the NDCG kernel.
+    ``idx`` rows hold GLOBAL (or shard-local, see ``base``) row indices
+    with invalid slots = the blocks' sentinel.  Eval fields (``k_idx``,
+    ``inv_k``, ``one_k``, ``qw``) exist only when built with
+    ``eval_at``: per query and k, NDCG = dcg[k_idx]*inv_k + one_k —
+    zero-relevance queries (and k's whose ideal DCG is <= 0) carry
+    inv_k=0/one_k=1 so they count as perfect exactly like the host
+    oracle's empty-dcg case; padding queries carry 0/0 and weight 0.
+    """
+    __slots__ = ("P", "qc", "nc", "idx", "labs", "gains", "inv",
+                 "k_idx", "inv_k", "one_k", "qw")
+
+    def __init__(self, **kw):
+        for k in self.__slots__:
+            setattr(self, k, kw.get(k))
+
+
+class QueryBlocks:
+    """The padded-query-bucket set for one (query set, label) pair."""
+
+    def __init__(self, buckets: List[QueryBucket], sentinel: int,
+                 eval_at: Optional[List[int]], wsum: float,
+                 num_queries: int):
+        self.buckets = buckets
+        self.sentinel = int(sentinel)
+        self.eval_at = list(eval_at) if eval_at else None
+        self.wsum = float(wsum)
+        self.num_queries = int(num_queries)
+
+
+def build_query_blocks(query_boundaries, label, label_gain, *,
+                       optimize_pos_at: int = 20,
+                       eval_at: Optional[Sequence[int]] = None,
+                       query_weights=None,
+                       query_ids: Optional[np.ndarray] = None,
+                       base: int = 0,
+                       sentinel: Optional[int] = None,
+                       chunk_elems: int = CHUNK_ELEMS,
+                       with_labels: bool = True) -> QueryBlocks:
+    """Group queries into padded-length buckets and precompute the
+    static per-query tensors (doc indices, label gains, inverse max
+    DCG — the inverse_max_dcgs_ cache of rank_objective.hpp:60-70 —
+    plus, when ``eval_at`` is given, the per-k NDCG lookup tables the
+    device metric kernel gathers against).
+
+    ``query_ids`` restricts to a subset of queries (a mesh shard);
+    ``base`` is subtracted from row indices so a shard's blocks address
+    its LOCAL score vector; ``sentinel`` is the invalid-slot index
+    (default: the global row count) — gathers at it clamp, scatters at
+    it drop.  ``with_labels=False`` skips the pair-pass-only tensors
+    (labels AND the per-query inverse-max-DCG with its sort per query)
+    for eval-only blocks — the NDCG kernel reads only idx/gains and the
+    per-k tables.
+    """
+    import jax.numpy as jnp
+
+    b = np.asarray(query_boundaries, dtype=np.int64)
+    label = np.asarray(label, dtype=np.float64)
+    gains_tab = np.asarray(label_gain, dtype=np.float64)
+    all_q = np.arange(len(b) - 1, dtype=np.int64)
+    qids = all_q if query_ids is None else np.asarray(query_ids, np.int64)
+    sizes = (b[qids + 1] - b[qids]) if len(qids) else np.zeros(0, np.int64)
+    if sentinel is None:
+        sentinel = int(b[-1])
+    pads = query_pads(sizes)
+    ks = [int(k) for k in eval_at] if eval_at else None
+    nK = len(ks) if ks else 0
+    buckets: List[QueryBucket] = []
+    wsum = 0.0
+    for P, Qp, qc in bucket_shapes(sizes, chunk_elems):
+        sel = np.flatnonzero(pads == P)
+        idx = np.full((Qp, P), sentinel, dtype=np.int32)
+        labs = np.zeros((Qp, P), dtype=np.float32)
+        gains = np.zeros((Qp, P), dtype=np.float32)
+        inv = np.zeros(Qp, dtype=np.float32)
+        k_idx = np.zeros((Qp, nK), dtype=np.int32) if nK else None
+        inv_k = np.zeros((Qp, nK), dtype=np.float32) if nK else None
+        one_k = np.zeros((Qp, nK), dtype=np.float32) if nK else None
+        qw = np.zeros(Qp, dtype=np.float32) if nK else None
+        for r, s in enumerate(sel):
+            q = int(qids[s])
+            lo, hi = int(b[q]), int(b[q + 1])
+            cnt = hi - lo
+            idx[r, :cnt] = np.arange(lo - base, hi - base, dtype=np.int32)
+            ql = label[lo:hi]
+            qi = ql.astype(np.int64)
+            gains[r, :cnt] = gains_tab[qi]
+            if with_labels:
+                labs[r, :cnt] = ql
+                maxdcg = max_dcg_at_k(optimize_pos_at, qi, gains_tab)
+                inv[r] = 1.0 / maxdcg if maxdcg > 0.0 else 0.0
+            if not nK:
+                continue
+            w = (float(query_weights[q]) if query_weights is not None
+                 else 1.0)
+            qw[r] = w
+            wsum += w
+            zero_rel = (gains_tab[qi].max(initial=0.0) <= 0.0
+                        if cnt else True)
+            if cnt:
+                ideal = np.sort(qi)[::-1]
+                disc = 1.0 / np.log2(np.arange(cnt) + 2.0)
+                icum = np.cumsum(gains_tab[ideal] * disc)
+            for i, k in enumerate(ks):
+                kk = min(k, cnt)
+                k_idx[r, i] = max(kk - 1, 0)
+                idcg = float(icum[kk - 1]) if cnt else 0.0
+                if zero_rel or idcg <= 0.0:
+                    # all-zero-relevance (or degenerate-ideal) queries
+                    # count as perfect (reference: NDCGMetric::Eval
+                    # empty-dcg case)
+                    one_k[r, i] = 1.0
+                else:
+                    inv_k[r, i] = 1.0 / idcg
+        nc = Qp // qc
+        buckets.append(QueryBucket(
+            P=P, qc=qc, nc=nc,
+            idx=jnp.asarray(idx.reshape(nc, qc, P)),
+            labs=(jnp.asarray(labs.reshape(nc, qc, P)) if with_labels
+                  else None),
+            gains=jnp.asarray(gains.reshape(nc, qc, P)),
+            inv=(jnp.asarray(inv.reshape(nc, qc)) if with_labels
+                 else None),
+            k_idx=(jnp.asarray(k_idx.reshape(nc, qc, nK)) if nK else None),
+            inv_k=(jnp.asarray(inv_k.reshape(nc, qc, nK)) if nK else None),
+            one_k=(jnp.asarray(one_k.reshape(nc, qc, nK)) if nK else None),
+            qw=(jnp.asarray(qw.reshape(nc, qc)) if nK else None),
+        ))
+    return QueryBlocks(buckets, sentinel, ks, wsum, len(qids))

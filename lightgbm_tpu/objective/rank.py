@@ -4,7 +4,9 @@
 The reference runs a per-query O(n^2) pair loop on the CPU
 (GetGradientsForOneQuery, rank_objective.hpp:117-166).  The TPU
 formulation keeps the same math but turns the ragged per-query loops
-into dense array ops:
+into dense array ops over the shared padded query blocks
+(``core/query.py QueryBlocks`` — the same structure the device NDCG
+metric kernel sorts):
 
 - queries are bucketed by padded length (powers of two), giving a few
   static shapes to jit instead of one shape per query size;
@@ -16,6 +18,11 @@ into dense array ops:
   query chunks bounds memory), then scatter-added back into the flat
   gradient vector.
 
+Under a data-parallel mesh the pair pass runs INSIDE the mesh over
+query-aligned row shards (parallel/rank_shard.py arms ``_shard``):
+every query lives wholly on one device, so the per-shard blocks drive
+the same ``pair_lambdas`` math shard-locally.
+
 Deviation from the reference: the 1M-entry sigmoid LUT
 (rank_objective.hpp:196-209) is a CPU memoization trick — the VPU
 computes ``exp`` at full throughput, so the sigmoid is evaluated
@@ -26,23 +33,10 @@ from __future__ import annotations
 
 import numpy as np
 
+from ..core.query import (MAX_LABEL, build_query_blocks,  # noqa: F401
+                          default_label_gain)
 from ..utils import log
 from .base import Objective
-
-# pair tensor budget per lax.map step (elements): q_chunk * P * P
-_CHUNK_ELEMS = 1 << 19
-_MIN_PAD = 8
-# hard cap on one query's padded length: a single [P, P] pair matrix is
-# materialized per query, so P=4096 already costs ~64MB per f32 temporary
-# (MSLR's largest query is 1251 docs — well inside).  Queries beyond this
-# would need a tiled pair scan; fail loudly instead of OOMing the device.
-_MAX_PAD = 4096
-_MAX_LABEL = 31
-
-
-def default_label_gain(n: int = _MAX_LABEL) -> np.ndarray:
-    """2^label - 1 (reference: DCGCalculator::DefaultLabelGain)."""
-    return np.asarray([(1 << i) - 1 for i in range(n)], dtype=np.float64)
 
 
 def _check_rank_labels(label: np.ndarray, num_gains: int) -> None:
@@ -53,16 +47,82 @@ def _check_rank_labels(label: np.ndarray, num_gains: int) -> None:
         log.fatal(f"label excel [0, {num_gains}) range for ranking task")
 
 
-def _max_dcg_at_k(k: int, labels: np.ndarray, gains: np.ndarray) -> float:
-    """Ideal DCG truncated at k (reference: DCGCalculator::CalMaxDCGAtK)."""
-    top = np.sort(labels)[::-1][:k]
-    disc = 1.0 / np.log2(np.arange(len(top)) + 2.0)
-    return float((gains[top.astype(np.int64)] * disc).sum())
+def pair_lambdas(score, buckets, sigmoid: float, norm: bool):
+    """Gradients/hessians over padded query buckets — the vectorized
+    form of GetGradientsForOneQuery (rank_objective.hpp:117-166).
+
+    ``buckets`` is any iterable of objects carrying chunk-reshaped
+    ``idx``/``labs``/``gains`` ``[nc, qc, P]`` and ``inv`` ``[nc, qc]``
+    (core/query.py QueryBucket, or the shard-local reconstruction in
+    parallel/rank_shard.py).  Row indices at or past ``len(score)``
+    are invalid: gathers clamp, scatters drop.  Returns flat f32
+    (g, h) shaped like ``score``.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    sig = sigmoid
+    neg_inf = jnp.float32(-jnp.inf)
+
+    def chunk_fn(args):
+        idx, labs, gains, inv = args          # [qc,P] ... [qc]
+        valid = idx < score.shape[0]
+        s_raw = score[idx]                    # OOB gathers clamp; masked
+        s_sort = jnp.where(valid, s_raw, neg_inf)
+        # rank positions via double argsort (stable, ties keep doc order
+        # like the reference's stable_sort)
+        order = jnp.argsort(-s_sort, axis=-1, stable=True)
+        pos = jnp.argsort(order, axis=-1, stable=True)
+        disc = 1.0 / jnp.log2(pos.astype(jnp.float32) + 2.0)
+
+        sv = jnp.where(valid, s_raw, 0.0)
+        best = jnp.max(s_sort, axis=-1)
+        worst = jnp.min(jnp.where(valid, s_raw, jnp.inf), axis=-1)
+
+        ds = sv[:, :, None] - sv[:, None, :]              # [qc,P,P]
+        dcg_gap = gains[:, :, None] - gains[:, None, :]
+        pd = jnp.abs(disc[:, :, None] - disc[:, None, :])
+        delta = dcg_gap * pd * inv[:, None, None]
+        if norm:
+            delta = jnp.where((best != worst)[:, None, None],
+                              delta / (0.01 + jnp.abs(ds)), delta)
+        p0 = jax.nn.sigmoid(-sig * ds)
+        vp = (valid[:, :, None] & valid[:, None, :]
+              & (labs[:, :, None] > labs[:, None, :]))
+        pl = jnp.where(vp, -sig * delta * p0, 0.0)
+        ph = jnp.where(vp, sig * sig * delta * p0 * (1.0 - p0), 0.0)
+
+        lam = pl.sum(axis=2) - pl.sum(axis=1)
+        hes = ph.sum(axis=2) + ph.sum(axis=1)
+        if norm:
+            sum_lambdas = -2.0 * pl.sum(axis=(1, 2))
+            factor = jnp.where(
+                sum_lambdas > 0.0,
+                jnp.log2(1.0 + sum_lambdas) / jnp.maximum(sum_lambdas, 1e-30),
+                1.0)
+            lam = lam * factor[:, None]
+            hes = hes * factor[:, None]
+        return lam.astype(jnp.float32), hes.astype(jnp.float32)
+
+    g = jnp.zeros(score.shape, jnp.float32)
+    h = jnp.zeros(score.shape, jnp.float32)
+    for bk in buckets:
+        lam, hes = jax.lax.map(
+            chunk_fn, (bk.idx, bk.labs, bk.gains, bk.inv))
+        flat_idx = bk.idx.reshape(-1)      # OOB scatters drop
+        g = g.at[flat_idx].add(lam.reshape(-1), mode="drop")
+        h = h.at[flat_idx].add(hes.reshape(-1), mode="drop")
+    return g, h
 
 
 class LambdarankNDCG(Objective):
     name = "lambdarank"
     need_accurate_prediction = False
+    # the pair pass is pure traced jnp over static blocks, so it can
+    # shard query-locally (parallel/rank_shard.py) and fold into the
+    # growth jit (tpu_fused_grad — differential-tested bit-identical
+    # through _grow_apply_fused in tests/test_rank_device.py)
+    supports_query_sharding = True
 
     def __init__(self, config):
         super().__init__(config)
@@ -72,6 +132,7 @@ class LambdarankNDCG(Objective):
         gains = list(config.label_gain or [])
         self.label_gain = (np.asarray(gains, dtype=np.float64) if gains
                            else default_label_gain())
+        self._shard = None   # parallel/rank_shard.py ShardedRankGrads
         if self.sigmoid <= 0.0:
             log.fatal(f"Sigmoid param {self.sigmoid} should be greater than zero")
 
@@ -84,109 +145,22 @@ class LambdarankNDCG(Objective):
         _check_rank_labels(label, len(self.label_gain))
         self.query_boundaries = np.asarray(metadata.query_boundaries,
                                            dtype=np.int64)
-        self._build_buckets(label, num_data)
-
-    def _build_buckets(self, label: np.ndarray, N: int) -> None:
-        """Group queries into padded-length buckets and precompute the
-        static per-query tensors (doc indices, label gains, inverse max
-        DCG — the inverse_max_dcgs_ cache of rank_objective.hpp:60-70)."""
-        import jax.numpy as jnp
-
-        b = self.query_boundaries
-        sizes = np.diff(b)
-        if sizes.max(initial=0) > _MAX_PAD:
-            log.fatal(f"Query with {int(sizes.max())} documents exceeds the "
-                      f"supported maximum of {_MAX_PAD} for lambdarank")
-        pads = np.maximum(_MIN_PAD,
-                          2 ** np.ceil(np.log2(np.maximum(sizes, 1))).astype(np.int64))
-        self._buckets = []
-        for P in np.unique(pads):
-            qids = np.flatnonzero(pads == P)
-            Q = len(qids)
-            P = int(P)
-            qc = max(1, _CHUNK_ELEMS // (P * P))
-            Qp = -(-Q // qc) * qc  # pad query count to a chunk multiple
-            idx = np.full((Qp, P), N, dtype=np.int32)
-            labs = np.zeros((Qp, P), dtype=np.float32)
-            gains = np.zeros((Qp, P), dtype=np.float32)
-            inv = np.zeros(Qp, dtype=np.float32)
-            for r, q in enumerate(qids):
-                lo, hi = int(b[q]), int(b[q + 1])
-                cnt = hi - lo
-                idx[r, :cnt] = np.arange(lo, hi, dtype=np.int32)
-                ql = label[lo:hi]
-                labs[r, :cnt] = ql
-                gains[r, :cnt] = self.label_gain[ql.astype(np.int64)]
-                maxdcg = _max_dcg_at_k(self.optimize_pos_at, ql.astype(np.int64),
-                                       self.label_gain)
-                inv[r] = 1.0 / maxdcg if maxdcg > 0.0 else 0.0
-            nc = Qp // qc
-            self._buckets.append(dict(
-                P=P, qc=qc,
-                idx=jnp.asarray(idx.reshape(nc, qc, P)),
-                labs=jnp.asarray(labs.reshape(nc, qc, P)),
-                gains=jnp.asarray(gains.reshape(nc, qc, P)),
-                inv=jnp.asarray(inv.reshape(nc, qc)),
-            ))
+        # the shared padded-query-bucket structure (core/query.py) —
+        # the device NDCG metric builds the same blocks from the same
+        # boundaries, plus its per-k eval tables
+        self.qblocks = build_query_blocks(
+            self.query_boundaries, label, self.label_gain,
+            optimize_pos_at=self.optimize_pos_at, sentinel=num_data)
 
     # ------------------------------------------------------------------
     def get_gradients(self, score):
-        """Gradients/hessians for the whole dataset; ``chunk_fn`` is the
-        vectorized form of GetGradientsForOneQuery
-        (rank_objective.hpp:117-166)."""
-        import jax
-        import jax.numpy as jnp
-
-        sig = self.sigmoid
-        norm = self.norm
-        neg_inf = jnp.float32(-jnp.inf)
-
-        def chunk_fn(args):
-            idx, labs, gains, inv = args          # [qc,P] ... [qc]
-            valid = idx < score.shape[0]
-            s_raw = score[idx]                    # OOB gathers clamp; masked
-            s_sort = jnp.where(valid, s_raw, neg_inf)
-            # rank positions via double argsort (stable, ties keep doc order
-            # like the reference's stable_sort)
-            order = jnp.argsort(-s_sort, axis=-1, stable=True)
-            pos = jnp.argsort(order, axis=-1, stable=True)
-            disc = 1.0 / jnp.log2(pos.astype(jnp.float32) + 2.0)
-
-            sv = jnp.where(valid, s_raw, 0.0)
-            best = jnp.max(s_sort, axis=-1)
-            worst = jnp.min(jnp.where(valid, s_raw, jnp.inf), axis=-1)
-
-            ds = sv[:, :, None] - sv[:, None, :]              # [qc,P,P]
-            dcg_gap = gains[:, :, None] - gains[:, None, :]
-            pd = jnp.abs(disc[:, :, None] - disc[:, None, :])
-            delta = dcg_gap * pd * inv[:, None, None]
-            if norm:
-                delta = jnp.where((best != worst)[:, None, None],
-                                  delta / (0.01 + jnp.abs(ds)), delta)
-            p0 = jax.nn.sigmoid(-sig * ds)
-            vp = (valid[:, :, None] & valid[:, None, :]
-                  & (labs[:, :, None] > labs[:, None, :]))
-            pl = jnp.where(vp, -sig * delta * p0, 0.0)
-            ph = jnp.where(vp, sig * sig * delta * p0 * (1.0 - p0), 0.0)
-
-            lam = pl.sum(axis=2) - pl.sum(axis=1)
-            hes = ph.sum(axis=2) + ph.sum(axis=1)
-            if norm:
-                sum_lambdas = -2.0 * pl.sum(axis=(1, 2))
-                factor = jnp.where(
-                    sum_lambdas > 0.0,
-                    jnp.log2(1.0 + sum_lambdas) / jnp.maximum(sum_lambdas, 1e-30),
-                    1.0)
-                lam = lam * factor[:, None]
-                hes = hes * factor[:, None]
-            return lam.astype(jnp.float32), hes.astype(jnp.float32)
-
-        g = jnp.zeros(score.shape, jnp.float32)
-        h = jnp.zeros(score.shape, jnp.float32)
-        for bk in self._buckets:
-            lam, hes = jax.lax.map(
-                chunk_fn, (bk["idx"], bk["labs"], bk["gains"], bk["inv"]))
-            flat_idx = bk["idx"].reshape(-1)      # OOB scatters drop
-            g = g.at[flat_idx].add(lam.reshape(-1), mode="drop")
-            h = h.at[flat_idx].add(hes.reshape(-1), mode="drop")
+        """Gradients/hessians for the whole dataset via ``pair_lambdas``
+        over the padded query blocks; when parallel/rank_shard.py armed
+        query-aligned sharding, the pair pass runs inside the mesh and
+        only the flat [N] g/h leave the shard_map."""
+        if self._shard is not None:
+            g, h = self._shard(score)
+            return self._apply_weight(g, h)
+        g, h = pair_lambdas(score, self.qblocks.buckets,
+                            self.sigmoid, self.norm)
         return self._apply_weight(g, h)

@@ -1,0 +1,97 @@
+"""Analytical cost models for the ranking plane.
+
+``objective/rank.py`` evaluates a dense [qc, P, P] sigmoid pair tensor
+per padded query chunk (the device form of GetGradientsForOneQuery) and
+``metric/rank.py`` sorts + cumsums [Q, P] blocks per eval round (the
+device form of the dcg_calculator loop).  ``rank_pair_cost`` /
+``ndcg_eval_cost`` are the hand-written rooflines for that work — the
+``wave_kernel_cost``/``partition_cost``/``shap_cost`` siblings for the
+ranking plane, so ``docs/ROOFLINE.md``'s "Ranking plane" section and
+the tests quote the same numbers.
+
+The op constants are empirical tallies of the emitted elementwise ops,
+not derivations — the same contract as ``split_scan_cost``.  Costs are
+in terms of the PADDED bucket geometry (``bucket_shapes``): padding is
+real VPU work the pow2 scheme pays for static shapes, so the model
+charges it.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.query import CHUNK_ELEMS, bucket_shapes  # noqa: F401 — the
+# geometry helper is re-exported here so cost-model callers read it
+# beside the models; core/query.py owns the single implementation the
+# block builder itself materializes
+
+# elementwise ops per [P, P] pair slot: score/gain/discount gaps (3),
+# delta product + inv scale (2), norm gate + divide (3), sigmoid
+# (exp ~6 + 2), lambda/hessian products (6), validity mask fold (2)
+_PAIR_SLOT_OPS = 24.0
+# per element per log2(P) step of a device sort network (compare +
+# select on key/index lanes); the pair pass pays it twice (rank
+# positions need sort + inverse), the NDCG kernel once + a gather
+_SORT_OPS = 8.0
+# per sorted element of the NDCG kernel: gain gather, discount
+# multiply, cumsum add, plus slack for the per-k gathers
+_NDCG_ELEM_OPS = 4.0
+
+
+def mslr_like_sizes(rows: int, rng=None) -> np.ndarray:
+    """MSLR-WEB30K-shaped ragged query sizes: lognormal(3.8, 1.0)
+    clamped to [1, 1251] docs (mean ~72), totalling ``rows``.  The
+    SAME generator bench.py's rank legs draw from, so the ROOFLINE
+    numbers and the bench shape agree by construction."""
+    if rng is None:
+        rng = np.random.default_rng(0)
+    out = []
+    total = 0
+    while total < rows:
+        s = int(min(max(1, rng.lognormal(3.8, 1.0)), 1251))
+        s = min(s, rows - total)
+        out.append(s)
+        total += s
+    return np.asarray(out, dtype=np.int64)
+
+
+def rank_pair_cost(sizes, chunk_elems: int = CHUNK_ELEMS):
+    """Analytical (FLOPs, HBM bytes) of ONE lambdarank gradient pass
+    (``pair_lambdas``) over the padded query buckets for ``sizes``.
+
+    FLOPs: the [qc, P, P] pair tensor per chunk (O(sum Qp * P^2) — the
+    pow2 padding's quadratic price is charged, which is why MIN_PAD
+    stays small) plus the two stable argsorts per block.  Bytes: the
+    static block tensors (idx/labs/gains + inv) read once, the score
+    gather, and the g/h scatter read-modify-write; the pair tensor
+    itself lives in VMEM (``lax.map`` chunking bounds it) and is not
+    charged to HBM."""
+    flops = 0.0
+    nbytes = 0.0
+    for P, Qp, _qc in bucket_shapes(sizes, chunk_elems):
+        flops += Qp * P * P * _PAIR_SLOT_OPS
+        flops += 2.0 * Qp * P * np.log2(P) * _SORT_OPS
+        nbytes += Qp * P * (12.0    # idx + labs + gains
+                            + 4.0   # score gather
+                            + 16.0)  # g/h scatter read-modify-write
+        nbytes += Qp * 4.0          # inverse max DCG
+    return flops, nbytes
+
+
+def ndcg_eval_cost(sizes, num_at: int = 1,
+                   chunk_elems: int = CHUNK_ELEMS):
+    """Analytical (FLOPs, HBM bytes) of ONE device NDCG@k eval
+    (``metric/rank.py _ndcg_device_fn``) over the padded query buckets:
+    one stable sort + gain-discount cumsum per block, ``num_at`` DCG
+    gathers + fma per query.  Bytes: idx/gains + score gather + the
+    per-k lookup tables; the [len(eval_at)] result is the ONLY thing
+    that leaves the device (vs the [N] score copy + per-query host
+    loop of the oracle path)."""
+    num_at = max(int(num_at), 1)
+    flops = 0.0
+    nbytes = 0.0
+    for P, Qp, _qc in bucket_shapes(sizes, chunk_elems):
+        flops += Qp * P * (np.log2(P) * _SORT_OPS + _NDCG_ELEM_OPS)
+        flops += Qp * num_at * 2.0
+        nbytes += Qp * P * (8.0 + 4.0)   # idx + gains + score gather
+        nbytes += Qp * (num_at * 12.0 + 4.0)  # k tables + query weight
+    return flops, nbytes

@@ -10,6 +10,11 @@ src/treelearner/{data,feature,voting}_parallel_tree_learner.cpp):
   best splits combined with an all-gather + argmax.
 - voting-parallel: rows sharded, per-device top-k feature gate before the
   histogram exchange (PV-Tree).
+- query-aligned lambdarank sharding (rank_shard.py): data-parallel shard
+  boundaries snapped to query boundaries so the per-query pair-lambda
+  pass runs shard-locally inside the mesh.
 """
 from .mesh import (make_data_parallel_grower, make_feature_parallel_grower,
                    make_voting_parallel_grower, row_sharded, shard_rows)
+from .rank_shard import (ShardedRankGrads, enable_query_sharded_grads,
+                         plan_query_shards)

@@ -64,6 +64,12 @@ _DIGEST_SKIP = frozenset((
     # (tpu_wave_overlap and tpu_hist_dtype are deliberately NOT here —
     # both change the trees a resumed run would grow.)
     "tpu_fused_sibling", "tpu_batched_split_apply", "tpu_fused_grad",
+    # eval-only: the device NDCG kernel never touches gradients or
+    # trees, so flipping it must not refuse a resume
+    "tpu_rank_device_eval",
+    # bit-identical knob (tests/test_rank_device.py pins the sharded
+    # pair pass against the single-device oracle across mesh sizes)
+    "tpu_rank_sharded_grad",
 ))
 
 

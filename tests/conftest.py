@@ -125,6 +125,14 @@ _SLOW = {
     "test_online.py::test_device_refit_matches_host_multiclass",
     "test_online.py::test_device_refit_matches_host_mesh_2dev",
     "test_online.py::test_device_refit_matches_host_binary[0.0]",
+    "test_rank_device.py::test_rank_data_parallel_end_to_end",
+    "test_rank_device.py::test_trainer_routes_device_score_to_ndcg",
+    "test_rank_device.py::test_fused_rank_gradients_bit_identical",
+    "test_rank_device.py::test_fused_rank_gradients_bit_identical_wave_interpret",
+    "test_rank_device.py::test_sharded_rank_grads_match_single_device_oracle[2]",
+    "test_rank_device.py::test_sharded_rank_grads_match_single_device_oracle[3]",
+    "test_serve.py::test_session_rank_topk_concurrent_mixed_sizes",
+    "test_explain.py::test_session_explain_rank_model_parity",
 }
 
 

@@ -577,3 +577,52 @@ def test_shap_cost_model_scales():
     f4, _ = shap_cost(N=64, T=10, L=31, P=16, F=12)
     assert f4 > 3.5 * f1                          # ~quadratic in depth
     assert f1 > 0 and b1 > 0 and b2 > b1
+
+
+# ---------------------------------------------------------------------------
+# 6. ranking fixture (ISSUE 13): /explain parity on a lambdarank model
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def rank_model(tmp_path_factory):
+    """Lambdarank model (ragged queries) saved + file-loaded — the
+    serving-plane ranking fixture's explain twin."""
+    rng = np.random.default_rng(21)
+    sizes = np.concatenate([rng.integers(1, 30, size=25), [1, 80]])
+    N = int(sizes.sum())
+    X = rng.normal(size=(N, 8))
+    y = rng.integers(0, 5, size=N).astype(np.float64)
+    params = {"objective": "lambdarank", "metric": "ndcg",
+              "num_leaves": 15, "min_data_in_leaf": 5, "verbose": -1}
+    ds = lgb.Dataset(X, label=y, group=sizes, params=params)
+    bst = lgb.train(params, ds, num_boost_round=12)
+    path = str(tmp_path_factory.mktemp("explain") / "rank.txt")
+    bst.save_model(path)
+    return bst, path
+
+
+def test_session_explain_rank_model_parity(rank_model):
+    """A served lambdarank model explains to host-oracle parity, with
+    SHAP local accuracy against its own raw ranking scores — the same
+    contract the classification fixtures pin, on the ranking batch
+    shape (one query's doc list per request)."""
+    _, path = rank_model
+    rng = np.random.default_rng(22)
+    Xq = rng.normal(size=(23, 8))       # one query's docs
+    want = predict_contrib(lgb.Booster(model_file=path)._gbdt, Xq)
+    with PredictorSession(path, max_batch=32) as sess:
+        got = sess.explain(Xq)
+        np.testing.assert_allclose(got, want, rtol=0, atol=1e-5)
+        # local accuracy: contributions sum to the raw ranking score
+        raw = sess.predict(Xq, raw_score=True)
+        np.testing.assert_allclose(got.sum(axis=1), raw, rtol=0,
+                                   atol=1e-5)
+        # mixed predict+explain traffic on the same session
+        ticket = sess.submit(Xq)
+        xticket = sess.submit_explain(Xq[:5])
+        np.testing.assert_allclose(sess.result(ticket, timeout=60), raw,
+                                   rtol=0, atol=1e-6)
+        np.testing.assert_allclose(sess.result(xticket, timeout=60),
+                                   want[:5], rtol=0, atol=1e-5)
+        st = sess.stats()
+    assert st["explain_armed"] is True and st["degraded"] is False

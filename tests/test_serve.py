@@ -370,3 +370,70 @@ def test_serve_event_schemas():
     problems = validate_events(bad)
     assert any("rows" in p for p in problems)
     assert any("total_ms" in p for p in problems)
+
+
+# ---------------------------------------------------------------------------
+# ranking fixtures (ISSUE 13): a lambdarank model behind PredictorSession —
+# top-k document scoring per request is a different batch shape than
+# per-row classification (each request is ONE query's doc list)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def rank_model(tmp_path_factory):
+    """File-loaded lambdarank model over MSLR-shaped ragged queries."""
+    rng = np.random.default_rng(9)
+    sizes = np.concatenate([rng.integers(1, 40, size=30), [1, 100]])
+    N = int(sizes.sum())
+    X = rng.normal(size=(N, 10))
+    y = rng.integers(0, 5, size=N).astype(np.float64)
+    params = {"objective": "lambdarank", "metric": "ndcg",
+              "num_leaves": 15, "min_data_in_leaf": 5, "verbose": -1}
+    ds = lgb.Dataset(X, label=y, group=sizes, params=params)
+    bst = lgb.train(params, ds, num_boost_round=15)
+    path = str(tmp_path_factory.mktemp("serve") / "rank.txt")
+    bst.save_model(path)
+    return path
+
+
+def test_session_rank_topk_concurrent_mixed_sizes(rank_model):
+    """Concurrent per-query scoring requests of wildly mixed sizes
+    (1..120 docs — a query per request) coalesce through the
+    microbatcher, match the host predictor, and preserve the host's
+    top-k document scores."""
+    sess = PredictorSession(rank_model, max_batch=64, max_wait_ms=1.0)
+    host = lgb.Booster(model_file=rank_model)
+    errs = []
+
+    def client(seed):
+        rng = np.random.default_rng(seed)
+        for _ in range(5):
+            n = int(rng.integers(1, 121))   # one query's doc list
+            Xq = rng.normal(size=(n, 10))
+            ticket = sess.submit(Xq)
+            got = sess.result(ticket, timeout=120)
+            want = host.predict(Xq)
+            if float(np.abs(got - want).max()) > 1e-6:
+                errs.append(("parity", seed, n))
+                continue
+            # top-k scoring: the served scores rank documents like the
+            # host's (compare sorted score vectors — index order is
+            # parity-implied up to exact ties)
+            k = min(10, n)
+            got_top = np.sort(got)[::-1][:k]
+            want_top = np.sort(want)[::-1][:k]
+            if float(np.abs(got_top - want_top).max()) > 1e-6:
+                errs.append(("topk", seed, n))
+
+    threads = [threading.Thread(target=client, args=(s,))
+               for s in range(5)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    st = sess.stats()
+    sess.close()
+    assert not errs, errs
+    assert st["degraded"] is False
+    assert st["batches"] >= 1
+    # single-doc and 100+-doc requests shared pow2 buckets
+    assert all(b & (b - 1) == 0 for b in st["buckets"])

@@ -618,14 +618,17 @@ def test_tpu_window_checklist_stubbed(tmp_path):
     assert set(rec["legs"]) == {"bench", "bench_profile",
                                 "bench_maxbin63", "bench_unfused",
                                 "bench_quant", "bench_nofusedgrad",
-                                "prof_kernels", "bench_serve",
-                                "bench_explain", "trace"}
+                                "bench_rank", "prof_kernels",
+                                "bench_serve", "bench_explain", "trace"}
     assert all(leg["rc"] == 0 for leg in rec["legs"].values())
-    # bench legs ran six times (clean, profile, maxbin63, unfused,
-    # quant, nofusedgrad)
+    # bench legs ran seven times (clean, profile, maxbin63, unfused,
+    # quant, nofusedgrad, rank)
     bench_calls = [c for c in fake.calls if any("bench.py" in a
                                                 for a in c)]
-    assert len(bench_calls) == 6
+    assert len(bench_calls) == 7
+    # the rank leg's parsed line landed as BENCH_rank_manual_rN.json
+    # and bench_history's BENCH_r* glob picks it up as its own context
+    assert (tmp_path / "BENCH_rank_manual_r07.json").exists()
     # the record is bench_history-compatible: it folds into the
     # trajectory as a canary (cpu-forced), never a baseline
     bh = _import_tool("bench_history")

@@ -28,7 +28,12 @@ ISSUE 11 it additionally carries the quantized + fused-grad smoke
 stochastic-rounded accumulation within its analytic error bound,
 bit-identical across the packed/fused layout grid, and the fused
 gradient pass bit-identical to its unfused oracle — the new modes
-can't rot between TPU windows.
+can't rot between TPU windows.  Since ISSUE 13 it also carries the
+ranking-plane smoke (``tests/test_rank_device.py::
+test_rank_wave_smoke_device_metric_parity``): a small lambdarank train
+end-to-end through the wave path (``LGBM_TPU_FORCE_WAVE=interpret``)
+with the device NDCG kernel asserted against the host oracle — CPU CI
+exercises the whole ranking plane every quick run.
 
 The ``serve`` tier is not a pytest marker: it runs
 ``tools/bench_serve.py --smoke`` — start the HTTP server in-process,

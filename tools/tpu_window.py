@@ -24,6 +24,11 @@ capture checklist with health monitoring enabled:
 3d. ``python bench.py`` with ``BENCH_FUSED_GRAD=0`` — the fused-
    gradient A/B twin: bit-identical trees, the delta is the per-
    iteration [N] g/h HBM round-trip the fused pass deletes;
+3e. ``python bench.py`` with ``BENCH_TASK=rank`` — the dedicated
+   MSLR-shaped lambdarank leg (ISSUE 13: device lambda pair pass +
+   device NDCG eval), written as ``BENCH_rank_manual_r{N}.json`` so
+   one window finally yields a clean ``rank_vs_baseline`` trajectory
+   point beside the HIGGS one;
 4. ``tools/prof_kernels.py`` (``PROF_JSON=1``) — the leg decomposition,
    including the wave-partition legs (batched one-pass split apply vs
    the sequential per-split oracle, against ``partition_cost``) and the
@@ -199,6 +204,15 @@ def checklist_legs(art_dir: str, dry_run: bool, py: str = sys.executable):
         # is the per-iteration [N] g/h HBM round-trip
         {"name": "bench_nofusedgrad", "argv": [py, bench],
          "env": env_for("bench_nofusedgrad", {"BENCH_FUSED_GRAD": "0"}),
+         "parse_json": True},
+        # the ranking-plane leg (ISSUE 13): a dedicated BENCH_TASK=rank
+        # run at full rank size (the headline's embedded rank leg runs
+        # at reduced BENCH_RANK_ROWS), written as BENCH_rank_manual_rN
+        # — the first clean window prices the device lambda/NDCG plane
+        # and bench_history trends its rank_vs_baseline point
+        {"name": "bench_rank", "argv": [py, bench],
+         "env": env_for("bench_rank", {"BENCH_TASK": "rank",
+                                       "BENCH_CPU_ROWS": "8000"}),
          "parse_json": True},
         {"name": "prof_kernels", "argv": [py, prof],
          "env": env_for("prof_kernels", {"PROF_JSON": "1"},
@@ -415,6 +429,19 @@ def run_checklist(out_dir: str, n: int, dry_run: bool,
         json.dump(health, fh, indent=1)
     print(f"# wrote {bench_path}")
     print(f"# wrote {health_path}")
+    rank_parsed = (results.get("bench_rank") or {}).get("parsed")
+    if rank_parsed:
+        # the dedicated rank record: bench.py's BENCH_TASK=rank line
+        # verbatim (value/vs_baseline + the hist_mode/fused_grad
+        # stamps) — the BENCH_r* glob in bench_history.py picks
+        # "BENCH_rank_manual_r*" up as its own context, so one good
+        # window leaves a trendable rank_vs_baseline point
+        rank_parsed = dict(rank_parsed, n=n, dry_run=dry_run)
+        rank_path = os.path.join(out_dir, f"BENCH_rank_manual_r{n:02d}.json")
+        with open(rank_path, "w") as fh:
+            json.dump(rank_parsed, fh, indent=1)
+        record["rank_path"] = rank_path
+        print(f"# wrote {rank_path}")
     serve_parsed = (results.get("bench_serve") or {}).get("parsed")
     if serve_parsed:
         serve_parsed = dict(serve_parsed, n=n, dry_run=dry_run)
@@ -489,8 +516,8 @@ def main(argv=None) -> int:
                     help="comma list restricting which checklist legs "
                          "run (bench,bench_profile,bench_maxbin63,"
                          "bench_unfused,bench_quant,bench_nofusedgrad,"
-                         "prof_kernels,bench_serve,bench_explain,trace); "
-                         "default all")
+                         "bench_rank,prof_kernels,bench_serve,"
+                         "bench_explain,trace); default all")
     ap.add_argument("--wedge-retries", type=int, default=1,
                     help="times a wedge-shaped leg failure (timeout / "
                          "transient runtime error) is retried with "
