@@ -36,6 +36,7 @@ if _env_plat:
 from .basic import Booster, Dataset
 from .config import Config
 from .engine import cv, train
+from . import ingest
 from .utils.log import LightGBMError
 from .callback import early_stopping, print_evaluation, record_evaluation, reset_parameter
 
@@ -54,6 +55,7 @@ except ImportError:  # pragma: no cover
 
 __version__ = "0.1.0"
 
-__all__ = ["Dataset", "Booster", "Config", "train", "cv", "LightGBMError",
+__all__ = ["Dataset", "Booster", "Config", "train", "cv", "ingest",
+           "LightGBMError",
            "early_stopping", "print_evaluation", "record_evaluation",
            "reset_parameter"] + _SKLEARN + _PLOT

@@ -42,6 +42,15 @@ def _parse_args(argv: List[str]) -> Dict[str, str]:
 
 def _dataset_from_file(path: str, cfg: Config, params: Dict,
                        reference=None, initscore_path: str = "") -> Dataset:
+    if getattr(cfg, "tpu_ingest", False):
+        from .io.text_loader import _ParseError
+        try:
+            return _dataset_ingest(path, cfg, params, reference,
+                                   initscore_path)
+        except _ParseError as exc:
+            log.warning("tpu_ingest streaming needs the strict native "
+                        "parser for text input (%s); falling back to "
+                        "in-memory loading", exc)
     if getattr(cfg, "two_round", False):
         from .io.text_loader import _ParseError
         try:
@@ -76,6 +85,59 @@ def _load_init_scores(path: str, initscore_path: str = ""):
     return None
 
 
+def _resolve_cli_categoricals(cfg: Config):
+    """categorical_feature spec string -> list of ints / names (the
+    name-based entries resolve against kept feature names downstream)."""
+    cats = []
+    spec = str(getattr(cfg, "categorical_feature", "") or "")
+    for tok in spec.replace("name:", "").split(","):
+        tok = tok.strip()
+        if not tok:
+            continue
+        cats.append(int(tok) if tok.isdigit() else tok)
+    return cats
+
+
+def _dataset_ingest(path: str, cfg: Config, params: Dict,
+                    reference=None, initscore_path: str = "") -> Dataset:
+    """tpu_ingest=true file loading: two-pass streaming ingestion
+    (ingest/stream.py) — chunked readers, reservoir bin sampling,
+    chunk-at-a-time binning, optional memmap-backed bin matrix and
+    row-shard plans; the raw matrix is never materialized."""
+    from .ingest.stream import ingest_file
+
+    ref_handle = (reference.construct()._handle
+                  if reference is not None else None)
+    handle, label, weight, group, names = ingest_file(
+        path, cfg, categorical_features=_resolve_cli_categoricals(cfg),
+        reference=ref_handle)
+    ds = Dataset(None, params=dict(params), feature_name=names,
+                 reference=reference)
+    ds._handle = handle
+    if label is not None:
+        ds.label = label
+    if weight is not None:
+        ds.weight = weight
+    if group is not None:
+        ds.group = group
+    init_score = _load_init_scores(path, initscore_path)
+    if init_score is not None:
+        # init-score files are whole-stream ([N_global * K] class-major
+        # flat); a sharded load keeps only its own rows of each class
+        lo, hi = getattr(handle, "ingest_row_range",
+                         (0, handle.num_data))
+        n_global = getattr(handle, "ingest_num_rows", handle.num_data)
+        if len(init_score) % n_global != 0:
+            log.fatal(f"init score length {len(init_score)} is not a "
+                      f"multiple of the data rows ({n_global})")
+        if handle.num_data != n_global:
+            k = len(init_score) // n_global
+            init_score = np.ascontiguousarray(
+                init_score.reshape(k, n_global)[:, lo:hi]).ravel()
+        ds.set_init_score(init_score)
+    return ds
+
+
 def _dataset_two_round(path: str, cfg: Config, params: Dict,
                        reference=None, initscore_path: str = "") -> Dataset:
     """two_round=true file loading: stream the file twice instead of
@@ -85,15 +147,9 @@ def _dataset_two_round(path: str, cfg: Config, params: Dict,
 
     ref_handle = (reference.construct()._handle
                   if reference is not None else None)
-    cats = []
-    spec = str(getattr(cfg, "categorical_feature", "") or "")
-    for tok in spec.replace("name:", "").split(","):
-        tok = tok.strip()
-        if not tok:
-            continue
-        cats.append(int(tok) if tok.isdigit() else tok)
     handle, label, weight, group, names = load_text_two_round(
-        path, cfg, categorical_features=cats, reference=ref_handle)
+        path, cfg, categorical_features=_resolve_cli_categoricals(cfg),
+        reference=ref_handle)
     ds = Dataset(None, params=dict(params), feature_name=names,
                  reference=reference)
     ds._handle = handle

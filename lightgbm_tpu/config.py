@@ -696,6 +696,49 @@ class Config:
                                         # are written ("" = a fresh temp
                                         # directory)
 
+    # ---- Out-of-core ingestion (ingest/ subsystem) ----
+    tpu_ingest: bool = False            # task=train file loading routes
+                                        # through the streaming ingest
+                                        # subsystem (ingest/): two-pass
+                                        # chunked readers (CSV/TSV,
+                                        # LibSVM, .npy/.npz), seeded
+                                        # reservoir bin-sampling over the
+                                        # WHOLE stream, chunk-at-a-time
+                                        # binning — the raw [N,F] f64
+                                        # matrix is never materialized.
+                                        # Bit-identical to the in-RAM
+                                        # path given the same sample
+                                        # (differential-test pinned)
+    tpu_ingest_chunk_rows: int = 65536  # rows per streamed chunk for the
+                                        # array/.npy/.npz/LibSVM readers
+                                        # — the peak-raw-memory knob
+                                        # (text files chunk by bytes via
+                                        # the mmap windows).  Chunk size
+                                        # never changes the constructed
+                                        # dataset (test-pinned)
+                                        # (LGBM_TPU_INGEST_CHUNK_ROWS env)
+    tpu_ingest_memmap: str = ""         # back the binned matrix with an
+                                        # np.memmap file instead of host
+                                        # RAM: a directory (per-shard
+                                        # X_bin.shardN.npy inside) or a
+                                        # file path.  "" keeps the
+                                        # matrix in RAM
+                                        # (LGBM_TPU_INGEST_MEMMAP env)
+    tpu_ingest_shards: int = 0          # row-shard plan: how many
+                                        # contiguous shards the stream
+                                        # splits into (query-aligned for
+                                        # ranking data), each worker
+                                        # binning ONLY its own rows.
+                                        # 0/1 = no sharding
+    tpu_ingest_shard_id: int = -1       # which shard THIS process bins;
+                                        # -1 = the recorded process rank
+                                        # (parallel/distributed.py)
+    tpu_ingest_sample_seed: int = -1    # reservoir sampling seed for
+                                        # streamed bin finding; -1 =
+                                        # inherit data_random_seed (so
+                                        # flipping tpu_ingest keeps the
+                                        # sample schedule stable)
+
     # ---- derived (not user-settable) ----
     is_parallel: bool = dataclasses.field(default=False, repr=False)
 
@@ -871,6 +914,14 @@ class Config:
             log.fatal("task=online needs a refresh cadence: set "
                       "tpu_online_refit_every (rows) and/or "
                       "tpu_online_refit_every_s (seconds)")
+        if self.tpu_ingest_chunk_rows < 1:
+            log.fatal("tpu_ingest_chunk_rows should be >= 1")
+        if self.tpu_ingest_shards < 0:
+            log.fatal("tpu_ingest_shards should be >= 0")
+        if (self.tpu_ingest_shards > 1
+                and self.tpu_ingest_shard_id >= self.tpu_ingest_shards):
+            log.fatal("tpu_ingest_shard_id should be < tpu_ingest_shards "
+                      "(or -1 for the process rank)")
 
     # ------------------------------------------------------------------
     def num_model_per_iteration(self) -> int:

@@ -1,0 +1,43 @@
+"""Out-of-core streaming ingestion (``ingest/``).
+
+Every other path into training materializes the raw [N, F] f64 matrix
+in host RAM before binning; this subsystem never does.  Two passes over
+a re-iterable chunk source: a seeded reservoir sample for bin finding
+(uniform over the WHOLE stream, merged over the host collectives in
+pre-sharded multi-host mode so every rank derives bit-identical
+``BinMapper``s), then chunk-at-a-time binning into a preallocated —
+optionally ``np.memmap``-backed — bin matrix.  Row-shard plans
+(query-aligned for ranking) let each data-parallel worker bin only its
+own rows.  Peak memory: O(chunk + sample + bin matrix).
+
+API::
+
+    from lightgbm_tpu import ingest
+    src = ingest.ArraySource(big_memmap, label=y, chunk_rows=65536)
+    ds = ingest.dataset_from_stream(src, params)      # a lightgbm_tpu.Dataset
+    bst = lightgbm_tpu.train(params, ds, ...)
+
+CLI: ``task=train tpu_ingest=true`` routes file loading through the
+chunked readers (CSV/TSV, LibSVM, ``.npy``/``.npz``); ``two_round=true``
+LibSVM input streams through here unconditionally.  See README
+"Out-of-core ingestion".
+"""
+from .readers import (ArraySource, LibSVMSource, NpzSource,
+                      SyntheticSource, TextSource, open_source)
+from .sample import ReservoirSampler, merge_shard_samples, sample_seed
+from .shard import (RowShardPlan, local_query_sizes, plan_row_shards,
+                    resolve_shard)
+from .stream import (IngestError, chunk_rows_from_config, dataset_digest,
+                     dataset_from_stream, ingest_dataset, ingest_file,
+                     memmap_from_config)
+
+__all__ = [
+    "ArraySource", "LibSVMSource", "NpzSource", "SyntheticSource",
+    "TextSource", "open_source",
+    "ReservoirSampler", "merge_shard_samples", "sample_seed",
+    "RowShardPlan", "local_query_sizes", "plan_row_shards",
+    "resolve_shard",
+    "IngestError", "chunk_rows_from_config", "dataset_digest",
+    "dataset_from_stream", "ingest_dataset", "ingest_file",
+    "memmap_from_config",
+]

@@ -1,0 +1,196 @@
+"""Single-pass reservoir sampling for out-of-core bin finding.
+
+The reference's ``DatasetLoader`` samples ``bin_construct_sample_cnt``
+rows BEFORE it ever materializes the dataset (dataset_loader.cpp:574
+ConstructFromSampleData; the two_round text path re-reads the file), and
+its sample is drawn from the WHOLE file — ``SampleFromFile`` walks every
+line.  A streaming ingestion path must preserve that property: taking
+the first ``sample_cnt`` rows of the stream would bias the bin bounds
+toward the head (a time-ordered log whose distribution drifts would get
+bins that cannot resolve the tail — the regression
+``tests/test_ingest_stream.py::test_reservoir_sample_covers_shifted_tail``
+pins this).  :class:`ReservoirSampler` is Algorithm R, vectorized per
+chunk, over either dense chunks or scipy-sparse row blocks — the same
+per-row replacement probabilities as the sequential algorithm (numpy
+fancy assignment keeps the LAST write per slot, matching sequential
+order), deterministic under ``seed`` and INDEPENDENT of how the stream
+is chunked (the bounded-integer draws consume the bit stream row by
+row; ``test_chunking_invariance`` pins it).
+
+Distributed bin finding: when every rank streams only ITS OWN row shard
+(pre-partitioned data), each rank feeds its local reservoir and then
+calls ``BinnedDataset.from_sample(local_sample, local_rows)`` — the
+pooling inside ``from_sample`` (``parallel/distributed.py
+global_bin_sample``: an allgather in rank order over the host
+collectives) makes every rank derive bit-identical ``BinMapper``s, the
+TPU analog of the reference's sample-sync between ``DatasetLoader`` and
+``Network``.  :func:`merge_shard_samples` is the host-side pooling
+oracle the single-process tests pin that path against.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+
+class ReservoirSampler:
+    """Uniform ``sample_cnt``-row reservoir over a chunked row stream.
+
+    ``add`` one chunk at a time (2-D ndarray or scipy-sparse rows; all
+    chunks must be one or the other).  ``finish`` returns the sampled
+    rows (dense [m, F] f64, or a scipy CSR when the stream was sparse)
+    plus the sampled rows' GLOBAL stream indices in slot order — the
+    differential tests feed those indices to the in-RAM oracle
+    (``BinnedDataset.from_matrix(sample_indices=...)``) so streamed and
+    in-RAM construction see the exact same sample.
+    """
+
+    def __init__(self, sample_cnt: int, seed: int = 1):
+        self.k = int(sample_cnt)
+        if self.k < 1:
+            raise ValueError("sample_cnt must be >= 1")
+        self._rng = np.random.default_rng(int(seed))
+        self.n = 0                       # stream rows seen so far
+        self._dense: Optional[np.ndarray] = None     # [k, F] f64
+        self._sparse_parts: List[Tuple[np.ndarray, object]] = []
+        self._sparse_cols = 0
+        self._sparse = None              # None until the first chunk
+        self.indices = np.full(self.k, -1, dtype=np.int64)
+
+    # ------------------------------------------------------------------
+    def _slots_for(self, m: int) -> Tuple[np.ndarray, np.ndarray]:
+        """(slots, local_rows) hit by this chunk's ``m`` rows: the fill
+        phase takes rows verbatim; past the fill, row with global index
+        ``g`` replaces a uniform slot in [0, g] and survives only when
+        that slot is < k (Algorithm R).  Draw order is strictly by
+        global row index, so chunk boundaries cannot change the
+        schedule."""
+        filled = min(self.n, self.k)
+        take = min(self.k - filled, m) if filled < self.k else 0
+        slots = [np.arange(filled, filled + take, dtype=np.int64)]
+        local = [np.arange(take, dtype=np.int64)]
+        if take < m:
+            gi = np.arange(self.n + take, self.n + m, dtype=np.int64)
+            draws = self._rng.integers(0, gi + 1)
+            hit = draws < self.k
+            slots.append(draws[hit])
+            local.append(np.arange(take, m, dtype=np.int64)[hit])
+        return np.concatenate(slots), np.concatenate(local)
+
+    def add(self, chunk) -> None:
+        sparse = hasattr(chunk, "tocsr")
+        if self._sparse is None:
+            self._sparse = sparse
+        elif self._sparse != sparse:
+            raise ValueError("reservoir stream mixed dense and sparse "
+                             "chunks")
+        m = int(chunk.shape[0])
+        if m == 0:
+            return
+        slots, local = self._slots_for(m)
+        if len(slots):
+            if sparse:
+                block = chunk.tocsr()[local]
+                self._sparse_parts.append((slots, block))
+                self._sparse_cols = max(self._sparse_cols,
+                                        int(chunk.shape[1]))
+                self._maybe_compact()
+            else:
+                arr = np.asarray(chunk, dtype=np.float64)
+                if self._dense is None:
+                    self._dense = np.empty((self.k, arr.shape[1]),
+                                           np.float64)
+                elif arr.shape[1] != self._dense.shape[1]:
+                    raise ValueError(
+                        f"chunk has {arr.shape[1]} columns, stream "
+                        f"started with {self._dense.shape[1]}")
+                self._dense[slots] = arr[local]
+            self.indices[slots] = self.n + local
+        self.n += m
+
+    # ------------------------------------------------------------------
+    def _live_sparse(self):
+        """slot -> (part index, row in part) for the LAST write per slot
+        (later parts — and later rows within a part — win, matching the
+        sequential reservoir)."""
+        live = {}
+        for pi, (slots, _) in enumerate(self._sparse_parts):
+            for r, s in enumerate(slots):
+                live[int(s)] = (pi, r)
+        return live
+
+    def _maybe_compact(self) -> None:
+        """Replacement blocks accumulate until ``finish``; past ~4x the
+        reservoir size, rewrite them down to the live rows so memory
+        stays O(sample) on arbitrarily long streams."""
+        stored = sum(p[1].shape[0] for p in self._sparse_parts)
+        if stored <= max(4 * self.k, self.k + 64):
+            return
+        sample = self._assemble_sparse()
+        live_slots = np.asarray(sorted(self._live_sparse()), np.int64)
+        self._sparse_parts = [(live_slots, sample)]
+
+    def _assemble_sparse(self):
+        import scipy.sparse as sp
+
+        live = self._live_sparse()
+        order = sorted(live.items())              # by slot
+        if not order:
+            return sp.csr_matrix((0, self._sparse_cols))
+        pos_parts, row_parts = [], []
+        by_part = {}
+        for pos, (_, (pi, r)) in enumerate(order):
+            by_part.setdefault(pi, []).append((pos, r))
+        for pi, lst in by_part.items():
+            rows = [r for _, r in lst]
+            blk = self._sparse_parts[pi][1][rows]
+            blk = sp.csr_matrix((blk.data, blk.indices, blk.indptr),
+                                shape=(blk.shape[0], self._sparse_cols))
+            row_parts.append(blk)
+            pos_parts.append(np.asarray([p for p, _ in lst], np.int64))
+        stacked = sp.vstack(row_parts, format="csr")
+        return stacked[np.argsort(np.concatenate(pos_parts),
+                                  kind="stable")]
+
+    def finish(self) -> Tuple[object, np.ndarray]:
+        """``(sample_rows, global_indices)`` in slot order.  With fewer
+        stream rows than ``sample_cnt`` the sample is every row (the
+        fill phase never completed)."""
+        m = min(self.n, self.k)
+        if self._sparse:
+            sample = self._assemble_sparse()[:m]
+        elif self._dense is not None:
+            sample = self._dense[:m]
+        else:
+            sample = np.empty((0, 0), np.float64)
+        return sample, self.indices[:m].copy()
+
+
+def merge_shard_samples(samples, shard_rows) -> Tuple[np.ndarray, int]:
+    """Host-side pooling oracle for pre-sharded distributed bin finding:
+    the rank-ordered concatenation (and summed global row count) that
+    ``parallel/distributed.py global_bin_sample`` produces over the real
+    collectives.  The single-process shard-agreement tests build every
+    shard's reservoir locally, pool with this, and assert the mappers
+    match what each rank of a real 2-process run derives
+    (``tests/dist_worker.py``)."""
+    mats = list(samples)
+    if not mats:
+        return np.empty((0, 0), np.float64), 0
+    if hasattr(mats[0], "tocsr"):
+        import scipy.sparse as sp
+        pooled = sp.vstack([m.tocsr() for m in mats], format="csc")
+    else:
+        pooled = np.concatenate([np.asarray(m, np.float64) for m in mats])
+    return pooled, int(sum(int(r) for r in shard_rows))
+
+
+def sample_seed(config) -> int:
+    """The reservoir seed: ``tpu_ingest_sample_seed`` when set (>= 0),
+    else ``data_random_seed`` — the same knob the in-RAM sampler uses,
+    so flipping ``tpu_ingest`` keeps the sampling seed stable."""
+    s = int(getattr(config, "tpu_ingest_sample_seed", -1))
+    if s >= 0:
+        return s
+    return int(getattr(config, "data_random_seed", 1))

@@ -1,0 +1,105 @@
+"""Row-shard plans for pod-scale ingestion.
+
+Data-parallel training at dataset sizes past host RAM needs every
+worker to bin ONLY its own contiguous row range — never the full
+matrix.  A :class:`RowShardPlan` is the static geometry both ingestion
+passes agree on: contiguous ``[cuts[d], cuts[d+1])`` row ranges per
+shard, near-equal by rows, and — for ranking data — snapped to QUERY
+boundaries by reusing ``parallel/rank_shard.plan_query_shards``'s
+greedy balanced cuts (the reference keeps query boundaries in
+``Metadata`` for exactly this: its data-parallel learner never splits a
+query across workers).  A shard's local ``BinnedDataset`` then feeds
+``parallel/mesh.py``'s row-sharded growers directly: the mesh sees
+``num_data_local`` rows whose histograms psum to the global ones.
+
+Shard identity resolves like the rest of the distributed plumbing:
+explicit config (``tpu_ingest_shards`` / ``tpu_ingest_shard_id``) wins,
+else the process rank recorded by ``parallel/distributed.py``.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..utils import log
+
+
+@dataclass
+class RowShardPlan:
+    """Contiguous row ranges per shard; ``query_cuts`` set when the
+    cuts were snapped to query boundaries."""
+    num_shards: int
+    n_rows: int
+    cuts: np.ndarray                       # int64 [num_shards + 1]
+    query_cuts: Optional[np.ndarray] = field(default=None)
+
+    def shard_range(self, shard_id: int) -> Tuple[int, int]:
+        return int(self.cuts[shard_id]), int(self.cuts[shard_id + 1])
+
+    def local_rows(self, shard_id: int) -> int:
+        lo, hi = self.shard_range(shard_id)
+        return hi - lo
+
+    @property
+    def query_aligned(self) -> bool:
+        return self.query_cuts is not None
+
+
+def plan_row_shards(n_rows: int, num_shards: int,
+                    query_boundaries=None) -> RowShardPlan:
+    """Near-equal contiguous row cuts over ``num_shards``.  With
+    ``query_boundaries`` (int [Q+1], ascending, last == n_rows) every
+    cut lands ON a query boundary — the greedy balanced partition of
+    ``parallel/rank_shard.plan_query_shards`` — so per-query work
+    (lambdarank pair passes, NDCG eval) stays shard-local."""
+    D = max(int(num_shards), 1)
+    n = int(n_rows)
+    if query_boundaries is None:
+        cuts = (np.arange(D + 1, dtype=np.int64) * n) // D
+        return RowShardPlan(D, n, cuts)
+    from ..parallel.rank_shard import plan_query_shards
+    b = np.asarray(query_boundaries, dtype=np.int64)
+    log.check(int(b[-1]) == n,
+              "query boundaries do not cover the row stream "
+              f"({int(b[-1])} != {n})")
+    qp = plan_query_shards(b, D)
+    cuts = np.asarray(qp.row_cuts, dtype=np.int64)
+    if (np.diff(cuts) == 0).any():
+        log.warning("row-shard plan: %d of %d shards got zero rows "
+                    "(fewer queries than shards?)",
+                    int((np.diff(cuts) == 0).sum()), D)
+    return RowShardPlan(D, n, cuts,
+                        query_cuts=np.asarray(qp.query_cuts, np.int64))
+
+
+def local_query_sizes(plan: RowShardPlan, shard_id: int,
+                      query_boundaries) -> Optional[np.ndarray]:
+    """Per-query sizes of the queries living wholly inside ``shard_id``
+    (the plan guarantees no straddlers).  None when the plan was not
+    query-aligned."""
+    if plan.query_cuts is None:
+        return None
+    b = np.asarray(query_boundaries, dtype=np.int64)
+    q0, q1 = int(plan.query_cuts[shard_id]), int(plan.query_cuts[shard_id + 1])
+    return np.diff(b[q0:q1 + 1]).astype(np.int64)
+
+
+def resolve_shard(config) -> Tuple[int, int]:
+    """``(num_shards, shard_id)`` for this process: explicit
+    ``tpu_ingest_shards``/``tpu_ingest_shard_id`` win; an unset shard id
+    falls back to the recorded process rank (``parallel/mesh.NETWORK``,
+    fed by ``init_distributed``/``set_network``), and unset shards to 1
+    (no sharding)."""
+    D = int(getattr(config, "tpu_ingest_shards", 0) or 0)
+    if D <= 1:
+        return 1, 0
+    sid = int(getattr(config, "tpu_ingest_shard_id", -1))
+    if sid < 0:
+        from ..parallel.mesh import NETWORK
+        sid = int(NETWORK.get("rank") or 0)
+    log.check(0 <= sid < D,
+              f"tpu_ingest_shard_id {sid} out of range for "
+              f"{D} shards")
+    return D, sid

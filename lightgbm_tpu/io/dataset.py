@@ -407,9 +407,11 @@ class BinnedDataset:
                 X[indices[lo:hi][nz], gp] = (off + fb[nz]).astype(dtype)
         self.X_bin = X
 
-    def _alloc_X(self) -> None:
-        """Allocate the binned matrix for ``num_data`` rows (filled by
-        ``_binarize_chunk`` — whole-matrix or streaming two_round)."""
+    def _bin_matrix_spec(self):
+        """``(columns, dtype)`` of the physical bin matrix — the single
+        source of the width/dtype ladder, shared by the in-RAM
+        ``_alloc_X`` and the streaming ingestion path's memmap
+        allocation (ingest/stream.py)."""
         if self.bundle is not None:
             widest = int(max(self.bundle.phys_num_bin.max(initial=0),
                              self.feature_max_bins().max(initial=0)))
@@ -427,6 +429,12 @@ class BinnedDataset:
                 "A feature has %d bins (> 256, from a high-cardinality "
                 "categorical); the whole binned matrix is widened to %s",
                 widest, np.dtype(dtype).name)
+        return cols, dtype
+
+    def _alloc_X(self) -> None:
+        """Allocate the binned matrix for ``num_data`` rows (filled by
+        ``_binarize_chunk`` — whole-matrix or streaming two_round)."""
+        cols, dtype = self._bin_matrix_spec()
         self.X_bin = np.empty((self.num_data, cols), dtype=dtype)
 
     def _binarize(self, data: np.ndarray) -> None:

@@ -335,13 +335,35 @@ def load_text_two_round(path: str, config, categorical_features=(),
     with open(path) as fh:
         first = fh.readline()
     if ":" in first and not getattr(config, "header", False):
-        log.warning("two_round is not supported for LibSVM input; "
-                    "loading in one round (stays sparse)")
-        X, label, weight, group, names = _load_libsvm(path, config)
-        handle = BinnedDataset.from_csr(
-            X, config, categorical_features=categorical_features,
-            feature_names=names, reference=reference)
-        return handle, label, weight, group, names
+        # LibSVM streams through the chunked ingest reader: reservoir
+        # bin-sampling over the whole stream, chunk-at-a-time binning —
+        # the full sparse matrix (and its dense sample slice) is never
+        # materialized, and the constructed dataset bit-matches the
+        # in-RAM from_csr path (tests/test_ingest_stream.py)
+        from ..ingest.readers import LibSVMSource
+        from ..ingest.stream import chunk_rows_from_config, ingest_dataset
+        log.info("two_round: streaming LibSVM input through the "
+                 "chunked ingest reader")
+        src = LibSVMSource(path,
+                           chunk_rows=chunk_rows_from_config(config))
+        # two_round keeps the pre-ingest contract: the WHOLE file, in
+        # RAM — the tpu_ingest_shards/tpu_ingest_memmap knobs (and the
+        # memmap env var) only govern the explicit tpu_ingest path, so
+        # an ambient ingest config can't silently halve this dataset
+        # or write X_bin files from an unrelated job's location
+        handle = ingest_dataset(
+            src, config, categorical_features=categorical_features,
+            reference=reference, num_shards=1, shard_id=0,
+            memmap_path="")
+        md = handle.metadata
+        group_sizes = (np.diff(md.query_boundaries)
+                       if md.query_boundaries is not None else None)
+        weight, group = _load_sidecars(path, md.weights, group_sizes)
+        if weight is not None and md.weights is None:
+            handle.metadata.set_weights(weight)
+        if group is not None and group_sizes is None:
+            handle.metadata.set_query(group)
+        return handle, md.label, weight, group, list(handle.feature_names)
     delim = _sniff_delimiter(first.rstrip("\n"))
     names: List[str] = []
     skip = 0

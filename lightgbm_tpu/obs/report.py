@@ -185,6 +185,9 @@ def summarize(events: List[dict]) -> dict:
     online = online_summary(events)
     if online:
         out["online"] = online
+    ing = ingest_summary(events)
+    if ing:
+        out["ingest"] = ing
     return out
 
 
@@ -491,6 +494,32 @@ def online_summary(events: List[dict]) -> dict:
     return out
 
 
+def ingest_summary(events: List[dict]) -> dict:
+    """Fold the streaming-ingestion events (``ingest_chunk`` per
+    streamed chunk, ``ingest_summary`` per constructed dataset —
+    ingest/stream.py) into one digest section: rows/chunks/throughput
+    of the LAST ingestion plus totals across the run.  Empty when
+    nothing streamed."""
+    chunks = [e for e in events if e.get("event") == "ingest_chunk"]
+    sums = [e for e in events if e.get("event") == "ingest_summary"]
+    if not (chunks or sums):
+        return {}
+    out = {
+        "ingestions": len(sums),
+        "chunk_events": len(chunks),
+        "rows_total": sum(int(e.get("rows", 0) or 0) for e in sums),
+    }
+    if sums:
+        last = sums[-1]
+        out["last"] = {k: last.get(k) for k in
+                       ("rows", "local_rows", "chunks", "sample_rows",
+                        "shards", "shard_id", "memmap", "wall_s",
+                        "rows_per_s", "source", "digest")
+                       if last.get(k) is not None}
+        out["rows_per_s"] = last.get("rows_per_s")
+    return out
+
+
 def trace_summary(events: List[dict]) -> dict:
     """Fold ``span`` events (obs/spans.py) into the trace digest:
     span/trace counts and per-name call/duration aggregates.  Empty when
@@ -741,6 +770,28 @@ EVENT_SCHEMAS = {
                                    # fired but no fresh rows arrived
         "error": (str, False),
     },
+    # streaming ingestion (ingest/stream.py)
+    "ingest_chunk": {
+        "pass": (int, True),       # 1 = count/sample, 2 = binarize
+        "chunk": (int, True),
+        "rows": (int, True),
+        "stream_row0": (int, True),
+    },
+    "ingest_summary": {
+        "rows": (int, True),       # whole-stream rows
+        "local_rows": (int, True),  # this shard's binned rows
+        "chunks": (int, True),
+        "sample_rows": (int, True),
+        "shards": (int, True),
+        "shard_id": (int, True),
+        "memmap": (bool, True),
+        "wall_s": (_NUM, True),
+        "rows_per_s": (_NUM, True),
+        "source": (str, True),
+        "digest": (str, False),    # dataset content digest (recorded
+                                   # when telemetry/flight is armed —
+                                   # crash-resume re-streams must match)
+    },
 }
 
 
@@ -955,6 +1006,23 @@ def render(digest: dict) -> str:
         if o.get("skipped_by_reason"):
             out.append("  skipped: " + ", ".join(
                 f"{k}={v}" for k, v in o["skipped_by_reason"].items()))
+    if digest.get("ingest"):
+        g = digest["ingest"]
+        out.append("")
+        last = g.get("last") or {}
+        line = (f"ingest: {g.get('ingestions', 0)} ingestion(s), "
+                f"{g.get('rows_total', 0):,} row(s) streamed")
+        if last.get("rows_per_s"):
+            line += f" — last at {last['rows_per_s']:,.0f} rows/s"
+        if last.get("shards", 1) and last.get("shards", 1) > 1:
+            line += (f", shard {last.get('shard_id')}/"
+                     f"{last.get('shards')} "
+                     f"({last.get('local_rows'):,} local rows)")
+        if last.get("memmap"):
+            line += ", memmap-backed"
+        out.append(line)
+        if last.get("digest"):
+            out.append(f"  dataset digest {last['digest']}")
     if digest.get("trace"):
         t = digest["trace"]
         out.append("")

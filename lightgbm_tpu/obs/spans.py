@@ -79,6 +79,11 @@ _FLIGHT_EVENTS = frozenset((
     # skipped refresh is the first thing a stale-model post-mortem
     # needs beside the swap/canary records it produced
     "online_refresh", "refit",
+    # streaming ingestion (ingest/stream.py): the per-dataset summary —
+    # rows, shard, digest — is what a crash-mid-ingest or corrupt-chunk
+    # post-mortem needs first (per-chunk records stay telemetry-only:
+    # a 10^8-row stream would flush the whole ring with them)
+    "ingest_summary",
 ))
 
 

@@ -70,6 +70,13 @@ _DIGEST_SKIP = frozenset((
     # bit-identical knob (tests/test_rank_device.py pins the sharded
     # pair pass against the single-device oracle across mesh sizes)
     "tpu_rank_sharded_grad",
+    # streamed ingestion is bit-identical to the in-RAM load given the
+    # same sample (tests/test_ingest_stream.py), and chunk size / memmap
+    # backing never change the constructed dataset — so flipping them
+    # must not refuse a resume.  (tpu_ingest_sample_seed and the shard
+    # knobs are deliberately NOT here: they change the sample / the
+    # local rows, hence the trees.)
+    "tpu_ingest", "tpu_ingest_chunk_rows", "tpu_ingest_memmap",
 ))
 
 

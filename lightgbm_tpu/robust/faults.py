@@ -13,6 +13,7 @@ CPU-only container.  The spec grammar (env var ``LGBM_TPU_FAULTS`` or
                  | serve_replica | serve_replica_N | serve_swap
                  | serve_canary | checkpoint_write
                  | online_ingest | online_refit | online_swap
+                 | ingest_chunk
                  (free-form: any check() name)
     action    := raise | transient | sleep=SECONDS | hang
     cond      := iter=N     fire only during boosting iteration N
@@ -46,8 +47,12 @@ the model registry's swap/canary path (serve/registry.py:
 online learning loop (online/loop.py: ``online_ingest`` per ingest
 batch, ``online_refit`` at the top of a refresh, ``online_swap``
 before the registry push — ``tools/fault_matrix.py`` proves a refit
-fault leaves the old version serving).  When no plan is configured
-every :func:`check` call is one ``None`` test.
+fault leaves the old version serving), and the streaming ingestion
+subsystem (ingest/stream.py: ``ingest_chunk`` guards every chunk
+fetch of both passes — a transient read fault retries with backoff, a
+fatal one aborts loudly, a ``sleep`` stall is stamped when
+``tpu_wedge_timeout_s`` is set).  When no plan is configured every
+:func:`check` call is one ``None`` test.
 """
 from __future__ import annotations
 

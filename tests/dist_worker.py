@@ -89,6 +89,30 @@ result["sparse_bounds_fp"] = [
     round(float(np.asarray(m.bin_upper_bound)[:-1].sum()), 9)
     for m in h_sp.bin_mappers]
 
+# ---- 2b. pre-sharded streaming ingestion (ingest/, ISSUE 14) ---------
+# each rank streams ONLY its contiguous half of the rows through the
+# two-pass ingest; the reservoir sample pools over the REAL collectives
+# inside from_sample, so both ranks must derive bit-identical mappers —
+# and binning only local rows, the halves must concatenate to the
+# single-host oracle.  Fingerprinted for the parent to cross-check.
+import hashlib  # noqa: E402
+
+from lightgbm_tpu.config import Config as _ICfg  # noqa: E402
+from lightgbm_tpu.ingest import ArraySource, ingest_dataset  # noqa: E402
+
+icfg = _ICfg.from_params({"verbose": -1, "max_bin": 31})
+half = X[:256] if rank == 0 else X[256:]
+half_y = y[:256] if rank == 0 else y[256:]
+ing = ingest_dataset(ArraySource(half, label=half_y, chunk_rows=100),
+                     icfg)
+assert ing.num_data == 256, ing.num_data
+result["ingest_bin_offsets"] = np.asarray(ing.bin_offsets).tolist()
+result["ingest_bounds_fp"] = [
+    round(float(np.nansum(np.asarray(m.bin_upper_bound)[:-1])), 9)
+    for m in ing.bin_mappers]
+result["ingest_xbin_sha"] = hashlib.sha256(
+    np.ascontiguousarray(ing.X_bin).tobytes()).hexdigest()
+
 # ---- 3. data-parallel boosting over the 2-process mesh ---------------
 import jax.numpy as jnp  # noqa: E402
 from jax.experimental import multihost_utils  # noqa: E402

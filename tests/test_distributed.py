@@ -178,6 +178,29 @@ def test_two_process_data_parallel_bitmatch(tmp_path):
     fp = [round(float(np.asarray(m.bin_upper_bound)[:-1].sum()), 9)
           for m in oracle.bin_mappers]
     assert res[0]["sparse_bounds_fp"] == fp
+    # pre-sharded streaming ingestion (ingest/, ISSUE 14): both ranks —
+    # each streaming ONLY its contiguous half — derived IDENTICAL bin
+    # mappers via the real-collective sample pooling...
+    assert res[0]["ingest_bin_offsets"] == res[1]["ingest_bin_offsets"]
+    assert res[0]["ingest_bounds_fp"] == res[1]["ingest_bounds_fp"]
+    # ...matching the single-host oracle built from the full matrix,
+    # and their locally-binned halves concatenate to the oracle's
+    # bin matrix bit-exactly
+    import hashlib
+    from lightgbm_tpu.ingest import ArraySource, ingest_dataset
+    icfg = Config.from_params({"verbose": -1, "max_bin": 31})
+    ing_oracle = ingest_dataset(
+        ArraySource(X, label=(X[:, 0] + X[:, 1] * X[:, 2] > 0)
+                    .astype(np.float64), chunk_rows=100), icfg)
+    assert res[0]["ingest_bin_offsets"] == np.asarray(
+        ing_oracle.bin_offsets).tolist()
+    fp = [round(float(np.nansum(np.asarray(m.bin_upper_bound)[:-1])), 9)
+          for m in ing_oracle.bin_mappers]
+    assert res[0]["ingest_bounds_fp"] == fp
+    assert res[0]["ingest_xbin_sha"] == hashlib.sha256(
+        np.ascontiguousarray(ing_oracle.X_bin[:256]).tobytes()).hexdigest()
+    assert res[1]["ingest_xbin_sha"] == hashlib.sha256(
+        np.ascontiguousarray(ing_oracle.X_bin[256:]).tobytes()).hexdigest()
     # both ranks saw identical data-parallel trees (replicated outputs)
     assert res[0]["dp_trees"] == res[1]["dp_trees"]
     # the cross-process psum'd training matches the serial oracle:

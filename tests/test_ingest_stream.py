@@ -1,0 +1,658 @@
+"""Streaming ingestion (ingest/): differential bit-identity against the
+in-RAM loaders, bounded memory, shard plans, sampling, faults, resume.
+
+The subsystem's correctness contract is DIFFERENTIAL: given the same
+reservoir sample, a streamed construction must produce bit-identical
+bin matrices, ``BinMapper``s and metadata — and a bit-identical trained
+model — vs the ``from_matrix``/``from_csr`` oracle, across dense/NaN/
+categorical/bundled/ranking fixtures, in one shard or many.  The
+reference's two-pass loader has the same property by construction
+(dataset_loader.cpp:807-827); here it is test-pinned.
+"""
+import os
+import tracemalloc
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.ingest import (ArraySource, IngestError, NpzSource,
+                                 ReservoirSampler, SyntheticSource,
+                                 dataset_digest, dataset_from_stream,
+                                 ingest_dataset, merge_shard_samples,
+                                 plan_row_shards)
+from lightgbm_tpu.io.dataset import BinnedDataset
+
+
+def assert_mappers_equal(a_list, b_list):
+    """Field-wise mapper equality; NaN bounds compare equal (the dict
+    ``==`` would fail on the trailing NaN bin bound)."""
+    assert len(a_list) == len(b_list)
+    for a, b in zip(a_list, b_list):
+        da, db = a.to_dict(), b.to_dict()
+        assert set(da) == set(db)
+        for k in da:
+            if k == "bin_upper_bound":
+                np.testing.assert_array_equal(np.asarray(da[k]),
+                                              np.asarray(db[k]))
+            else:
+                assert da[k] == db[k], (k, da[k], db[k])
+
+
+def assert_datasets_equal(ds, oracle):
+    assert ds.num_data == oracle.num_data
+    np.testing.assert_array_equal(ds.X_bin, oracle.X_bin)
+    np.testing.assert_array_equal(ds.bin_offsets, oracle.bin_offsets)
+    np.testing.assert_array_equal(ds.used_feature_map,
+                                  oracle.used_feature_map)
+    np.testing.assert_array_equal(ds.real_feature_idx,
+                                  oracle.real_feature_idx)
+    assert_mappers_equal(ds.bin_mappers, oracle.bin_mappers)
+    assert (ds.bundle is None) == (oracle.bundle is None)
+    if ds.bundle is not None:
+        assert ds.bundle.groups == oracle.bundle.groups
+        np.testing.assert_array_equal(ds.bundle.feat_offset,
+                                      oracle.bundle.feat_offset)
+
+
+def _problem(n=2500, f=6, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    X[rng.random(n) < 0.06, 0] = np.nan          # missing
+    X[:, 3] = rng.integers(0, 9, n)              # categorical candidate
+    y = (np.nan_to_num(X[:, 0]) + 0.5 * X[:, 1] > 0).astype(np.float64)
+    return X, y
+
+
+# ---------------------------------------------------------------------------
+# bit-identity vs the in-RAM oracle
+# ---------------------------------------------------------------------------
+
+def test_stream_matches_from_matrix_dense_nan_categorical():
+    """Full-coverage sample: streamed == from_matrix exactly, including
+    NaN missing bins, a categorical feature and the metadata."""
+    X, y = _problem()
+    w = np.linspace(0.5, 2.0, len(y))
+    cfg = Config.from_params({"verbose": -1, "max_bin": 63})
+    ds = ingest_dataset(ArraySource(X, label=y, weight=w, chunk_rows=257),
+                        cfg, categorical_features=[3])
+    oracle = BinnedDataset.from_matrix(X, cfg, categorical_features=[3])
+    assert_datasets_equal(ds, oracle)
+    np.testing.assert_array_equal(ds.metadata.label, y.astype(np.float32))
+    np.testing.assert_array_equal(ds.metadata.weights,
+                                  w.astype(np.float32))
+
+
+def test_stream_subsample_matches_oracle_given_same_sample():
+    """Reservoir-subsampled stream == from_matrix fed the reservoir's
+    own indices: the sample is the ONLY degree of freedom."""
+    X, y = _problem()
+    cfg = Config.from_params({"verbose": -1, "max_bin": 31,
+                              "bin_construct_sample_cnt": 400})
+    s = ReservoirSampler(400, seed=cfg.data_random_seed)
+    for lo in range(0, len(X), 257):
+        s.add(X[lo:lo + 257])
+    _, idx = s.finish()
+    ds = ingest_dataset(ArraySource(X, label=y, chunk_rows=257), cfg,
+                        categorical_features=[3])
+    oracle = BinnedDataset.from_matrix(X, cfg, categorical_features=[3],
+                                       sample_indices=idx)
+    assert_datasets_equal(ds, oracle)
+
+
+def test_stream_chunk_size_never_changes_the_dataset():
+    """tpu_ingest_chunk_rows is a memory knob, not a result knob: any
+    chunking yields the identical dataset AND the identical sample
+    (the reservoir draws by global row index, so it is in the
+    checkpoint digest SKIP list)."""
+    X, y = _problem(n=1700)
+    cfg = Config.from_params({"verbose": -1, "max_bin": 31,
+                              "bin_construct_sample_cnt": 300})
+    builds = [ingest_dataset(ArraySource(X, label=y, chunk_rows=c), cfg)
+              for c in (64, 999, 1700)]
+    for b in builds[1:]:
+        np.testing.assert_array_equal(builds[0].X_bin, b.X_bin)
+        assert_mappers_equal(builds[0].bin_mappers, b.bin_mappers)
+    assert dataset_digest(builds[0]) == dataset_digest(builds[1])
+
+
+def test_stream_bundled_matches_oracle():
+    """EFB fixture: sparse-exclusive columns bundle identically on the
+    streamed and in-RAM paths (groups, offsets, encoded columns)."""
+    rng = np.random.default_rng(3)
+    n, f = 2000, 12
+    X = np.zeros((n, f))
+    X[:, 0] = rng.normal(size=n)                 # dense
+    block = n // (f + 2)
+    for j in range(1, f):                        # strictly exclusive
+        rows = np.arange((j - 1) * block, j * block)
+        X[rows, j] = rng.normal(size=len(rows)) + j + 2.0
+    y = (X[:, 0] > 0).astype(np.float64)
+    cfg = Config.from_params({"verbose": -1, "max_bin": 63})
+    oracle = BinnedDataset.from_matrix(X, cfg)
+    assert oracle.bundle is not None, "fixture failed to trigger EFB"
+    ds = ingest_dataset(ArraySource(X, label=y, chunk_rows=333), cfg)
+    assert_datasets_equal(ds, oracle)
+
+
+def test_stream_trained_model_bit_identical():
+    """The model trained from a streamed dataset == the model trained
+    from the in-RAM dataset, byte for byte."""
+    X, y = _problem(n=1200)
+    P = {"objective": "binary", "num_leaves": 7, "min_data_in_leaf": 5,
+         "verbose": -1, "max_bin": 63}
+    ds_s = dataset_from_stream(ArraySource(X, label=y, chunk_rows=311), P,
+                               categorical_features=[3])
+    b1 = lgb.train(P, ds_s, num_boost_round=5, verbose_eval=False)
+    b2 = lgb.train(P, lgb.Dataset(X, label=y, params=P,
+                                  categorical_feature=[3]),
+                   num_boost_round=5, verbose_eval=False)
+    m1 = b1.model_to_string(num_iteration=-1).split("\nparameters:")[0]
+    m2 = b2.model_to_string(num_iteration=-1).split("\nparameters:")[0]
+    assert m1 == m2
+
+
+def test_stream_reference_alignment_valid_set():
+    """A streamed validation set binned against a reference reuses its
+    mappers exactly (the create_valid analog)."""
+    X, y = _problem()
+    Xv, yv = _problem(n=700, seed=9)
+    cfg = Config.from_params({"verbose": -1, "max_bin": 63})
+    train = ingest_dataset(ArraySource(X, label=y, chunk_rows=400), cfg)
+    valid = ingest_dataset(ArraySource(Xv, label=yv, chunk_rows=123), cfg,
+                           reference=train)
+    assert valid.bin_mappers is train.bin_mappers
+    np.testing.assert_array_equal(valid.X_bin, train.create_valid(Xv).X_bin)
+
+
+# ---------------------------------------------------------------------------
+# sampling: uniform over the whole stream (the head-bias regression)
+# ---------------------------------------------------------------------------
+
+def test_reservoir_sample_covers_shifted_tail():
+    """REGRESSION (ISSUE 14 satellite): sampling must draw uniformly
+    from all N rows, not the first ``bin_construct_sample_cnt`` rows of
+    the stream.  A distribution-shifted tail (last 10% of rows moved by
+    +8) must (a) appear in the sample at ~its stream share and (b) get
+    bin bounds placed over it — a head-only sample would fail both."""
+    n, k = 30000, 600
+    src = SyntheticSource(n, n_features=4, chunk_rows=1024, seed=5,
+                          tail_shift=8.0)
+    cfg = Config.from_params({"verbose": -1, "max_bin": 63,
+                              "bin_construct_sample_cnt": k})
+    s = ReservoirSampler(k, seed=cfg.data_random_seed)
+    for Xc, _ in src:
+        s.add(Xc)
+    sample, idx = s.finish()
+    assert len(idx) == k
+    # (a) uniform coverage: the tail's sample share tracks its 10%
+    # stream share (binomial 3-sigma ~ 0.037), and the sample is not
+    # the stream head
+    frac_tail = float((idx >= int(0.9 * n)).mean())
+    assert 0.04 < frac_tail < 0.18, frac_tail
+    assert idx.max() > 0.95 * n
+    assert idx.min() < 0.05 * n
+    # (b) the mappers resolve the shifted mass: finite bounds beyond
+    # the base distribution's reach (|N(0,1)| rarely exceeds ~4.5)
+    ds = ingest_dataset(SyntheticSource(n, n_features=4, chunk_rows=1024,
+                                        seed=5, tail_shift=8.0), cfg)
+    ub = np.asarray(ds.bin_mappers[0].bin_upper_bound)
+    assert float(ub[np.isfinite(ub)].max()) > 4.5
+    # and the head-only counterexample really would fail (a): the first
+    # k rows never reach the tail
+    assert (np.arange(k) >= int(0.9 * n)).mean() == 0.0
+
+
+def test_reservoir_matches_oracle_on_short_stream():
+    """Streams shorter than the reservoir keep every row in order."""
+    X = np.arange(50, dtype=np.float64).reshape(25, 2)
+    s = ReservoirSampler(100, seed=0)
+    for lo in range(0, 25, 7):
+        s.add(X[lo:lo + 7])
+    sample, idx = s.finish()
+    np.testing.assert_array_equal(sample, X)
+    np.testing.assert_array_equal(idx, np.arange(25))
+
+
+def test_merge_shard_samples_is_rank_ordered_concat():
+    a = np.full((3, 2), 1.0)
+    b = np.full((2, 2), 2.0)
+    pooled, total = merge_shard_samples([a, b], [300, 200])
+    np.testing.assert_array_equal(pooled, np.concatenate([a, b]))
+    assert total == 500
+
+
+# ---------------------------------------------------------------------------
+# shard plans
+# ---------------------------------------------------------------------------
+
+def test_two_shard_ingest_concatenates_to_oracle():
+    """Shared-stream sharding: every shard derives the SAME mappers and
+    bins only its own rows; stacking the shards reproduces the in-RAM
+    oracle bit-exactly (metadata included)."""
+    X, y = _problem(n=2100)
+    cfg = Config.from_params({"verbose": -1, "max_bin": 63})
+    oracle = BinnedDataset.from_matrix(X, cfg, categorical_features=[3])
+    parts = []
+    for sid in range(2):
+        d = ingest_dataset(ArraySource(X, label=y, chunk_rows=400), cfg,
+                           categorical_features=[3], num_shards=2,
+                           shard_id=sid)
+        assert_mappers_equal(d.bin_mappers, oracle.bin_mappers)
+        assert d.num_data < oracle.num_data
+        parts.append(d)
+    np.testing.assert_array_equal(
+        np.vstack([p.X_bin for p in parts]), oracle.X_bin)
+    np.testing.assert_array_equal(
+        np.concatenate([p.metadata.label for p in parts]),
+        y.astype(np.float32))
+
+
+def test_presharded_ingest_with_merged_samples_matches_shared():
+    """Pre-partitioned mode oracle: each 'rank' streams ONLY its rows
+    and samples locally; pooling the local samples in rank order (what
+    ``global_bin_sample`` does over the real collectives) must give the
+    mappers ``from_sample`` derives from the pooled sample directly —
+    i.e. both ranks bin identically.  The real-collective twin lives in
+    tests/dist_worker.py."""
+    X, y = _problem(n=1600)
+    cfg = Config.from_params({"verbose": -1, "max_bin": 31,
+                              "bin_construct_sample_cnt": 200})
+    halves = [(X[:800], y[:800]), (X[800:], y[800:])]
+    locals_, counts = [], []
+    for Xh, _ in halves:
+        s = ReservoirSampler(200, seed=cfg.data_random_seed)
+        for lo in range(0, len(Xh), 199):
+            s.add(Xh[lo:lo + 199])
+        sample, _ = s.finish()
+        locals_.append(sample)
+        counts.append(len(Xh))
+    pooled, total = merge_shard_samples(locals_, counts)
+    assert total == len(X)
+    ref = BinnedDataset.from_sample(pooled, total, cfg)
+    # every rank bins its local rows through the pooled-sample mappers
+    ref._alloc_X()
+    ref._binarize_chunk(X, 0)
+    parts = []
+    for (Xh, yh) in halves:
+        d = ingest_dataset(ArraySource(Xh, label=yh, chunk_rows=199),
+                           cfg, reference=ref)
+        parts.append(d)
+    np.testing.assert_array_equal(
+        np.vstack([p.X_bin for p in parts]), ref.X_bin)
+
+
+def _ranking_problem(nq=60, seed=2):
+    rng = np.random.default_rng(seed)
+    sizes = rng.integers(4, 20, nq)
+    n = int(sizes.sum())
+    X = rng.normal(size=(n, 5))
+    y = rng.integers(0, 3, n).astype(np.float64)
+    qid = np.repeat(np.arange(nq), sizes)
+    return X, y, sizes, qid, n
+
+
+def test_query_aligned_shards_never_straddle():
+    X, y, sizes, qid, n = _ranking_problem()
+    boundaries = np.concatenate([[0], np.cumsum(sizes)])
+    plan = plan_row_shards(n, 3, boundaries)
+    assert plan.query_aligned
+    assert int(plan.cuts[0]) == 0 and int(plan.cuts[-1]) == n
+    for d in range(3):
+        lo, hi = plan.shard_range(d)
+        # every cut IS a query boundary
+        assert lo in boundaries and hi in boundaries
+        # queries in [lo, hi) are whole
+        inside = qid[lo:hi]
+        for q in np.unique(inside):
+            assert (qid == q).sum() == (inside == q).sum()
+
+
+def test_ranking_stream_shards_and_trains():
+    """Ranking fixture end to end: the streamed (unsharded) dataset
+    trains lambdarank bit-identically to the in-RAM path, and the
+    sharded locals carry query-aligned local query sizes."""
+    X, y, sizes, qid, n = _ranking_problem()
+    P = {"objective": "lambdarank", "num_leaves": 7,
+         "min_data_in_leaf": 5, "verbose": -1, "max_bin": 63}
+    cfg = Config.from_params(P)
+    src = ArraySource(X, label=y, group=sizes, chunk_rows=123)
+    ds = ingest_dataset(src, cfg)
+    np.testing.assert_array_equal(
+        ds.metadata.query_boundaries,
+        np.concatenate([[0], np.cumsum(sizes)]).astype(np.int32))
+    sds = dataset_from_stream(ArraySource(X, label=y, group=sizes,
+                                          chunk_rows=123), P)
+    b1 = lgb.train(P, sds, num_boost_round=4, verbose_eval=False)
+    b2 = lgb.train(P, lgb.Dataset(X, label=y, group=sizes, params=P),
+                   num_boost_round=4, verbose_eval=False)
+    assert (b1.model_to_string(num_iteration=-1).split("\nparameters:")[0]
+            == b2.model_to_string(
+                num_iteration=-1).split("\nparameters:")[0])
+    # sharded locals: query sizes partition cleanly
+    parts = [ingest_dataset(ArraySource(X, label=y, group=sizes,
+                                        chunk_rows=123), cfg,
+                            num_shards=2, shard_id=sid)
+             for sid in range(2)]
+    got_sizes = np.concatenate([np.diff(p.metadata.query_boundaries)
+                                for p in parts])
+    np.testing.assert_array_equal(got_sizes, sizes)
+    assert sum(p.num_data for p in parts) == n
+
+
+# ---------------------------------------------------------------------------
+# bounded memory + memmap + serialization (satellites)
+# ---------------------------------------------------------------------------
+
+def test_bounded_memory_never_materializes_raw_matrix():
+    """ACCEPTANCE: a stream >= 20x the chunk size ingests with peak
+    incremental host allocation O(chunk + sample + bin matrix) — far
+    below the raw [N, F] f64 bytes the in-RAM path would allocate."""
+    import gc
+    n, f, chunk = 200_000, 12, 4096
+    assert n >= 20 * chunk
+
+    class FeatureStream:
+        """SyntheticSource with the label column stripped: the proof
+        measures the FEATURE-matrix path (labels are an inherent O(N)
+        side array, carried and asserted by the differential tests)."""
+        group_sizes = None
+
+        def __iter__(self):
+            for Xc, _ in SyntheticSource(n, n_features=f,
+                                         chunk_rows=chunk, seed=1):
+                yield Xc, {}
+
+    cfg = Config.from_params({"verbose": -1, "max_bin": 63,
+                              "bin_construct_sample_cnt": 5000})
+    gc.collect()                          # a clean baseline under load
+    tracemalloc.start()
+    tracemalloc.reset_peak()
+    base = tracemalloc.get_traced_memory()[0]
+    ds = ingest_dataset(FeatureStream(), cfg)
+    peak = tracemalloc.get_traced_memory()[1] - base
+    tracemalloc.stop()
+    raw = n * f * 8                       # 19.2 MB
+    assert ds.num_data == n
+    bin_bytes = ds.X_bin.nbytes           # 2.4 MB (uint8)
+    # O(chunk + sample + bins) with slack for transposes/sort copies and
+    # suite-load allocator noise — an O(N * F * 8) path cannot fit this
+    budget = (bin_bytes + 8 * chunk * f * 8 + 4 * 5000 * f * 8
+              + (2 << 20))
+    assert peak < budget, (peak, budget)
+    assert peak < raw // 2, (peak, raw)
+
+
+def test_memmap_backed_ingest_save_load_roundtrip(tmp_path):
+    """SATELLITE: memmap-backed bin matrix — identical content to the
+    RAM path, and ``dataset_io.save_dataset``/``load_dataset`` round-
+    trips it (metadata included) with the digest preserved."""
+    from lightgbm_tpu.io.dataset_io import load_dataset, save_dataset
+    X, y = _problem(n=900)
+    w = np.linspace(1, 2, len(y))
+    cfg = Config.from_params({"verbose": -1, "max_bin": 63})
+    mm_path = str(tmp_path / "X_bin.npy")
+    ds = ingest_dataset(ArraySource(X, label=y, weight=w, chunk_rows=200),
+                        cfg, categorical_features=[3],
+                        memmap_path=mm_path)
+    assert isinstance(ds.X_bin, np.memmap)
+    assert os.path.exists(mm_path)
+    oracle = BinnedDataset.from_matrix(X, cfg, categorical_features=[3])
+    np.testing.assert_array_equal(np.asarray(ds.X_bin), oracle.X_bin)
+    out = str(tmp_path / "ds.npz")
+    save_dataset(ds, out)
+    back = load_dataset(out)
+    assert_datasets_equal(back, oracle)
+    np.testing.assert_array_equal(back.metadata.label,
+                                  y.astype(np.float32))
+    np.testing.assert_array_equal(back.metadata.weights,
+                                  w.astype(np.float32))
+    assert dataset_digest(back) == dataset_digest(ds)
+    # memmap dir form: per-shard file dropped inside
+    d2 = ingest_dataset(ArraySource(X, label=y, chunk_rows=200), cfg,
+                        memmap_path=str(tmp_path))
+    assert isinstance(d2.X_bin, np.memmap)
+    assert (tmp_path / "X_bin.shard0.npy").exists()
+    # REGRESSION (review): a second ingest with the same memmap target
+    # must NOT truncate the first dataset's live backing file — it
+    # walks to a fresh name and the first dataset's bins stay intact
+    d2_bins = np.asarray(d2.X_bin).copy()
+    d3 = ingest_dataset(ArraySource(X, label=y, chunk_rows=200), cfg,
+                        memmap_path=str(tmp_path))
+    assert d3.X_bin.filename != d2.X_bin.filename
+    np.testing.assert_array_equal(np.asarray(d2.X_bin), d2_bins)
+
+
+def test_crash_mid_ingest_resume_bit_exact(tmp_path):
+    """SATELLITE: crash-mid-train on an INGESTED dataset composes with
+    robust/checkpoint.py — the restart re-streams the source (the
+    digest proves determinism) and resumes to the bit-identical model;
+    flipping tpu_ingest knobs between runs must not refuse the resume
+    (they sit in the config-digest skip list)."""
+    from lightgbm_tpu.robust import DeviceWedgedError, faults
+    X, y = _problem(n=900)
+    P = {"objective": "binary", "num_leaves": 7, "min_data_in_leaf": 5,
+         "verbose": -1, "max_bin": 63, "bagging_fraction": 0.8,
+         "bagging_freq": 2}
+
+    def make_ds(chunk):
+        return dataset_from_stream(
+            ArraySource(X, label=y, chunk_rows=chunk),
+            dict(P, tpu_ingest_chunk_rows=chunk))
+
+    # re-streaming is deterministic: same digest both times
+    d1 = ingest_dataset(ArraySource(X, label=y, chunk_rows=200),
+                        Config.from_params(P))
+    d2 = ingest_dataset(ArraySource(X, label=y, chunk_rows=200),
+                        Config.from_params(P))
+    assert dataset_digest(d1) == dataset_digest(d2)
+
+    ref = lgb.train(P, make_ds(200), num_boost_round=6,
+                    verbose_eval=False).model_to_string(
+        num_iteration=-1).split("\nparameters:")[0]
+    ck = str(tmp_path / "ckpt")
+    crash_p = dict(P, tpu_on_device_error="abort", tpu_checkpoint_dir=ck,
+                   tpu_checkpoint_freq=2)
+    faults.configure("device_execute:raise@iter=4")
+    with pytest.raises(DeviceWedgedError):
+        lgb.train(crash_p, make_ds(200), num_boost_round=6,
+                  verbose_eval=False)
+    faults.disarm()
+    # restart re-streams with a DIFFERENT chunk size (bit-identical
+    # dataset, digest-skip knob) and resumes to the reference model
+    resumed = lgb.train(dict(crash_p, tpu_ingest_chunk_rows=333),
+                        make_ds(333), num_boost_round=6,
+                        verbose_eval=False).model_to_string(
+        num_iteration=-1).split("\nparameters:")[0]
+    assert resumed == ref
+
+
+# ---------------------------------------------------------------------------
+# readers + CLI + config surface
+# ---------------------------------------------------------------------------
+
+def test_npy_source_streams_with_sidecars(tmp_path):
+    X, y = _problem(n=600)
+    p = str(tmp_path / "data.npy")
+    np.save(p, X)
+    np.save(str(tmp_path / "data.y.npy"), y)
+    cfg = Config.from_params({"verbose": -1, "max_bin": 31,
+                              "tpu_ingest_chunk_rows": 128})
+    src = NpzSource(p, chunk_rows=128)
+    ds = ingest_dataset(src, cfg)
+    oracle = BinnedDataset.from_matrix(X, cfg)
+    np.testing.assert_array_equal(ds.X_bin, oracle.X_bin)
+    np.testing.assert_array_equal(ds.metadata.label, y.astype(np.float32))
+
+
+def test_libsvm_two_round_streams_bit_identical(tmp_path):
+    """SATELLITE: two_round=true LibSVM no longer falls back to the
+    in-RAM load — it streams through the chunked reader and bit-matches
+    the from_csr oracle (qids -> query boundaries included)."""
+    from lightgbm_tpu.io.text_loader import (_load_libsvm,
+                                             load_text_two_round)
+    rng = np.random.default_rng(4)
+    p = str(tmp_path / "rank.svm")
+    with open(p, "w") as fh:
+        for q in range(30):
+            for _ in range(int(rng.integers(4, 12))):
+                rel = int(rng.integers(0, 3))
+                feats = " ".join(
+                    f"{j}:{rng.normal() + rel:.3f}" for j in
+                    sorted(rng.choice(25, size=8, replace=False)))
+                fh.write(f"{rel} qid:{q} {feats}\n")
+    cfg = Config.from_params({"verbose": -1, "max_bin": 63,
+                              "two_round": True})
+    h, label, weight, group, names = load_text_two_round(p, cfg)
+    Xo, lo, _, go, _ = _load_libsvm(p, cfg)
+    oracle = BinnedDataset.from_csr(Xo, cfg)
+    assert_datasets_equal(h, oracle)
+    np.testing.assert_array_equal(label, lo)
+    np.testing.assert_array_equal(group, go)
+    # python-fallback parser streams to the same dataset
+    import lightgbm_tpu.native as _native
+    old_lib, old_tried = _native._lib, _native._tried
+    _native._lib, _native._tried = None, True
+    try:
+        h2, label2, _, group2, _ = load_text_two_round(p, cfg)
+    finally:
+        _native._lib, _native._tried = old_lib, old_tried
+    np.testing.assert_array_equal(h2.X_bin, h.X_bin)
+    np.testing.assert_array_equal(label2, label)
+    np.testing.assert_array_equal(group2, group)
+
+
+def test_cli_tpu_ingest_trains_identical_model(tmp_path):
+    """CLI wiring: task=train tpu_ingest=true == the default in-RAM
+    load (sample covers all rows -> identical mappers -> identical
+    model up to the echoed parameter block)."""
+    from lightgbm_tpu.app import main
+    X, y = _problem(n=700)
+    p = str(tmp_path / "train.csv")
+    with open(p, "w") as fh:
+        for yi, row in zip(y, X):
+            fh.write(",".join(
+                "nan" if np.isnan(v) else repr(float(v))
+                for v in np.concatenate([[yi], row])) + "\n")
+    outs = []
+    for i, extra in enumerate(["tpu_ingest=false", "tpu_ingest=true"]):
+        out = str(tmp_path / f"m{i}.txt")
+        main(["task=train", f"data={p}", "objective=binary",
+              "num_trees=6", "num_leaves=7", "verbose=-1",
+              f"output_model={out}", extra])
+        outs.append(open(out).read())
+    strip = [[l for l in o.splitlines()
+              if not l.startswith("[") and l != "end of parameters"]
+             for o in outs]
+    assert strip[0] == strip[1]
+
+
+def test_sharded_ingest_file_slices_sidecars(tmp_path):
+    """REGRESSION (review): whole-stream .weight/.query sidecars must
+    slice to the LOCAL shard (not crash the metadata length checks),
+    and a .query sidecar must be read BEFORE the shard plan so the
+    cuts query-align on it."""
+    from lightgbm_tpu.ingest import ingest_file
+    rng = np.random.default_rng(6)
+    sizes = rng.integers(4, 16, 40)
+    n = int(sizes.sum())
+    X = rng.normal(size=(n, 4))
+    y = rng.integers(0, 3, n).astype(np.float64)
+    w = np.linspace(0.5, 2.0, n)
+    p = str(tmp_path / "rank.csv")
+    with open(p, "w") as fh:
+        for yi, row in zip(y, X):
+            fh.write(",".join(repr(float(v)) for v in [yi, *row]) + "\n")
+    np.savetxt(p + ".weight", w)
+    np.savetxt(p + ".query", sizes, fmt="%d")
+    parts = []
+    for sid in range(2):
+        cfg_s = Config.from_params({"verbose": -1, "max_bin": 31,
+                                    "tpu_ingest_shards": 2,
+                                    "tpu_ingest_shard_id": sid})
+        h, label, weight, group, _ = ingest_file(p, cfg_s)
+        lo, hi = h.ingest_row_range
+        assert h.num_data == hi - lo < n
+        np.testing.assert_array_equal(weight, w[lo:hi].astype(np.float32))
+        # every shard cut landed on a query boundary of the SIDECAR
+        boundaries = np.concatenate([[0], np.cumsum(sizes)])
+        assert lo in boundaries and hi in boundaries
+        parts.append((h, label, group))
+    got_sizes = np.concatenate([g for _, _, g in parts])
+    np.testing.assert_array_equal(got_sizes, sizes)
+    np.testing.assert_array_equal(
+        np.concatenate([l for _, l, _ in parts]), y.astype(np.float32))
+
+
+def test_ingest_config_validation():
+    with pytest.raises(lgb.LightGBMError, match="chunk_rows"):
+        Config.from_params({"tpu_ingest_chunk_rows": 0, "verbose": -1})
+    with pytest.raises(lgb.LightGBMError, match="shard_id"):
+        Config.from_params({"tpu_ingest_shards": 2,
+                            "tpu_ingest_shard_id": 5, "verbose": -1})
+    cfg = Config.from_params({"tpu_ingest": True, "verbose": -1})
+    assert cfg.tpu_ingest and cfg.tpu_ingest_chunk_rows == 65536
+
+
+def test_ingest_events_validate_and_digest(tmp_path):
+    """Telemetry: ingest_chunk/ingest_summary events pass the schema
+    validator, the digest grows an ingest section, and the flight ring
+    keeps the summary (with the dataset digest stamped)."""
+    from lightgbm_tpu import obs
+    from lightgbm_tpu.obs.report import (load_events, render, summarize,
+                                         validate_events)
+    X, y = _problem(n=500)
+    cfg = Config.from_params({"verbose": -1, "max_bin": 31})
+    obs.enable_flight(64)
+    obs.enable(str(tmp_path / "telem"))
+    try:
+        ingest_dataset(ArraySource(X, label=y, chunk_rows=100), cfg)
+        summ = [e for e in obs.flight_snapshot()
+                if e.get("event") == "ingest_summary"]
+        assert summ and summ[-1].get("digest")
+        obs.disable()
+        events = load_events(str(tmp_path / "telem"))
+        assert not validate_events(events)
+        ing = [e for e in events if e.get("event") == "ingest_chunk"]
+        assert len(ing) == 10          # 5 chunks x 2 passes
+        assert {e["pass"] for e in ing} == {1, 2}
+        digest = summarize(events)
+        assert digest["ingest"]["rows_total"] == 500
+        assert "ingest:" in render(digest)
+    finally:
+        obs.disable()
+        obs.reset()   # drop the accumulated phase timers + flight ring:
+                      # process-wide state must not leak into later
+                      # off-path tests (test_obs asserts a clean slate)
+
+
+def test_empty_and_inconsistent_streams_abort():
+    cfg = Config.from_params({"verbose": -1})
+
+    class Empty:
+        group_sizes = None
+
+        def __iter__(self):
+            return iter(())
+
+    with pytest.raises(IngestError, match="no rows"):
+        ingest_dataset(Empty(), cfg)
+
+    X, y = _problem(n=300)
+
+    class ShrinkingSource:
+        """Pass 2 sees fewer rows than pass 1 — 'file changed'."""
+        group_sizes = None
+
+        def __init__(self):
+            self.calls = 0
+
+        def __iter__(self):
+            self.calls += 1
+            stop = 300 if self.calls == 1 else 200
+            for lo in range(0, stop, 100):
+                yield X[lo:lo + 100], {"label": y[lo:lo + 100]}
+
+    with pytest.raises(IngestError, match="changed between passes"):
+        ingest_dataset(ShrinkingSource(), cfg)

@@ -602,8 +602,16 @@ def test_tpu_window_checklist_stubbed(tmp_path):
                                       "explain_p99_ms": 48.0},
                              "occupancy": 0.7, "compiles": 8,
                              "degraded": False})
+    ingest_line = json.dumps({"kind": "ingest", "backend": "cpu",
+                              "rows": 60000, "features": 8,
+                              "chunk_rows": 2048, "memmap": False,
+                              "ingest_rows_per_s": 250000.0,
+                              "ingest_wall_s": 0.24,
+                              "checks": {"bounded_memory": True},
+                              "ok": True})
     fake = _FakeRun({
         "bench_serve.py": (0, serve_line + "\n"),
+        "ingest_bench.py": (0, ingest_line + "\n"),
         "bench.py": (0, "noise\n" + bench_line + "\n"),
         "prof_kernels.py": (0, json.dumps({"tool": "prof_kernels",
                                            "legs": {}}) + "\n"),
@@ -619,12 +627,16 @@ def test_tpu_window_checklist_stubbed(tmp_path):
                                 "bench_maxbin63", "bench_unfused",
                                 "bench_quant", "bench_nofusedgrad",
                                 "bench_rank", "prof_kernels",
-                                "bench_serve", "bench_explain", "trace"}
+                                "bench_serve", "bench_explain",
+                                "bench_ingest", "trace"}
     assert all(leg["rc"] == 0 for leg in rec["legs"].values())
     # bench legs ran seven times (clean, profile, maxbin63, unfused,
-    # quant, nofusedgrad, rank)
-    bench_calls = [c for c in fake.calls if any("bench.py" in a
-                                                for a in c)]
+    # quant, nofusedgrad, rank) — endswith, so tools/ingest_bench.py's
+    # leg is not miscounted as a bench.py invocation
+    bench_calls = [c for c in fake.calls
+                   if any(isinstance(a, str)
+                          and a.endswith(os.sep + "bench.py")
+                          for a in c)]
     assert len(bench_calls) == 7
     # the rank leg's parsed line landed as BENCH_rank_manual_rN.json
     # and bench_history's BENCH_r* glob picks it up as its own context
@@ -647,6 +659,12 @@ def test_tpu_window_checklist_stubbed(tmp_path):
     assert (tmp_path / "SERVE_explain_manual_r07.json").exists()
     xrows = bh.collect([str(tmp_path / "SERVE_explain_manual_r07.json")])
     assert xrows[0]["metrics"]["serve_explain_p99_ms"] == 48.0
+    # the ingest leg (--no-write) landed as the window-owned
+    # INGEST_manual_rN.json and trends under its own ingest context
+    assert (tmp_path / "INGEST_manual_r07.json").exists()
+    irows = bh.collect([str(tmp_path / "INGEST_manual_r07.json")])
+    assert irows[0]["context"][0] == "ingest"
+    assert irows[0]["metrics"]["ingest_rows_per_s"] == 250000.0
 
 
 def test_tpu_window_dry_run_end_to_end(tmp_path):

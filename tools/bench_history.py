@@ -11,10 +11,11 @@ the wrong way".  This tool does:
 
 ``path`` entries are bench-round JSON files, serving-round files
 (``SERVE_r*.json`` from ``tools/bench_serve.py``), online-loop rounds
-(``ONLINE_r*.json`` from ``tools/online_smoke.py``), telemetry digest
-JSON files (``telemetry_report.py --json`` output), or directories to
-glob for ``BENCH_r*.json`` + ``SERVE_r*.json`` + ``ONLINE_r*.json``
-(default: the repo root).
+(``ONLINE_r*.json`` from ``tools/online_smoke.py``), streaming-ingest
+rounds (``INGEST_r*.json`` from ``tools/ingest_bench.py``), telemetry
+digest JSON files (``telemetry_report.py --json`` output), or
+directories to glob for ``BENCH_r*.json`` + ``SERVE_r*.json`` +
+``ONLINE_r*.json`` + ``INGEST_r*.json`` (default: the repo root).
 Rounds whose bench produced no parseable line (``"parsed": null`` —
 e.g. round 1's empty tail) are listed but carry no metrics.  Serving
 rounds trend rows/s + p50/p99 + batch occupancy under their own
@@ -100,6 +101,13 @@ _DIRECTIONS = [
     # swap) and how many refreshed versions made it through the gate
     ("online_refresh_s", False),
     ("online_swap_ok", True),
+    # streaming-ingestion rounds (INGEST_r*.json, tools/ingest_bench.py):
+    # two-pass construction throughput and the traced peak of the
+    # bounded-memory proof (growth = the O(chunk + bins) contract
+    # eroding)
+    ("ingest_rows_per_s", True),
+    ("ingest_wall_s", False),
+    ("peak_traced_bytes", False),
 ]
 
 # a swap blip worse than this multiple of the steady p99 is flagged: the
@@ -154,6 +162,22 @@ def load_round(path: str) -> dict:
     if parsed is None:
         row["note"] = "no parsed bench line"
         row["context"] = None
+        return row
+    if parsed.get("kind") == "ingest":  # a tools/ingest_bench.py round
+        row["context"] = ("ingest", parsed.get("backend"),
+                          parsed.get("rows"), parsed.get("features"),
+                          parsed.get("chunk_rows"), parsed.get("memmap"))
+        for name in ("ingest_rows_per_s", "ingest_wall_s",
+                     "peak_traced_bytes", "rows"):
+            v = parsed.get(name)
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                row["metrics"][name] = float(v)
+        checks = parsed.get("checks") or {}
+        failed = [k for k, v in checks.items() if not v]
+        if failed:
+            row["note"] = ("ingest checks FAILED: " + ", ".join(failed)
+                           + " — excluded from baselines")
+            row["canary"] = "ingest-failed"
         return row
     if parsed.get("kind") == "online":  # a tools/online_smoke.py round
         row["context"] = ("online", parsed.get("backend"))
@@ -314,6 +338,7 @@ def collect(paths: List[str]) -> List[dict]:
             files.extend(sorted(glob.glob(os.path.join(p, "BENCH_r*.json"))))
             files.extend(sorted(glob.glob(os.path.join(p, "SERVE_r*.json"))))
             files.extend(sorted(glob.glob(os.path.join(p, "ONLINE_r*.json"))))
+            files.extend(sorted(glob.glob(os.path.join(p, "INGEST_r*.json"))))
         else:
             files.append(p)
     rows = []

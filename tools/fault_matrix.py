@@ -17,7 +17,10 @@ mode in one process and emits a per-check verdict map, exactly like
   periodic re-probe recovers it (health + metrics flip back);
 - a failed CHECKPOINT write is survived (training never dies for it)
   and the loader skips a corrupted checkpoint for the previous valid
-  one.
+  one;
+- a streamed-ingestion chunk fault (ISSUE 14, ``ingest_chunk``)
+  retries to a bit-identical dataset, a fatal/corrupt chunk aborts
+  loudly before anything bins, and a stalled chunk read is stamped.
 
     python tools/fault_matrix.py --json      # one JSON verdict line
 """
@@ -316,6 +319,59 @@ def main(argv=None) -> int:
         check("online.continue_resume_bit_exact", m == ref_cont)
     except Exception as exc:  # noqa: BLE001
         check("online.continue_resume_bit_exact", False, repr(exc))
+
+    # ---- streaming ingestion (ISSUE 14): chunk fault x recovery ----
+    # a transient chunk-read fault retries to a BIT-IDENTICAL dataset,
+    # a fatal one aborts loudly (never bins garbage), a corrupt chunk
+    # (column-count drift) aborts loudly, and a stalled read is stamped
+    from lightgbm_tpu.config import Config as _ICfg
+    from lightgbm_tpu.ingest import ArraySource, IngestError, ingest_dataset
+
+    icfg = _ICfg.from_params({"verbose": -1, "max_bin": 31})
+    clean_ing = ingest_dataset(ArraySource(X, label=y, chunk_rows=100),
+                               icfg)
+    faults.configure("ingest_chunk:transient@call=3")
+    try:
+        d2 = ingest_dataset(ArraySource(X, label=y, chunk_rows=100), icfg)
+        check("ingest.chunk_fault_retry_bit_identical",
+              np.array_equal(d2.X_bin, clean_ing.X_bin))
+    except Exception as exc:  # noqa: BLE001
+        check("ingest.chunk_fault_retry_bit_identical", False, repr(exc))
+    faults.disarm()
+
+    faults.configure("ingest_chunk:raise@call=2")
+    try:
+        ingest_dataset(ArraySource(X, label=y, chunk_rows=100), icfg)
+        check("ingest.fatal_chunk_aborts", False, "ingest completed")
+    except (DeviceWedgedError, IngestError):
+        check("ingest.fatal_chunk_aborts", True)
+    faults.disarm()
+
+    class _CorruptSource:  # column-count drift mid-stream
+        group_sizes = None
+
+        def __iter__(self):
+            yield X[:100], {"label": y[:100]}
+            yield X[100:200, :3], {"label": y[100:200]}
+
+    try:
+        ingest_dataset(_CorruptSource(), icfg)
+        check("ingest.corrupt_chunk_aborts", False, "ingest completed")
+    except IngestError:
+        check("ingest.corrupt_chunk_aborts", True)
+
+    faults.configure("ingest_chunk:sleep=0.25@call=2")
+    try:
+        ingest_dataset(ArraySource(X, label=y, chunk_rows=100),
+                       _ICfg.from_params({"verbose": -1, "max_bin": 31,
+                                          "tpu_wedge_timeout_s": 0.05}))
+        ing_stalls = [e for e in obs.flight_snapshot()
+                      if e.get("event") == "device_stall"
+                      and e.get("point") == "ingest_chunk"]
+        check("ingest.stall_stamped", len(ing_stalls) >= 1)
+    except Exception as exc:  # noqa: BLE001
+        check("ingest.stall_stamped", False, repr(exc))
+    faults.disarm()
 
     # ---- ingest stall: cadence fires, no fresh rows -> skipped -----
     sloop = OnlineLoop(base_path, config=ocfg, push=None, params=dict(P))

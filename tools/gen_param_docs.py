@@ -234,6 +234,19 @@ ENV_VARS = [
      "ingest window: how many of the freshest labeled rows the loop "
      "keeps for the next refresh (older rows fall out; memory-bounded "
      "like the serve queue)."),
+    ("LGBM_TPU_INGEST_CHUNK_ROWS",
+     "streaming-ingestion override for `tpu_ingest_chunk_rows` — rows "
+     "per streamed chunk for the array/`.npy`/`.npz`/LibSVM readers "
+     "(the peak-raw-memory knob of `ingest/`); lets an operator retune "
+     "a running pipeline's chunking without editing configs.  Chunk "
+     "size never changes the constructed dataset (test-pinned), so it "
+     "also sits in the checkpoint config-digest skip list."),
+    ("LGBM_TPU_INGEST_MEMMAP",
+     "streaming-ingestion override for `tpu_ingest_memmap` — back the "
+     "binned matrix with an `np.memmap` file instead of host RAM: a "
+     "directory (per-shard `X_bin.shardN.npy` inside) or a file path.  "
+     "With it set, peak host RAM during ingestion is O(chunk + "
+     "sample) even though the constructed dataset may be far larger."),
     ("LGBM_TPU_PREDICT_MIN_WORK",
      "CLI `task=predict` routing override: the rows x trees work "
      "threshold above which value predictions go through the serving "

@@ -1,0 +1,227 @@
+"""Synthetic-stream ingestion bench + CPU-smokeable correctness checks.
+
+Two legs, one process:
+
+- **throughput leg** — a :class:`~lightgbm_tpu.ingest.SyntheticSource`
+  sized by env (``INGEST_ROWS``, default 120k for the smoke; the
+  generator computes chunks on the fly so ``INGEST_ROWS=100000000``
+  streams a 10^8-row leg without ever holding the raw matrix), two-pass
+  ingested under ``tracemalloc``: the BOUNDED-MEMORY proof asserts the
+  peak incremental host allocation stays O(chunk + sample + bin matrix)
+  — strictly below half the raw [N, F] f64 bytes the in-RAM path would
+  materialize — while the stream is >= 20x the chunk size.  Wall time
+  becomes ``ingest_rows_per_s``, trended by ``tools/bench_history.py``
+  from the ``INGEST_r*.json`` artifact.
+- **correctness leg** — a small distribution-SHIFTED stream (the last
+  10% of rows displaced): streamed construction must bit-match the
+  in-RAM ``from_matrix`` oracle given the same reservoir sample, chunk
+  size must not change the result, and the sample must cover the
+  shifted tail (the head-bias regression, ingest/sample.py).
+
+    python tools/ingest_bench.py --json          # one JSON verdict line
+    INGEST_ROWS=100000000 INGEST_MEMMAP=1 python tools/ingest_bench.py
+
+``tools/run_suite.py`` runs this as the ``ingest`` tier;
+``tools/tpu_window.py`` captures it as the ``bench_ingest`` leg.
+
+Env knobs: ``INGEST_ROWS``, ``INGEST_FEATURES``, ``INGEST_CHUNK_ROWS``,
+``INGEST_SAMPLE`` (bin_construct_sample_cnt), ``INGEST_MAXBIN``,
+``INGEST_MEMMAP`` (=1 backs the bin matrix with a temp memmap file).
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+import tempfile
+import time
+import tracemalloc
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+CHECKS = {}
+
+# skip the O(N) differential / tracemalloc instrumentation past these
+# sizes — the big leg measures throughput, the small leg proves bits
+_DIFF_MAX_ROWS = 300_000
+_TRACE_MAX_ROWS = 10_000_000
+
+
+def check(name, ok, detail=""):
+    CHECKS[name] = bool(ok)
+    print(f"# {'ok ' if ok else 'FAIL'} {name}"
+          + (f" — {detail}" if detail and not ok else ""), flush=True)
+
+
+def _next_round(out_dir):
+    n = 0
+    for f in glob.glob(os.path.join(out_dir, "INGEST_r*.json")):
+        m = re.search(r"INGEST_r(\d+)\.json$", os.path.basename(f))
+        if m:
+            n = max(n, int(m.group(1)))
+    return n + 1
+
+
+def _env_int(name, default):
+    try:
+        return int(float(os.environ.get(name, "") or default))
+    except ValueError:
+        return default
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description="Streaming-ingest bench")
+    ap.add_argument("--json", action="store_true",
+                    help="print a machine-readable verdict line")
+    ap.add_argument("--out", default=REPO,
+                    help="INGEST_rN.json artifact dir (default: repo root)")
+    ap.add_argument("--no-write", action="store_true",
+                    help="skip writing the INGEST_rN.json artifact")
+    args = ap.parse_args(argv)
+
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.ingest import (ArraySource, SyntheticSource,
+                                     ingest_dataset, ReservoirSampler)
+    from lightgbm_tpu.io.dataset import BinnedDataset
+
+    t0 = time.time()
+    rows = _env_int("INGEST_ROWS", 120_000)
+    features = _env_int("INGEST_FEATURES", 12)
+    chunk_rows = _env_int("INGEST_CHUNK_ROWS", 4096)
+    sample_cnt = _env_int("INGEST_SAMPLE", 20_000)
+    max_bin = _env_int("INGEST_MAXBIN", 63)
+    use_memmap = os.environ.get("INGEST_MEMMAP", "") in ("1", "true")
+
+    P = {"verbose": -1, "max_bin": max_bin,
+         "bin_construct_sample_cnt": sample_cnt,
+         "tpu_ingest_chunk_rows": chunk_rows}
+    cfg = Config.from_params(P)
+    art = tempfile.mkdtemp(prefix="ingest_bench_")
+    memmap_path = os.path.join(art, "X_bin.npy") if use_memmap else None
+
+    # ---- throughput + bounded-memory leg ---------------------------
+    src = SyntheticSource(rows, n_features=features,
+                          chunk_rows=chunk_rows, seed=0)
+    raw_bytes = rows * features * 8
+    trace = rows <= _TRACE_MAX_ROWS
+    if trace:
+        tracemalloc.start()
+        tracemalloc.reset_peak()
+        base = tracemalloc.get_traced_memory()[0]
+    t1 = time.perf_counter()
+    ds = ingest_dataset(src, cfg, memmap_path=memmap_path)
+    ingest_s = time.perf_counter() - t1
+    peak = None
+    if trace:
+        peak = tracemalloc.get_traced_memory()[1] - base
+        tracemalloc.stop()
+    rows_per_s = rows / ingest_s if ingest_s > 0 else 0.0
+
+    check("rows_complete", ds.num_data == rows,
+          f"{ds.num_data} != {rows}")
+    check("stream_20x_chunk", rows >= 20 * chunk_rows,
+          f"{rows} rows / {chunk_rows} chunk")
+    if trace:
+        # O(chunk + sample + bins + the [N] label side array), never the
+        # raw [N, F] matrix: half the raw bytes is a hard ceiling with
+        # slack over the chunk transposes + sample copies + the label
+        # collect/concat/f32 lifecycle (3 x N x 8)
+        bin_bytes = 0 if use_memmap else (ds.X_bin.nbytes
+                                          if ds.X_bin is not None else 0)
+        budget = max(raw_bytes // 2,
+                     bin_bytes + 8 * chunk_rows * features * 8
+                     + 4 * sample_cnt * features * 8
+                     + 3 * rows * 8 + (2 << 20))
+        check("bounded_memory", peak < budget,
+              f"peak {peak:,} >= budget {budget:,} "
+              f"(raw would be {raw_bytes:,})")
+        check("raw_never_materialized", peak < raw_bytes,
+              f"peak {peak:,} vs raw {raw_bytes:,}")
+    check("throughput_recorded", rows_per_s > 0)
+
+    # ---- correctness leg (small, distribution-shifted tail) --------
+    diff_rows = min(rows, 40_000)
+    if rows > _DIFF_MAX_ROWS:
+        print(f"# differential leg runs at {diff_rows} rows "
+              f"(INGEST_ROWS={rows} exceeds the {_DIFF_MAX_ROWS} "
+              "differential cap — throughput leg stays unchecked for "
+              "bits, the small leg proves them)", flush=True)
+    dP = dict(P, bin_construct_sample_cnt=2000)
+    dcfg = Config.from_params(dP)
+    dsrc = SyntheticSource(diff_rows, n_features=features,
+                           chunk_rows=1024, seed=3, tail_shift=6.0)
+    dds = ingest_dataset(dsrc, dcfg)
+    # the oracle sees the SAME rows and the SAME sample
+    Xs, ys = [], []
+    for Xc, side in dsrc:
+        Xs.append(Xc)
+        ys.append(side["label"])
+    Xfull = np.concatenate(Xs)
+    samp = ReservoirSampler(2000, seed=dcfg.data_random_seed)
+    for Xc in Xs:
+        samp.add(Xc)
+    _, idx = samp.finish()
+    oracle = BinnedDataset.from_matrix(Xfull, dcfg, sample_indices=idx)
+    check("differential_bit_identical",
+          np.array_equal(dds.X_bin, oracle.X_bin)
+          and np.array_equal(dds.bin_offsets, oracle.bin_offsets))
+    # chunk size must not change the constructed dataset
+    dds2 = ingest_dataset(
+        SyntheticSource(diff_rows, n_features=features,
+                        chunk_rows=1024, seed=3, tail_shift=6.0),
+        Config.from_params(dict(dP, tpu_ingest_chunk_rows=333)))
+    check("chunk_size_invariant", np.array_equal(dds.X_bin, dds2.X_bin))
+    # head-bias regression: the sample must cover the shifted tail —
+    # a first-2000-rows sample could not place bounds past the shift
+    tail0 = int(0.9 * diff_rows)
+    frac_tail = float((idx >= tail0).mean())
+    m0 = dds.bin_mappers[0]
+    top = float(np.asarray(m0.bin_upper_bound)[
+        np.isfinite(np.asarray(m0.bin_upper_bound))].max())
+    check("sample_covers_tail",
+          0.02 < frac_tail < 0.25 and top > 3.0,
+          f"tail frac {frac_tail:.3f}, top bound {top:.2f}")
+
+    record = {
+        "kind": "ingest",
+        "t": round(time.time(), 1),
+        "wall_s": round(time.time() - t0, 1),
+        "backend": "cpu",
+        "rows": int(rows),
+        "features": int(features),
+        "chunk_rows": int(chunk_rows),
+        "sample_cnt": int(sample_cnt),
+        "memmap": bool(use_memmap),
+        "ingest_rows_per_s": round(rows_per_s, 1),
+        "ingest_wall_s": round(ingest_s, 3),
+        "peak_traced_bytes": int(peak) if peak is not None else None,
+        "raw_matrix_bytes": int(raw_bytes),
+        "checks": CHECKS,
+        "ok": all(CHECKS.values()),
+        "artifacts_dir": art,
+    }
+    if not args.no_write:
+        n = _next_round(args.out)
+        path = os.path.join(args.out, f"INGEST_r{n:02d}.json")
+        with open(path, "w") as fh:
+            json.dump(record, fh, indent=1)
+        print(f"# wrote {path}")
+    if args.json:
+        print(json.dumps(record))
+    else:
+        print(f"# {sum(CHECKS.values())}/{len(CHECKS)} checks passed "
+              f"({record['wall_s']}s, "
+              f"{record['ingest_rows_per_s']:,.0f} rows/s)")
+    return 0 if record["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -72,6 +72,15 @@ online loop: a refit fault leaves the old version serving, a crash
 mid-train-continue resumes bit-exactly, and an ingest stall skips the
 cadence with a logged + telemetry-stamped event.
 
+The ``ingest`` tier (ISSUE 14) runs ``tools/ingest_bench.py --json``:
+the streaming-ingestion smoke — a synthetic chunked stream two-pass
+ingested with the bounded-memory proof (tracemalloc peak strictly
+below the raw [N, F] f64 bytes the in-RAM path would materialize),
+streamed-vs-``from_matrix`` bit identity on the same reservoir
+sample, chunk-size invariance, and the distribution-shifted-tail
+sampling regression — so every suite round re-proves that out-of-core
+ingestion produces the exact same datasets the in-RAM loaders would.
+
 The ``online`` tier (ISSUE 12) runs ``tools/online_smoke.py --json``:
 the closed-loop end-to-end check — a drifting labeled stream drives
 the OnlineLoop to >= 2 refreshed versions through
@@ -173,6 +182,12 @@ _TOOL_TIERS = {
     # swap under live traffic, poisoned refit rejected — the closed loop
     # re-proved on CPU each suite round
     "online": ["online_smoke.py", "--json"],
+    # streaming ingestion (ISSUE 14): the synthetic-stream bench's
+    # verdict map — bounded-memory proof (peak << raw [N,F] bytes),
+    # streamed-vs-in-RAM bit identity, chunk-size invariance, and the
+    # shifted-tail sampling regression — re-proved on CPU each round;
+    # its INGEST_rN.json carries ingest_rows_per_s for bench_history
+    "ingest": ["ingest_bench.py", "--json"],
 }
 
 
@@ -227,12 +242,13 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="Run the quick/slow test tiers and write SUITE_rN.json")
     ap.add_argument("--tiers", default="quick,slow,serve,faults,chaos,"
-                                       "online",
+                                       "online,ingest",
                     help="comma list of tiers: pytest markers plus the "
                          "built-in 'serve' smoke, 'faults' matrix, "
-                         "'chaos' serving-chaos and 'online' closed-"
-                         "loop legs (default "
-                         "quick,slow,serve,faults,chaos,online)")
+                         "'chaos' serving-chaos, 'online' closed-loop "
+                         "and 'ingest' streaming-ingestion legs "
+                         "(default quick,slow,serve,faults,chaos,"
+                         "online,ingest)")
     ap.add_argument("--select", default="",
                     help="pytest collection target (file or node id) "
                          "instead of the whole tests/ dir")

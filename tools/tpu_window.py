@@ -49,7 +49,13 @@ capture checklist with health monitoring enabled:
    explanation-serving leg (ISSUE 9): half the open-loop Poisson
    arrivals are ``/explain`` TreeSHAP requests, so the window captures
    ``explain_p99`` under real mixed contention on the live backend,
-   written as ``SERVE_explain_manual_r{N}.json``.
+   written as ``SERVE_explain_manual_r{N}.json``;
+8. ``tools/ingest_bench.py --json`` — the streaming-ingestion leg
+   (ISSUE 14): synthetic-stream two-pass construction throughput
+   (``ingest_rows_per_s``) + the bounded-memory proof on the window's
+   host, written as ``INGEST_manual_r{N}.json`` (pass the file to
+   ``bench_history.py`` explicitly to fold it into the trend beside
+   the auto-globbed CI ``INGEST_r*`` rounds, like ``SERVE_manual``).
 
 Artifacts (``--out``, default repo root):
 
@@ -101,6 +107,11 @@ _DRY_SERVE_ENV = {
     "SERVE_MAX_BATCH": "128", "SERVE_CLIENTS": "2",
     "SERVE_DURATION_S": "1.5", "SERVE_RATE": "40",
 }
+# ingest_bench's built-in defaults ARE smoke-sized (120k rows, ~2s);
+# shrinking them further would starve the bounded-memory check of the
+# raw-matrix headroom it measures against, so the dry leg only pins
+# the backend
+_DRY_INGEST_ENV = {"JAX_PLATFORMS": "cpu"}
 
 _TRACE_CODE = """
 import sys
@@ -158,6 +169,7 @@ def checklist_legs(art_dir: str, dry_run: bool, py: str = sys.executable):
     bench = os.path.join(REPO, "bench.py")
     prof = os.path.join(REPO, "tools", "prof_kernels.py")
     serve = os.path.join(REPO, "tools", "bench_serve.py")
+    ingest = os.path.join(REPO, "tools", "ingest_bench.py")
     trace_dir = os.path.join(art_dir, "trace")
 
     def env_for(tag, extra=None, dry_env=None):
@@ -238,6 +250,14 @@ def checklist_legs(art_dir: str, dry_run: bool, py: str = sys.executable):
          # one stays a pure explain-mix measurement
          "env": env_for("bench_explain", {"SERVE_SWAP": "0"},
                         dry_env=_DRY_SERVE_ENV),
+         "parse_json": True},
+        # streaming-ingestion leg (ISSUE 14): the synthetic-stream
+        # two-pass bench — ingest_rows_per_s + the bounded-memory proof
+        # on whatever host backs this window; artifact written by the
+        # window itself (INGEST_manual_rN) so the repo root stays clean
+        {"name": "bench_ingest",
+         "argv": [py, ingest, "--json", "--no-write"],
+         "env": env_for("bench_ingest", dry_env=_DRY_INGEST_ENV),
          "parse_json": True},
         {"name": "trace",
          "argv": [py, "-c", _TRACE_CODE, trace_rows, trace_dir],
@@ -457,6 +477,18 @@ def run_checklist(out_dir: str, n: int, dry_run: bool,
             json.dump(serve_parsed, fh, indent=1)
         record["serve_path"] = serve_path
         print(f"# wrote {serve_path}")
+    ingest_parsed = (results.get("bench_ingest") or {}).get("parsed")
+    if ingest_parsed:
+        # the ingest leg runs --no-write; the window owns the artifact.
+        # Like SERVE_manual_rN it is NOT auto-globbed by bench_history's
+        # directory scan (that scan takes the CI INGEST_r* rounds) —
+        # pass the file explicitly to fold a window point into the table
+        ingest_parsed = dict(ingest_parsed, n=n, dry_run=dry_run)
+        ingest_path = os.path.join(out_dir, f"INGEST_manual_r{n:02d}.json")
+        with open(ingest_path, "w") as fh:
+            json.dump(ingest_parsed, fh, indent=1)
+        record["ingest_path"] = ingest_path
+        print(f"# wrote {ingest_path}")
     explain_parsed = (results.get("bench_explain") or {}).get("parsed")
     if explain_parsed:
         explain_parsed = dict(explain_parsed, n=n, dry_run=dry_run)
@@ -517,7 +549,7 @@ def main(argv=None) -> int:
                          "run (bench,bench_profile,bench_maxbin63,"
                          "bench_unfused,bench_quant,bench_nofusedgrad,"
                          "bench_rank,prof_kernels,bench_serve,"
-                         "bench_explain,trace); default all")
+                         "bench_explain,bench_ingest,trace); default all")
     ap.add_argument("--wedge-retries", type=int, default=1,
                     help="times a wedge-shaped leg failure (timeout / "
                          "transient runtime error) is retried with "
