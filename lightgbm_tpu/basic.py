@@ -545,6 +545,23 @@ class Booster:
                    start_iteration: int = 0) -> "Booster":
         with open(filename, "w") as fh:
             fh.write(self.model_to_string(num_iteration, start_iteration))
+        # quality-profile sidecar (obs/drift.py): boosters that still
+        # hold their training dataset persist the reference
+        # distribution beside the model so serving can arm drift
+        # monitoring; the model text format itself stays untouched
+        # (reference-compatible).  Never lets profiling fail a save.
+        cfg = getattr(self._gbdt, "config", None) if self._gbdt else None
+        if (self._gbdt is not None
+                and getattr(self._gbdt, "train_ds", None) is not None
+                and (cfg is None
+                     or getattr(cfg, "tpu_quality_profile", True))):
+            from .obs.drift import profile_path
+            try:
+                prof = self._gbdt.quality_profile()
+                if prof is not None:
+                    prof.save(profile_path(filename))
+            except Exception as exc:  # noqa: BLE001
+                log.warning("quality profile sidecar skipped: %s", exc)
         return self
 
     def model_to_string(self, num_iteration: Optional[int] = None,

@@ -1253,6 +1253,24 @@ class GBDT(PredictorBase):
         self._has_deferred = False
 
     # ------------------------------------------------------------------
+    def quality_profile(self):
+        """Reference distribution for the drift plane (obs/drift.py):
+        per-feature bin occupancy straight off the binned ``X_bin``
+        (streaming ingestion may have pre-accumulated it as
+        ``train_ds.quality_occupancy``), the training raw-score
+        histogram, and the train-AUC baseline.  None without a live
+        training dataset — a file-loaded model has no distribution to
+        profile."""
+        ds = self.train_ds
+        if ds is None or ds.X_bin is None:
+            return None
+        from ..obs.drift import QualityProfile
+        raw = (np.asarray(self._train_score, np.float64)
+               if self._train_score is not None else None)
+        return QualityProfile.from_training(ds, raw_score=raw,
+                                            label=ds.metadata.label)
+
+    # ------------------------------------------------------------------
     def add_valid(self, valid_ds, name: str) -> None:
         import jax.numpy as jnp
         ms = []

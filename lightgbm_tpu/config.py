@@ -739,6 +739,64 @@ class Config:
                                         # flipping tpu_ingest keeps the
                                         # sample schedule stable)
 
+    # ---- Drift & quality monitoring (obs/drift.py + serve/quality.py) ----
+    tpu_drift: bool = True              # arm serve-side drift monitoring
+                                        # when a .quality.json profile
+                                        # sits beside the loaded model
+                                        # file; off = the session takes
+                                        # one is-None branch and nothing
+                                        # more (LGBM_TPU_DRIFT env)
+    tpu_quality_profile: bool = True    # write the <model>.quality.json
+                                        # reference profile (per-feature
+                                        # bin occupancy + training
+                                        # prediction histogram + train
+                                        # AUC baseline) beside every
+                                        # saved model that still has its
+                                        # training dataset attached
+    tpu_drift_sample_rate: float = 0.05  # fraction of served rows whose
+                                        # raw features feed the drift
+                                        # sketch (deterministic batch-
+                                        # granularity sampling); the
+                                        # prediction histogram is taken
+                                        # on every response regardless
+                                        # (LGBM_TPU_DRIFT_SAMPLE_RATE
+                                        # env)
+    tpu_drift_check_s: float = 30.0     # cadence for scoring the live
+                                        # sketch against the reference
+                                        # profile (PSI + KS) and
+                                        # emitting drift_snapshot events
+                                        # (LGBM_TPU_DRIFT_CHECK_S env)
+    tpu_drift_min_rows: int = 200       # sketch rows required before a
+                                        # cadence firing scores at all —
+                                        # tiny samples make PSI scream
+                                        # (LGBM_TPU_DRIFT_MIN_ROWS env)
+    tpu_drift_psi_warn: float = 0.25    # PSI breach threshold (feature
+                                        # max or prediction histogram):
+                                        # above it the monitor dumps the
+                                        # flight recorder and latches a
+                                        # breach for the registry's
+                                        # post-swap watch
+                                        # (LGBM_TPU_DRIFT_PSI_WARN env)
+    tpu_quality_window: int = 512       # labeled rows per rolling
+                                        # quality window (windowed AUC /
+                                        # NDCG / calibration error from
+                                        # the online loop's label
+                                        # stream) (LGBM_TPU_QUALITY_WINDOW
+                                        # env)
+    tpu_quality_drop_warn: float = 0.05  # AUC drop below the profile's
+                                        # training baseline that counts
+                                        # as a quality breach
+                                        # (LGBM_TPU_QUALITY_DROP_WARN
+                                        # env)
+    tpu_serve_rollback_on_drift: bool = False  # opt-in: a drift/quality
+                                        # breach during the post-swap
+                                        # health watch triggers rollback
+                                        # like an error-rate burn;
+                                        # default only annotates the
+                                        # watch report
+                                        # (LGBM_TPU_SERVE_ROLLBACK_ON_DRIFT
+                                        # env)
+
     # ---- derived (not user-settable) ----
     is_parallel: bool = dataclasses.field(default=False, repr=False)
 
@@ -922,6 +980,18 @@ class Config:
                 and self.tpu_ingest_shard_id >= self.tpu_ingest_shards):
             log.fatal("tpu_ingest_shard_id should be < tpu_ingest_shards "
                       "(or -1 for the process rank)")
+        if not 0.0 <= self.tpu_drift_sample_rate <= 1.0:
+            log.fatal("tpu_drift_sample_rate should be in [0, 1]")
+        if self.tpu_drift_check_s <= 0:
+            log.fatal("tpu_drift_check_s should be > 0")
+        if self.tpu_drift_min_rows < 1:
+            log.fatal("tpu_drift_min_rows should be >= 1")
+        if self.tpu_drift_psi_warn <= 0:
+            log.fatal("tpu_drift_psi_warn should be > 0")
+        if self.tpu_quality_window < 1:
+            log.fatal("tpu_quality_window should be >= 1")
+        if self.tpu_quality_drop_warn <= 0:
+            log.fatal("tpu_quality_drop_warn should be > 0")
 
     # ------------------------------------------------------------------
     def num_model_per_iteration(self) -> int:

@@ -397,6 +397,12 @@ def ingest_dataset(source, config=None, *, categorical_features: Sequence = (),
         ds._alloc_X()
 
     # ---- pass 2: bin chunk-at-a-time into [lo, hi) -------------------
+    # bin-occupancy capture rides the binarize pass for free: each
+    # just-binned slice of X_bin folds into the per-feature occupancy
+    # accumulator the quality profile (obs/drift.py) is built from —
+    # no extra scan over a matrix that may be memmap-backed
+    from ..obs.drift import accumulate_occupancy, init_occupancy
+    occupancy = init_occupancy(ds)
     with timetag("binarize"):
         seen = 0
         filled = 0
@@ -415,8 +421,10 @@ def ingest_dataset(source, config=None, *, categorical_features: Sequence = (),
                             f"ingest pass 2: chunk {ci} width "
                             f"{sub.shape[1]} != stream width {n_cols}")
                 ds._binarize_chunk(sub, filled)
+                accumulate_occupancy(ds, occupancy, filled, e - s)
                 filled += e - s
             seen += m
+    ds.quality_occupancy = occupancy
     if seen != n_rows:
         raise IngestError(
             f"ingest: stream changed between passes ({seen} rows on "

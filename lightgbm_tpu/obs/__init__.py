@@ -28,6 +28,10 @@ from .core import (TIMETAG_ENABLED, add, count, counter_value,
                    phase_snapshot, record_collective,
                    record_collective_host, report, reset, sink_path, sync,
                    tracing_enabled)
+from .drift import (DriftMonitor, DriftSketch, QualityProfile,
+                    accumulate_occupancy, bin_features, coarsen,
+                    compute_occupancy, init_occupancy, ks, profile_path,
+                    psi)
 from .health import (TrainingHealthError, check_gradients, check_score,
                      check_tree, divergence_audit, enable_health,
                      health_enabled, health_mode, model_fingerprint)
@@ -50,6 +54,9 @@ __all__ = [
     "enabled", "event", "gauge", "phase", "phase_delta", "phase_snapshot",
     "record_collective", "record_collective_host", "report", "reset",
     "sink_path", "sync", "tracing_enabled",
+    "DriftMonitor", "DriftSketch", "QualityProfile",
+    "accumulate_occupancy", "bin_features", "coarsen",
+    "compute_occupancy", "init_occupancy", "ks", "profile_path", "psi",
     "compile_count", "compile_seconds", "install_recompile_hook",
     "device_peaks", "enable_profile", "profile_digest", "profile_enabled",
     "profile_wrap", "record_kernel", "roofline_seconds",

@@ -188,6 +188,9 @@ def summarize(events: List[dict]) -> dict:
     ing = ingest_summary(events)
     if ing:
         out["ingest"] = ing
+    drift = drift_summary(events)
+    if drift:
+        out["drift"] = drift
     return out
 
 
@@ -520,6 +523,42 @@ def ingest_summary(events: List[dict]) -> dict:
     return out
 
 
+def drift_summary(events: List[dict]) -> dict:
+    """Fold the drift/quality plane (``drift_snapshot`` cadence checks
+    from obs/drift.py's serve-side monitor, ``quality_window`` rolling
+    evaluations from serve/quality.py) into one digest section: score
+    trajectory extremes, breach counts, and the last window per model.
+    Empty when the run monitored nothing."""
+    snaps = [e for e in events if e.get("event") == "drift_snapshot"]
+    wins = [e for e in events if e.get("event") == "quality_window"]
+    if not (snaps or wins):
+        return {}
+    out = {"snapshots": len(snaps), "quality_windows": len(wins),
+           "drift_breaches": sum(1 for e in snaps if e.get("breach")),
+           "quality_breaches": sum(1 for e in wins if e.get("breach"))}
+    if snaps:
+        last = snaps[-1]
+        out["psi_max"] = round(max(float(e.get("psi_max", 0.0) or 0.0)
+                                   for e in snaps), 6)
+        out["pred_psi_max"] = round(
+            max(float(e.get("pred_psi", 0.0) or 0.0) for e in snaps), 6)
+        out["last_snapshot"] = {k: last.get(k) for k in
+                                ("model", "version", "feat_rows",
+                                 "pred_rows", "psi_max", "pred_psi",
+                                 "worst_feature", "breach")}
+    if wins:
+        last = wins[-1]
+        deltas = [float(e["auc_delta"]) for e in wins
+                  if e.get("auc_delta") is not None]
+        if deltas:
+            out["auc_delta_max"] = round(max(deltas), 6)
+        out["last_window"] = {k: last.get(k) for k in
+                              ("model", "version", "rows", "auc",
+                               "auc_delta", "cal_err", "ndcg", "breach")
+                              if last.get(k) is not None}
+    return out
+
+
 def trace_summary(events: List[dict]) -> dict:
     """Fold ``span`` events (obs/spans.py) into the trace digest:
     span/trace counts and per-name call/duration aggregates.  Empty when
@@ -792,6 +831,31 @@ EVENT_SCHEMAS = {
                                    # when telemetry/flight is armed —
                                    # crash-resume re-streams must match)
     },
+    # drift/quality plane (obs/drift.py + serve/quality.py)
+    "drift_snapshot": {
+        "model": (str, True),
+        "version": (int, True),
+        "feat_rows": (int, True),   # sampled feature rows in the sketch
+        "pred_rows": (int, True),   # scored responses in the sketch
+        "psi_max": (_NUM, True),    # worst per-feature PSI vs reference
+        "psi_mean": (_NUM, True),
+        "ks_max": (_NUM, True),
+        "pred_psi": (_NUM, True),   # prediction-histogram PSI
+        "pred_ks": (_NUM, True),
+        "worst_feature": (str, True),
+        "breach": (bool, True),
+    },
+    "quality_window": {
+        "model": (str, True),
+        "version": (int, True),     # served version the window scored
+        "rows": (int, True),
+        "auc": (_NUM, False),       # absent for single-class windows
+        "auc_ref": (_NUM, False),   # training AUC from the profile
+        "auc_delta": (_NUM, False),  # ref - live (positive = worse)
+        "cal_err": (_NUM, False),
+        "ndcg": (_NUM, False),
+        "breach": (bool, True),
+    },
 }
 
 
@@ -1023,6 +1087,39 @@ def render(digest: dict) -> str:
         out.append(line)
         if last.get("digest"):
             out.append(f"  dataset digest {last['digest']}")
+    if digest.get("drift"):
+        d = digest["drift"]
+        out.append("")
+        verdict = ("BREACHED" if (d.get("drift_breaches")
+                                  or d.get("quality_breaches"))
+                   else "quiet")
+        out.append(f"drift/quality: {verdict} — {d['snapshots']} "
+                   f"snapshot(s) ({d.get('drift_breaches', 0)} drift "
+                   f"breach(es)), {d['quality_windows']} quality "
+                   f"window(s) ({d.get('quality_breaches', 0)} quality "
+                   f"breach(es))")
+        if d.get("last_snapshot"):
+            ls = d["last_snapshot"]
+            out.append(f"  last snapshot: {ls.get('model')} "
+                       f"v{ls.get('version')} psi_max "
+                       f"{ls.get('psi_max')} pred_psi "
+                       f"{ls.get('pred_psi')} "
+                       f"(worst {ls.get('worst_feature') or '-'}, "
+                       f"{ls.get('feat_rows')}/{ls.get('pred_rows')} "
+                       f"feat/pred rows)")
+        if d.get("last_window"):
+            lw = d["last_window"]
+            parts = [f"{lw.get('rows')} row(s)"]
+            if lw.get("auc") is not None:
+                parts.append(f"auc {lw['auc']}")
+            if lw.get("auc_delta") is not None:
+                parts.append(f"delta {lw['auc_delta']}")
+            if lw.get("cal_err") is not None:
+                parts.append(f"cal_err {lw['cal_err']}")
+            if lw.get("ndcg") is not None:
+                parts.append(f"ndcg {lw['ndcg']}")
+            out.append(f"  last window: {lw.get('model')} "
+                       f"v{lw.get('version')} " + ", ".join(parts))
     if digest.get("trace"):
         t = digest["trace"]
         out.append("")

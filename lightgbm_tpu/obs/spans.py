@@ -84,6 +84,10 @@ _FLIGHT_EVENTS = frozenset((
     # post-mortem needs first (per-chunk records stay telemetry-only:
     # a 10^8-row stream would flush the whole ring with them)
     "ingest_summary",
+    # drift & quality plane (obs/drift.py + serve/quality.py): the
+    # score trail leading up to a breach is exactly what the breach's
+    # own flight dump must contain
+    "drift_snapshot", "quality_window",
 ))
 
 

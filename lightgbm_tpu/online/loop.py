@@ -179,6 +179,11 @@ class OnlineLoop:
         self.failed = 0             # refreshes that died before the push
         self.skipped = 0            # cadence firings with no fresh rows
         self.rows_ingested = 0
+        # serve/quality.py tracker, attached by the driver when the
+        # served model carries a quality-profile sidecar: every labeled
+        # batch this loop sees doubles as ground truth for the rolling
+        # per-version quality windows
+        self.quality = None
 
     # ------------------------------------------------------------------
     def ingest(self, X, y) -> int:
@@ -197,6 +202,13 @@ class OnlineLoop:
             del self._y[:drop]
         self._rows_since += X.shape[0]
         self.rows_ingested += X.shape[0]
+        if self.quality is not None:
+            try:
+                self.quality.add(X, y)
+            except Exception as exc:  # noqa: BLE001 — quality eval must
+                # never take the ingest path down with it
+                log.warning("online: quality window update failed: %s",
+                            exc)
         return X.shape[0]
 
     def due(self, now: Optional[float] = None) -> bool:
@@ -288,11 +300,16 @@ class OnlineLoop:
         return report
 
     def stats(self) -> dict:
-        return {"mode": self.mode, "versions": self.versions,
-                "rejected": self.rejected, "failed": self.failed,
-                "skipped": self.skipped,
-                "rows_ingested": self.rows_ingested,
-                "window_rows": len(self._X), "base": self.base}
+        out = {"mode": self.mode, "versions": self.versions,
+               "rejected": self.rejected, "failed": self.failed,
+               "skipped": self.skipped,
+               "rows_ingested": self.rows_ingested,
+               "window_rows": len(self._X), "base": self.base,
+               "last_refresh_age_s": round(
+                   time.monotonic() - self._last_refresh_t, 3)}
+        if self.quality is not None:
+            out["quality"] = self.quality.stats()
+        return out
 
 
 def run_online(cfg, params: dict) -> None:
@@ -329,6 +346,25 @@ def run_online(cfg, params: dict) -> None:
     loop = OnlineLoop(cfg.input_model, config=cfg, push=push,
                       workdir=getattr(cfg, "tpu_online_dir", "") or None,
                       params=dict(params))
+    # the fleet /metrics endpoint renders this loop's counters as the
+    # tpu_online_* series — the registry just holds the provider hook
+    reg.online_provider = loop.stats
+    from ..obs.drift import QualityProfile, profile_path
+    prof_file = profile_path(cfg.input_model)
+    if os.path.isfile(prof_file):
+        try:
+            from ..serve.quality import QualityTracker
+            prof = QualityProfile.load(prof_file)
+            loop.quality = QualityTracker(
+                lambda X: reg.resolve(name).router.predict(
+                    X, raw_score=True),
+                prof, config=cfg, registry=reg, model_name=name)
+            log.info("online: quality windows armed from %s "
+                     "(train_auc=%s)", prof_file,
+                     prof.meta.get("train_auc"))
+        except (ValueError, OSError) as exc:
+            log.warning("online: quality profile unusable, windows "
+                        "disarmed: %s", exc)
     follow = bool(getattr(cfg, "tpu_online_follow", False))
     log.info("online: serving %r on %s, ingesting %s (mode=%s, cadence "
              "%d rows / %gs, window %d)", name, server.url, source,

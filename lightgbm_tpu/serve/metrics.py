@@ -200,6 +200,40 @@ def _fmt(v) -> str:
     return repr(v) if isinstance(v, float) else str(v)
 
 
+_DRIFT_KINDS = ("psi_max", "psi_mean", "ks_max", "pred_psi", "pred_ks")
+
+
+def _drift_series(out, head, dr) -> None:
+    """Render one drift-monitor status dict (obs/drift.py
+    ``DriftMonitor.status()``) as the ``tpu_serve_drift_*`` series.
+    ``head`` is the caller's HELP/TYPE emitter so repeated calls (one
+    per model in a fleet scrape) share a single header block."""
+    model = dr.get("model") or "default"
+    version = int(dr.get("version") or 0)
+    scores = dr.get("scores") or {}
+    head("tpu_serve_drift_score", "gauge",
+         "Live-traffic drift vs the training reference from the last "
+         "cadence check (PSI/KS over feature bins and the prediction "
+         "histogram, by kind).")
+    for kind in _DRIFT_KINDS:
+        out.append(
+            'tpu_serve_drift_score{model="%s",version="%d",kind="%s"} %s'
+            % (model, version, kind, _fmt(scores.get(kind))))
+    head("tpu_serve_drift_rows", "gauge",
+         "Rows accumulated in the live drift sketch since the last "
+         "reset, by stream (feat = sampled feature rows, pred = scored "
+         "responses).")
+    for kind in ("feat", "pred"):
+        out.append(
+            'tpu_serve_drift_rows{model="%s",version="%d",kind="%s"} %d'
+            % (model, version, kind, int(dr.get(kind + "_rows") or 0)))
+    head("tpu_serve_drift_breach", "gauge",
+         "1 while a drift breach is latched (PSI above "
+         "tpu_drift_psi_warn at the last cadence check).")
+    out.append('tpu_serve_drift_breach{model="%s",version="%d"} %d'
+               % (model, version, 1 if dr.get("breach") else 0))
+
+
 def render_prometheus(session) -> str:
     """Prometheus text exposition for one session (its ``ServeMetrics``
     plus the live gauges out of ``session.stats()``)."""
@@ -335,6 +369,19 @@ def render_prometheus(session) -> str:
             out.append('tpu_serve_replica_queue_rows{replica="%s"} %d'
                        % (r.get("replica"),
                           int(r.get("queue_rows") or 0)))
+    # drift plane (obs/drift.py): stats() carries the monitor status
+    # when the model shipped a quality-profile sidecar and tpu_drift is
+    # on — rendered with model/version labels so the fleet exposition
+    # can mix per-model series without a collision
+    dr = st.get("drift")
+    if isinstance(dr, dict) and dr.get("armed"):
+        _drift_series(out, head, dr)
+    if st.get("resident_bytes") is not None:
+        head("tpu_serve_resident_bytes", "gauge",
+             "Device bytes held resident by this serving target "
+             "(packed forest + explanation planes, all replicas).")
+        out.append("tpu_serve_resident_bytes %d"
+                   % int(st["resident_bytes"]))
     return "\n".join(out) + "\n"
 
 
@@ -373,6 +420,65 @@ def render_prometheus_fleet(registry) -> str:
     for m in listing:
         out.append('tpu_serve_rollbacks_total{model="%s"} %d'
                    % (m["name"], m["rollbacks"]))
+    head("tpu_serve_resident_bytes", "gauge",
+         "Device bytes held resident per model version (live and the "
+         "rollback-held previous version).")
+    for m in listing:
+        for v in m.get("versions") or []:
+            if v.get("resident_bytes") is not None:
+                out.append(
+                    'tpu_serve_resident_bytes{model="%s",version="%d"} %d'
+                    % (m["name"], int(v["version"]),
+                       int(v["resident_bytes"])))
+    # per-model drift for the non-default models (the default model's
+    # live router is the primary section above, already rendered)
+    seen_heads = set()
+
+    def head_once(name, kind, help_):
+        if name not in seen_heads:
+            seen_heads.add(name)
+            head(name, kind, help_)
+
+    for m in listing:
+        dr = m.get("drift")
+        if not m.get("default") and isinstance(dr, dict) \
+                and dr.get("armed"):
+            _drift_series(out, head_once, dr)
+    # online learning loop (online/loop.py): the run_online driver
+    # parks its stats provider on the registry so one fleet scrape
+    # covers serving AND the refresh loop feeding it
+    prov = getattr(registry, "online_provider", None)
+    if prov is not None:
+        try:
+            ost = prov() if callable(prov) else dict(prov)
+        except Exception:  # noqa: BLE001 — a scrape never fails for a
+            # dead provider
+            ost = None
+        if ost:
+            head("tpu_online_refresh_total", "counter",
+                 "Online-loop refresh outcomes (pushed = adopted by "
+                 "the registry, rejected = bounced by the canary gate, "
+                 "failed = died before the push, skipped = cadence "
+                 "fired on a stalled ingest).")
+            for outcome, key in (("pushed", "versions"),
+                                 ("rejected", "rejected"),
+                                 ("failed", "failed"),
+                                 ("skipped", "skipped")):
+                out.append('tpu_online_refresh_total{outcome="%s"} %d'
+                           % (outcome, int(ost.get(key) or 0)))
+            head("tpu_online_swap_rejected_total", "counter",
+                 "Online refreshes the canary gate refused to flip.")
+            out.append("tpu_online_swap_rejected_total %d"
+                       % int(ost.get("rejected") or 0))
+            head("tpu_online_rows_ingested_total", "counter",
+                 "Labeled rows the online loop has ingested.")
+            out.append("tpu_online_rows_ingested_total %d"
+                       % int(ost.get("rows_ingested") or 0))
+            head("tpu_online_last_refresh_age_seconds", "gauge",
+                 "Seconds since the online loop last attempted a "
+                 "refresh (stalls show up as unbounded growth).")
+            out.append("tpu_online_last_refresh_age_seconds %s"
+                       % _fmt(ost.get("last_refresh_age_s")))
     return "\n".join(out) + "\n"
 
 

@@ -87,10 +87,15 @@ class _Version:
         self.watch_until: Optional[float] = None
 
     def row(self) -> dict:
+        try:
+            resident = int(self.router.resident_bytes())
+        except Exception:  # noqa: BLE001 — a torn-down router lists as None
+            resident = None
         return {"version": self.version, "state": self.state,
                 "source": self.source,
                 "created_t": round(self.created_t, 1),
-                "canary": self.canary}
+                "canary": self.canary,
+                "resident_bytes": resident}
 
 
 class _Entry:
@@ -106,6 +111,9 @@ class _Entry:
         self.swaps_rejected = 0
         self.rollbacks = 0
         self.swap_lock = threading.Lock()  # one swap at a time per model
+        # latest rolling-quality breach (serve/quality.py
+        # note_quality_breach); cleared whenever the live version changes
+        self.quality_breach: Optional[dict] = None
 
 
 class ModelRegistry:
@@ -142,6 +150,14 @@ class ModelRegistry:
             config, "tpu_serve_rollback_slo_burn", 0.0) or 0.0)
         self.swap_warmup = bool(getattr(config, "tpu_serve_swap_warmup",
                                         True))
+        # drift/quality breaches gate rollback only on opt-in; default
+        # they just annotate the post-swap watch report
+        self.rollback_on_drift = bool(_env_num(
+            "LGBM_TPU_SERVE_ROLLBACK_ON_DRIFT", int,
+            getattr(config, "tpu_serve_rollback_on_drift", False)))
+        # online-loop stats provider (online/loop.py run_online wires
+        # loop.stats here) — rendered into the fleet /metrics
+        self.online_provider = None
 
     # ------------------------------------------------------------------
     def _build_version(self, entry: _Entry, model) -> _Version:
@@ -182,6 +198,10 @@ class ModelRegistry:
                 raise SwapRejected(
                     f"initial deploy of {name!r} failed the canary gate: "
                     f"{report['checks']}", report)
+        if ver.router.drift is not None:
+            # canary probes ran synthetic traffic through the real
+            # predict path; the live window starts empty
+            ver.router.drift.reset_window()
         with self._lock:
             ver.state = "live"
             entry.live = ver
@@ -201,6 +221,10 @@ class ModelRegistry:
         checks: Dict[str, bool] = {}
         p99 = None
         t0 = time.perf_counter()
+        mon = getattr(router, "drift", None)
+        if mon is not None:
+            # probe rows are synthetic — they must not feed the sketch
+            mon.pause()
         try:
             faults.check("serve_canary")
             rng = np.random.default_rng(_CANARY_SEED)
@@ -239,6 +263,9 @@ class ModelRegistry:
                       version=int(router.version or 0), ok=False,
                       checks={k: bool(v) for k, v in checks.items()})
             return report
+        finally:
+            if mon is not None:
+                mon.resume()
         ok = all(checks.values())
         report = {"ok": ok, "checks": checks, "p99_ms": p99,
                   "ms": round((time.perf_counter() - t0) * 1e3, 1)}
@@ -287,6 +314,10 @@ class ModelRegistry:
                 raise SwapRejected(
                     f"swap of {name!r} failed before the flip: "
                     f"{type(exc).__name__}: {exc}", report) from exc
+            if ver.router.drift is not None:
+                # canary probes ran synthetic traffic through the real
+                # predict path; the live window starts empty
+                ver.router.drift.reset_window()
             # ---- atomic flip ----------------------------------------
             with self._lock:
                 old = entry.live
@@ -301,6 +332,9 @@ class ModelRegistry:
                                        + self.rollback_watch_s)
                 entry.live = ver
                 entry.swaps += 1
+                # a quality breach describes the version that produced
+                # it — the fresh flip starts with a clean slate
+                entry.quality_breach = None
             if retired is not None:
                 # the version two pushes back leaves the fleet; closing
                 # it drains its (by now idle) batchers
@@ -360,8 +394,13 @@ class ModelRegistry:
             entry.live.watch_until = None
             entry.previous = None
             entry.rollbacks += 1
+            entry.quality_breach = None
         bad.state = "rolled_back"
         entry.history.append(bad.row())
+        if entry.live.router.drift is not None:
+            # the restored version's sketch holds pre-swap traffic; its
+            # fresh serving run is scored from an empty window
+            entry.live.router.drift.reset_window()
         obs.event("serve_rollback", model=name,
                   from_version=bad.version,
                   to_version=entry.live.version, reason=reason)
@@ -414,11 +453,47 @@ class ModelRegistry:
         elif (self.rollback_slo_burn > 0 and burn is not None
                 and burn > self.rollback_slo_burn):
             reason = f"slo_burn {burn:g} > {self.rollback_slo_burn:g}"
+        # drift / quality plane (obs/drift.py + serve/quality.py): the
+        # latched breach always annotates the watch report; it becomes a
+        # rollback signal like the burns above only on the
+        # tpu_serve_rollback_on_drift opt-in
+        drift_mon = getattr(ver.router, "drift", None)
+        drift_breach = (drift_mon.breach if drift_mon is not None
+                        else None)
+        quality_breach = entry.quality_breach
+        if reason is None and self.rollback_on_drift:
+            if drift_breach is not None:
+                worst = max(float(drift_breach.get("psi_max") or 0.0),
+                            float(drift_breach.get("pred_psi") or 0.0))
+                reason = (f"drift_psi {worst:g} > "
+                          f"{drift_breach.get('threshold'):g}")
+            elif quality_breach is not None:
+                reason = (f"quality_drop auc_delta "
+                          f"{quality_breach.get('auc_delta')}")
         if reason is not None:
             return self.rollback(name, reason=f"auto: {reason}")
-        return {"ok": True, "status": "watching" if watching else "clear",
-                "requests": total, "failed": failed_d,
-                "degraded_transitions": deg_d, "slo_burn": burn}
+        out = {"ok": True, "status": "watching" if watching else "clear",
+               "requests": total, "failed": failed_d,
+               "degraded_transitions": deg_d, "slo_burn": burn}
+        if drift_breach is not None:
+            out["drift_breach"] = drift_breach
+        if quality_breach is not None:
+            out["quality_breach"] = quality_breach
+        return out
+
+    def note_quality_breach(self, name: Optional[str],
+                            info: dict) -> None:
+        """Latch the online loop's rolling-quality breach
+        (serve/quality.py) so the post-swap watch folds it into its
+        verdict.  Unknown names are ignored — the quality tracker must
+        never take its feed down."""
+        try:
+            entry = self._entry(name)
+        except UnknownModelError:
+            return
+        entry.quality_breach = dict(info)
+        log.warning("registry: quality breach latched on %r (%s)",
+                    entry.name, info.get("auc_delta"))
 
     def _start_watch(self, name: str, ver: _Version) -> None:
         """Background post-swap watcher: polls ``check_postswap`` until
@@ -473,6 +548,8 @@ class ModelRegistry:
         with self._lock:
             entries = list(self._models.values())
         for e in entries:
+            drift = (getattr(e.live.router, "drift", None)
+                     if e.live else None)
             out.append({
                 "name": e.name,
                 "default": e.name == self._default,
@@ -482,6 +559,13 @@ class ModelRegistry:
                 "swaps": e.swaps,
                 "swaps_rejected": e.swaps_rejected,
                 "rollbacks": e.rollbacks,
+                # resident = live + rollback-held device bytes (the
+                # tpu_serve_resident_bytes gauge per version)
+                "resident_bytes": sum(
+                    int(v.router.resident_bytes())
+                    for v in (e.live, e.previous) if v is not None),
+                "drift": drift.status() if drift is not None else None,
+                "quality_breach": e.quality_breach,
                 "versions": ([e.live.row()] if e.live else [])
                 + ([e.previous.row()] if e.previous else [])
                 + e.history[-4:],

@@ -139,9 +139,15 @@ class ReplicaRouter:
         if sessions is None:
             n = max(int(n_replicas), 1)
             devices = self._replica_devices(n)
+            # one DriftMonitor per version, shared by every replica
+            # (like ServeMetrics below): the sidecar loads once and the
+            # merged sketch needs no cross-replica merge step
+            from ..obs.drift import DriftMonitor
+            shared_drift = DriftMonitor.maybe_load(model, config)
             sessions = [PredictorSession(model, config=config,
                                          metrics=metrics,
                                          device=devices[i],
+                                         drift=shared_drift,
                                          **session_kw)
                         for i in range(n)]
         if not sessions:
@@ -157,15 +163,22 @@ class ReplicaRouter:
         trip = int(getattr(cfg, "tpu_serve_breaker_trip", 3) or 3)
         base = float(getattr(cfg, "tpu_serve_breaker_backoff_s", 0.5)
                      or 0.5)
+        # drift: adopt replica 0's monitor (caller-built sessions may
+        # each have armed "auto" — unify to one so the sketch merges)
+        self.drift = getattr(sessions[0], "_drift", None)
         self.replicas = []
         for i, s in enumerate(sessions):
             s.model_name = self.name
             s.model_version = self.version
             s.replica_id = f"r{i}"
             s.metrics = self.metrics
+            s._drift = self.drift
             self.replicas.append(Replica(
                 i, s, CircuitBreaker(trip_after=trip, backoff_base_s=base,
                                      seed=i)))
+        if self.drift is not None:
+            self.drift.model_name = self.name or "default"
+            self.drift.model_version = int(self.version or 0)
         self._rr = itertools.count()
         self._lock = threading.Lock()
         self.failovers = 0
@@ -376,8 +389,18 @@ class ReplicaRouter:
         agg["n_replicas"] = len(self.replicas)
         agg["routable_replicas"] = self.routable_count()
         agg["failovers"] = self.failovers
+        agg["resident_bytes"] = self.resident_bytes()
+        agg["drift"] = (self.drift.status()
+                        if self.drift is not None else None)
         agg["replicas"] = rows
         return agg
+
+    def resident_bytes(self) -> int:
+        """Device bytes this version's replicas hold resident (the
+        ``tpu_serve_resident_bytes`` gauge; each replica packs its own
+        forest, so the total is a sum even on a shared device)."""
+        return sum(int(r.session.resident_bytes())
+                   for r in self.replicas)
 
     def close(self) -> None:
         for r in self.replicas:

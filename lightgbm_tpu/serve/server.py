@@ -215,6 +215,23 @@ class _Handler(BaseHTTPRequestHandler):
                 else:
                     self._reply(200, {"default": reg.default,
                                       "models": reg.models()})
+            elif path == "/drift":
+                # drift/quality plane (obs/drift.py): per-model monitor
+                # status — thresholds, live sketch rows, last scores,
+                # breach latch — for dashboards that want the raw view
+                # behind the tpu_serve_drift_* series
+                if reg is not None:
+                    body = {}
+                    for m in reg.models():
+                        body[m["name"]] = {
+                            "drift": m.get("drift"),
+                            "quality_breach": m.get("quality_breach"),
+                        }
+                    self._reply(200, {"models": body})
+                else:
+                    dr = sess.stats().get("drift")
+                    self._reply(200, {"drift": dr,
+                                      "armed": bool(dr)})
             elif path == "/debug/flight":
                 self._reply(200, {"enabled": obs.flight_enabled(),
                                   "ring_len": obs.flight_len(),

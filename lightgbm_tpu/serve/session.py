@@ -114,7 +114,7 @@ class PredictorSession:
                  max_wait_ms: Optional[float] = None,
                  queue_depth: Optional[int] = None,
                  metrics: Optional[ServeMetrics] = None,
-                 device=None):
+                 device=None, drift="auto"):
         gbdt = model
         # fleet identity (serve/router.py + serve/registry.py stamp
         # these): which model/version/replica this session serves, and an
@@ -245,6 +245,17 @@ class PredictorSession:
         # shed counters aggregate without a merge step
         self.metrics = (metrics if metrics is not None
                         else ServeMetrics(slo_p99_ms=self.slo_p99_ms))
+        # ---- drift monitoring (obs/drift.py) -------------------------
+        # "auto" arms only for a file-loaded model with a .quality.json
+        # sidecar beside it (and tpu_drift on); the router passes a
+        # shared DriftMonitor instead so one sketch covers every
+        # replica of a version, like ServeMetrics above.  Unarmed, the
+        # hot path pays exactly one is-None branch.
+        if drift == "auto":
+            from ..obs.drift import DriftMonitor
+            self._drift = DriftMonitor.maybe_load(model, config)
+        else:
+            self._drift = drift or None
         # probe-and-recover: while degraded, re-try the device every
         # reprobe_s seconds so a transient backend error is not a
         # one-way latch (0 disables — the pre-ISSUE-7 behavior)
@@ -496,6 +507,12 @@ class PredictorSession:
             chunk = X[lo:lo + self.max_batch]
             raw[lo:lo + chunk.shape[0]] = self._predict_chunk(chunk)
         self._note_request(X.shape[0], (time.perf_counter() - t0) * 1e3)
+        if self._drift is not None:
+            try:
+                self._drift.observe(X, raw)
+                self._drift.maybe_check()
+            except Exception as exc:  # noqa: BLE001 — monitor never fails serving
+                log.warning("drift observe failed: %s", exc)
         return self._convert(raw, raw_score)
 
     def _predict_chunk(self, X: np.ndarray) -> np.ndarray:
@@ -966,10 +983,25 @@ class PredictorSession:
                                   (t_end - t_dispatch) * 1e3, tid,
                                   parent_id=pid, attrs={"rows": rows})
         exec_ms = (time.perf_counter() - t0) * 1e3
+        if self._drift is not None:
+            # before the futures resolve: observe() is a buffered append
+            # (histogramming runs on flush), so the latency cost is a few
+            # microseconds — and a caller that saw result() return can
+            # then force a check knowing this batch is already in the
+            # sketch. maybe_check (the expensive part) stays after.
+            try:
+                self._drift.observe([r.raw for r in live], raw)
+            except Exception as exc:  # noqa: BLE001 — monitor never fails serving
+                log.warning("drift observe failed: %s", exc)
         off = 0
         for r in live:
             _safe_resolve(r.future, result=raw[off:off + r.n])
             off += r.n
+        if self._drift is not None:
+            try:
+                self._drift.maybe_check()
+            except Exception as exc:  # noqa: BLE001 — monitor never fails serving
+                log.warning("drift observe failed: %s", exc)
         with self._lock:
             self._batches += 1
             self._real_rows += rows
@@ -1003,6 +1035,23 @@ class PredictorSession:
                   total_ms=round(total_ms, 3), ok=True)
 
     # ------------------------------------------------------------------
+    def resident_bytes(self) -> int:
+        """Device bytes this session's packed model holds resident: the
+        stacked forest plus (when armed) the TreeSHAP arrays — the
+        per-version residency figure behind
+        ``tpu_serve_resident_bytes`` (the first brick of
+        memory-pressure-aware registry residency)."""
+        import jax
+        total = 0
+        for leaf in jax.tree_util.tree_leaves(self.forest):
+            if hasattr(leaf, "nbytes"):
+                total += int(leaf.nbytes)
+        if self._explain is not None:
+            for leaf in jax.tree_util.tree_leaves(self._explain[:3]):
+                if hasattr(leaf, "nbytes"):
+                    total += int(leaf.nbytes)
+        return total
+
     def stats(self) -> dict:
         """Serving counters + latency percentiles (for /health and the
         serve bench)."""
@@ -1072,6 +1121,10 @@ class PredictorSession:
                 "model": self.model_name,
                 "version": self.model_version,
                 "replica": self.replica_id,
+                "resident_bytes": self.resident_bytes(),
+                # drift plane (obs/drift.py): None when unarmed
+                "drift": (self._drift.status()
+                          if self._drift is not None else None),
             }
 
     def close(self) -> None:

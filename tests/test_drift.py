@@ -1,0 +1,525 @@
+"""Model-quality & drift plane (ISSUE 16): reference profiles,
+streaming sketches, rolling quality, breach wiring.
+
+The contracts under test:
+
+- ``save_model`` writes the ``<model>.quality.json`` sidecar whose
+  per-feature occupancy matches the serve-side ``bin_features`` binning
+  exactly (bin-space consistency — PSI must measure traffic shift,
+  never binning skew), and whose chunked/streamed accumulation equals
+  the one-shot scan;
+- PSI/KS/coarsen behave (zero on identity, monotone under shift,
+  coarsening preserves mass) and the prediction histogram's tie-robust
+  edges survive float-noise-level score perturbation;
+- per-replica ``DriftSketch`` merge is bit-exact against the
+  single-accumulator oracle;
+- a ``DriftMonitor`` fed i.i.d. training-like traffic stays quiet while
+  seeded covariate shift breaches, dumps the flight recorder, and
+  latches the breach the registry's post-swap watch reads (rollback on
+  the ``tpu_serve_rollback_on_drift`` opt-in only);
+- the serve surfaces expose it all: ``stats()['drift']``,
+  ``tpu_serve_drift_*`` + ``tpu_serve_resident_bytes`` in /metrics,
+  GET /drift, the online-loop counters in the fleet exposition, and
+  the ``drift_snapshot``/``quality_window`` events validate against
+  their schemas and fold into ``drift_summary``.
+
+All CPU-runnable, quick tier.
+"""
+import json
+import os
+import urllib.request
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import obs
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.obs.drift import (DriftMonitor, DriftSketch,
+                                    FEAT_PSI_BUCKETS, QualityProfile,
+                                    _pred_histogram, bin_features, coarsen,
+                                    compute_occupancy, ks, profile_path,
+                                    psi)
+from lightgbm_tpu.obs.report import (drift_summary, load_events,
+                                     validate_events)
+from lightgbm_tpu.serve import (ModelRegistry, PredictorSession,
+                                PredictServer, parse_prometheus)
+from lightgbm_tpu.serve.metrics import (render_prometheus,
+                                        render_prometheus_fleet)
+from lightgbm_tpu.serve.quality import QualityTracker
+
+P = {"objective": "binary", "num_leaves": 7, "min_data_in_leaf": 5,
+     "verbose": -1}
+
+
+@pytest.fixture(autouse=True)
+def _obs_clean():
+    yield
+    obs.disable()
+    obs.enable_flight(0)
+    obs.reset()
+
+
+@pytest.fixture(scope="module")
+def drift_model(tmp_path_factory):
+    """One trained binary model saved to a file (sidecar rides along),
+    plus its training matrix — the reference distribution."""
+    rng = np.random.default_rng(7)
+    X = rng.normal(size=(600, 6))
+    X[rng.random(X.shape) < 0.03] = np.nan
+    y = (np.nan_to_num(X[:, 0]) + 0.5 * np.nan_to_num(X[:, 1]) > 0
+         ).astype(np.float64)
+    bst = lgb.train(P, lgb.Dataset(X, label=y, params=P),
+                    num_boost_round=8)
+    path = str(tmp_path_factory.mktemp("drift") / "model.txt")
+    bst.save_model(path)
+    return path, bst, X, y
+
+
+def _cfg(**over):
+    base = dict(P, tpu_serve_max_batch=64, tpu_serve_max_wait_ms=1.0,
+                tpu_serve_canary_rows=16, tpu_serve_canary_probes=2,
+                tpu_serve_rollback_watch_s=0.0, tpu_serve_reprobe_s=0.0,
+                tpu_drift_sample_rate=1.0, tpu_drift_min_rows=64)
+    base.update(over)
+    return Config.from_params(base)
+
+
+def _shifted(n=256, seed=11):
+    rng = np.random.default_rng(seed)
+    return rng.normal(size=(n, 6)) * 2.5 + 1.5
+
+
+# ---------------------------------------------------------------------
+# reference profile: sidecar capture + bin-space consistency
+# ---------------------------------------------------------------------
+
+def test_profile_sidecar_written_and_roundtrips(drift_model):
+    path, _, X, _ = drift_model
+    side = profile_path(path)
+    assert os.path.isfile(side)
+    prof = QualityProfile.load(side)
+    assert len(prof.features) == X.shape[1]
+    for rec in prof.features:
+        assert sum(rec["counts"]) == X.shape[0]
+        assert not rec["categorical"]
+    assert sum(prof.pred["counts"]) == X.shape[0]
+    assert len(prof.pred["counts"]) == len(prof.pred["edges"]) + 1
+    assert prof.meta["rows"] == X.shape[0]
+    assert 0.5 < prof.meta["train_auc"] <= 1.0
+    # dict round-trip is lossless (registry carries profiles as dicts)
+    again = QualityProfile.from_dict(prof.to_dict())
+    assert again.to_dict() == prof.to_dict()
+
+
+def test_bin_features_matches_training_occupancy(drift_model):
+    """Serve-side binning of the raw training rows reproduces the
+    profile's occupancy exactly — the bin-space-consistency invariant
+    that keeps PSI free of binning skew."""
+    path, _, X, _ = drift_model
+    prof = QualityProfile.load(profile_path(path))
+    recs = prof.numeric_records()
+    assert recs, "all-dense numeric features must all profile"
+    bins = bin_features(X, recs)
+    for rec, b in zip(recs, bins):
+        got = np.bincount(b, minlength=rec["num_bin"])
+        assert np.array_equal(got, np.asarray(rec["counts"])), rec["name"]
+
+
+def test_occupancy_chunked_matches_one_shot(drift_model):
+    """Streaming ingestion accumulates occupancy chunk by chunk during
+    pass 2 — any chunking must equal the whole-matrix scan."""
+    _, bst, _, _ = drift_model
+    ds = bst._gbdt.train_ds
+    full = compute_occupancy(ds, chunk_rows=1 << 20)
+    for chunk in (37, 128):
+        acc = compute_occupancy(ds, chunk_rows=chunk)
+        for a, b in zip(acc, full):
+            assert np.array_equal(a, b)
+
+
+# ---------------------------------------------------------------------
+# distances: psi / ks / coarsen / tie-robust prediction edges
+# ---------------------------------------------------------------------
+
+def test_psi_ks_basic_properties():
+    p = np.array([10, 20, 30, 40], float)
+    assert psi(p, p) == 0.0
+    assert ks(p, p) == 0.0
+    assert psi(p, [0, 0, 0, 0]) == 0.0       # degenerate -> neutral
+    near = [11, 19, 31, 39]
+    far = [40, 30, 20, 10]
+    assert 0.0 < psi(p, near) < psi(p, far)
+    assert 0.0 < ks(p, near) < ks(p, far) <= 1.0
+
+
+def test_coarsen_equal_reference_mass():
+    rng = np.random.default_rng(0)
+    ref = rng.integers(0, 50, size=255).astype(np.int64)
+    live = rng.integers(0, 5, size=255).astype(np.int64)
+    rc, lc = coarsen(ref, live)
+    assert len(rc) <= FEAT_PSI_BUCKETS + 1 and len(rc) == len(lc)
+    assert rc.sum() == ref.sum() and lc.sum() == live.sum()
+    # identical distributions stay identical after regrouping
+    rc2, lc2 = coarsen(ref, ref * 3)
+    assert psi(rc2, lc2) < 1e-12
+    # already-coarse histograms pass through untouched
+    small = np.arange(8, dtype=float)
+    a, b = coarsen(small, small)
+    assert np.array_equal(a, small) and np.array_equal(b, small)
+
+
+def test_coarsen_absorbs_sparse_sample_noise():
+    """The motivating failure: a thin i.i.d. sample over many fine bins
+    leaves most bins empty, and epsilon smoothing reads each as a large
+    PSI term.  Coarse view must stay well under the default 0.25 warn
+    while the fine view blows past it."""
+    rng = np.random.default_rng(1)
+    ref_vals = rng.normal(size=200_000)
+    live_vals = rng.normal(size=400)          # thin but same distribution
+    edges = np.quantile(ref_vals, np.linspace(0, 1, 256)[1:-1])
+    ref = np.bincount(np.searchsorted(edges, ref_vals), minlength=256)
+    live = np.bincount(np.searchsorted(edges, live_vals), minlength=256)
+    assert psi(ref, live) > 0.25               # fine bins: false alarm
+    rc, lc = coarsen(ref, live)
+    assert psi(rc, lc) < 0.1                   # coarse: quiet
+
+
+def test_pred_histogram_tie_robust_edges():
+    """GBDT margins are discrete; serve-time recomputation differs from
+    the training accumulation by float noise.  Edges must sit BETWEEN
+    distinct values so a 1e-9 wobble never flips a tie clump."""
+    rng = np.random.default_rng(2)
+    vals = np.array([-1.2, -0.4, 0.1, 0.9, 2.0])
+    s = rng.choice(vals, size=500)
+    edges, counts = _pred_histogram(s)
+    assert sum(counts) == s.size
+    assert not np.isin(np.asarray(edges), vals).any()
+    jittered = s + rng.uniform(-1e-9, 1e-9, size=s.size)
+    binned = np.bincount(np.searchsorted(edges, jittered, side="left"),
+                         minlength=len(counts))
+    assert np.array_equal(binned, counts)
+    # degenerate streams don't fabricate edges
+    assert _pred_histogram(np.full(9, 3.0)) == ([], [9])
+    assert _pred_histogram(np.array([])) == ([], [0])
+
+
+# ---------------------------------------------------------------------
+# sketch: replica merge bit-exactness
+# ---------------------------------------------------------------------
+
+def test_sketch_merge_matches_single_accumulator_oracle(drift_model):
+    path, bst, X, _ = drift_model
+    prof = QualityProfile.load(profile_path(path))
+    scores = bst.predict(X, raw_score=True)
+    a, b, oracle = (DriftSketch(prof) for _ in range(3))
+    a.observe_features(X[:220]); a.observe_preds(scores[:220])
+    b.observe_features(X[220:]); b.observe_preds(scores[220:])
+    oracle.observe_features(X); oracle.observe_preds(scores)
+    a.merge(b)
+    sa, so = a.snapshot(), oracle.snapshot()
+    assert sa["feat_rows"] == so["feat_rows"]
+    assert sa["pred_rows"] == so["pred_rows"]
+    assert np.array_equal(sa["pred_counts"], so["pred_counts"])
+    for ca, co in zip(sa["feat_counts"], so["feat_counts"]):
+        assert np.array_equal(ca, co)
+
+
+# ---------------------------------------------------------------------
+# monitor: differential (iid quiet / shift breaches), knobs, latch
+# ---------------------------------------------------------------------
+
+def test_monitor_iid_quiet_shift_breaches(drift_model, tmp_path,
+                                          monkeypatch):
+    path, bst, X, _ = drift_model
+    monkeypatch.setenv("LGBM_TPU_FLIGHT_DIR", str(tmp_path))
+    obs.enable_flight(64)
+    prof = QualityProfile.load(profile_path(path))
+
+    quiet = DriftMonitor(prof, _cfg())
+    quiet.observe(X, bst.predict(X, raw_score=True))
+    sq = quiet.maybe_check(force=True)
+    assert sq["feat_rows"] == len(X)
+    assert sq["psi_max"] < quiet.psi_warn
+    assert sq["pred_psi"] < quiet.psi_warn
+    assert quiet.breach is None
+
+    mon = DriftMonitor(prof, _cfg())
+    Xs = _shifted(256)
+    mon.observe(Xs, bst.predict(Xs, raw_score=True))
+    s = mon.maybe_check(force=True)
+    assert s["psi_max"] > mon.psi_warn
+    assert mon.breach is not None
+    assert "feature_psi" in mon.breach["kinds"]
+    assert mon.breach_count == 1
+    dumps = list(tmp_path.glob("FLIGHT_r*.json"))
+    assert dumps, "a drift breach must dump the flight recorder"
+    rec = json.loads(dumps[0].read_text())
+    assert rec["reason"].startswith("drift_psi:")
+    assert "feature_psi" in rec["breach"]["kinds"]
+    st = mon.status()
+    assert st["armed"] and st["breaches"] == 1
+    assert st["scores"]["psi_max"] == s["psi_max"]
+    assert "per_feature" not in st["scores"]
+
+
+def test_monitor_arming_and_kill_switch(drift_model, tmp_path,
+                                        monkeypatch):
+    path, bst, _, _ = drift_model
+    assert DriftMonitor.maybe_load(path, _cfg()) is not None
+    # env knobs override config (the LGBM_TPU_ prefix folds tpu_ in)
+    monkeypatch.setenv("LGBM_TPU_DRIFT_SAMPLE_RATE", "0.5")
+    assert DriftMonitor.maybe_load(path, _cfg()).sample_rate == 0.5
+    monkeypatch.setenv("LGBM_TPU_DRIFT", "0")
+    assert DriftMonitor.maybe_load(path, _cfg()) is None
+    monkeypatch.delenv("LGBM_TPU_DRIFT")
+    # in-memory models have no sidecar to find
+    assert DriftMonitor.maybe_load(bst, _cfg()) is None
+    # missing or corrupt sidecar: serve on, monitoring off
+    lone = tmp_path / "bare.txt"
+    lone.write_text("tree\n")
+    assert DriftMonitor.maybe_load(str(lone), _cfg()) is None
+    (tmp_path / "bare.txt.quality.json").write_text("{not json")
+    assert DriftMonitor.maybe_load(str(lone), _cfg()) is None
+
+
+def test_monitor_sampler_rate_honored(drift_model):
+    path, _, X, _ = drift_model
+    prof = QualityProfile.load(profile_path(path))
+    mon = DriftMonitor(prof, _cfg(tpu_drift_sample_rate=0.25))
+    for s in range(0, 512, 32):             # 16 batches of 32
+        mon.observe(X[:32], np.zeros(32))
+    st = mon.status()                        # drains the pending buffer
+    assert st["pred_rows"] == 512            # predictions: every row
+    assert st["feat_rows"] == 128            # features: exactly 1 in 4
+
+
+# ---------------------------------------------------------------------
+# serve surfaces: session stats, /metrics, /drift, registry annotation
+# ---------------------------------------------------------------------
+
+def test_session_drift_stats_and_prometheus(drift_model):
+    path, _, X, _ = drift_model
+    sess = PredictorSession(path, max_batch=64, max_wait_ms=0.5,
+                            config=_cfg())
+    try:
+        for s in range(0, 256, 64):
+            sess.predict(X[s:s + 64])
+        sess._drift.maybe_check(force=True)
+        st = sess.stats()
+        dr = st["drift"]
+        assert dr["armed"] and dr["feat_rows"] >= 256
+        assert dr["pred_rows"] >= 256
+        assert st["resident_bytes"] > 0
+        text = render_prometheus(sess)
+        parsed = parse_prometheus(text)
+        key = ('tpu_serve_drift_score{model="default",version="0",'
+               'kind="psi_max"}')
+        assert parsed[key] == dr["scores"]["psi_max"]
+        assert parsed['tpu_serve_drift_rows{model="default",version="0",'
+                      'kind="pred"}'] == dr["pred_rows"]
+        assert parsed['tpu_serve_drift_breach{model="default",'
+                      'version="0"}'] == 0.0
+        assert parsed["tpu_serve_resident_bytes"] == st["resident_bytes"]
+    finally:
+        sess.close()
+
+
+def test_session_drift_disabled_by_config(drift_model):
+    path, _, X, _ = drift_model
+    sess = PredictorSession(path, max_batch=64, max_wait_ms=0.5,
+                            config=_cfg(tpu_drift=False))
+    try:
+        sess.predict(X[:8])
+        assert sess.stats()["drift"] is None
+        assert "tpu_serve_drift_score" not in render_prometheus(sess)
+    finally:
+        sess.close()
+
+
+def test_registry_drift_endpoint_and_fleet_metrics(drift_model):
+    path, _, X, _ = drift_model
+    reg = ModelRegistry(config=_cfg(), n_replicas=1)
+    server = None
+    try:
+        reg.add_model("default", path)
+        for s in range(0, len(X), 120):
+            t = reg.submit(X[s:s + 120])
+            reg.result(t, timeout=30)
+        mon = reg.resolve(None).router.drift
+        assert mon is not None and mon.model_version == 1
+        mon.maybe_check(force=True)
+        row = reg.models()[0]
+        assert row["drift"]["armed"] and row["drift"]["breach"] is None
+        assert row["resident_bytes"] > 0
+
+        # fleet exposition: per-version residency + online-loop counters
+        reg.online_provider = lambda: {
+            "versions": 3, "rejected": 1, "failed": 0, "skipped": 2,
+            "rows_ingested": 640, "last_refresh_age_s": 1.5}
+        parsed = parse_prometheus(render_prometheus_fleet(reg))
+        assert parsed['tpu_serve_drift_breach{model="default",'
+                      'version="1"}'] == 0.0
+        assert parsed['tpu_serve_resident_bytes{model="default",'
+                      'version="1"}'] > 0
+        assert parsed['tpu_online_refresh_total{outcome="pushed"}'] == 3.0
+        assert parsed['tpu_online_refresh_total{outcome="rejected"}'] == 1.0
+        assert parsed["tpu_online_swap_rejected_total"] == 1.0
+        assert parsed["tpu_online_rows_ingested_total"] == 640.0
+        assert parsed["tpu_online_last_refresh_age_seconds"] == 1.5
+
+        # GET /drift over HTTP mirrors the registry's per-model status
+        server = PredictServer(reg).start()
+        with urllib.request.urlopen(server.url + "/drift",
+                                    timeout=30) as r:
+            body = json.loads(r.read())
+        assert body["models"]["default"]["drift"]["armed"]
+        assert body["models"]["default"]["quality_breach"] is None
+    finally:
+        if server is not None:
+            server.stop()
+        reg.close()
+
+
+def test_postswap_annotates_default_and_rolls_back_on_optin(drift_model,
+                                                            tmp_path):
+    """A latched breach annotates the watch verdict by default; the
+    tpu_serve_rollback_on_drift opt-in turns the same latch into an
+    automatic rollback."""
+    path, bst, X, _ = drift_model
+    # a second version to swap to (its own sidecar rides along)
+    p2 = dict(P, learning_rate=0.2)
+    b2 = lgb.train(p2, lgb.Dataset(X, label=(np.nan_to_num(X[:, 0]) > 0
+                                             ).astype(float), params=p2),
+                   num_boost_round=4)
+    m2 = str(tmp_path / "m2.txt")
+    b2.save_model(m2)
+
+    for optin in (False, True):
+        reg = ModelRegistry(config=_cfg(
+            tpu_serve_rollback_on_drift=optin), n_replicas=1)
+        try:
+            reg.add_model("default", path)
+            reg.swap("default", m2)
+            mon = reg.resolve(None).router.drift
+            mon.observe(_shifted(256), np.zeros(256))
+            assert mon.maybe_check(force=True)["psi_max"] > mon.psi_warn
+            rep = reg.check_postswap("default")
+            if optin:
+                assert rep["reason"].startswith("auto: drift_psi")
+                assert rep["to_version"] == 1
+            else:
+                assert rep["status"] in ("watching", "clear")
+                assert "feature_psi" in rep["drift_breach"]["kinds"]
+                assert reg.models()[0]["live_version"] == 2
+        finally:
+            reg.close()
+
+
+# ---------------------------------------------------------------------
+# rolling label quality (serve/quality.py) + registry latch
+# ---------------------------------------------------------------------
+
+def test_quality_tracker_windows_and_breach(drift_model):
+    path, bst, X, y = drift_model
+    prof = QualityProfile.load(profile_path(path))
+
+    class _Latch:
+        note = None
+
+        def note_quality_breach(self, name, info):
+            self.note = (name, dict(info))
+
+    latch = _Latch()
+    tr = QualityTracker(lambda rows: bst.predict(rows, raw_score=True),
+                        prof, config=_cfg(tpu_quality_window=200),
+                        registry=latch, model_name="default")
+    tr.add(X[:150], y[:150])                 # below the window: buffered
+    assert tr.windows == 0 and tr.stats()["buffered"] == 150
+    tr.add(X[150:300], y[150:300])
+    assert tr.windows == 1
+    assert tr.last["auc"] > 0.8 and not tr.last["breach"]
+    assert latch.note is None
+    # flipped labels crater windowed AUC past the drop threshold
+    tr.add(X[300:500], 1.0 - y[300:500])
+    assert tr.windows == 2 and tr.last["breach"]
+    assert tr.last["auc_delta"] > tr.drop_warn
+    assert tr.breaches == 1
+    assert latch.note[0] == "default"
+    assert latch.note[1]["auc_delta"] == tr.last["auc_delta"]
+
+
+def test_online_loop_carries_quality_and_refresh_age(drift_model,
+                                                     tmp_path):
+    from lightgbm_tpu.online.loop import OnlineLoop
+    path, bst, X, y = drift_model
+    prof = QualityProfile.load(profile_path(path))
+    loop = OnlineLoop(path, config=_cfg(), workdir=str(tmp_path))
+    loop.quality = QualityTracker(
+        lambda rows: bst.predict(rows, raw_score=True), prof,
+        config=_cfg(tpu_quality_window=128))
+    loop.ingest(X[:256], y[:256])
+    st = loop.stats()
+    assert st["rows_ingested"] == 256
+    assert st["last_refresh_age_s"] >= 0.0
+    assert st["quality"]["windows"] == 2
+    assert st["quality"]["last"]["auc"] > 0.8
+
+
+# ---------------------------------------------------------------------
+# telemetry: event schemas + digest section
+# ---------------------------------------------------------------------
+
+def test_drift_events_validate_and_summarize(drift_model, tmp_path):
+    path, bst, X, y = drift_model
+    obs.enable(str(tmp_path / "telem"))
+    try:
+        prof = QualityProfile.load(profile_path(path))
+        mon = DriftMonitor(prof, _cfg())
+        mon.observe(X, bst.predict(X, raw_score=True))
+        mon.maybe_check(force=True)          # quiet snapshot
+        Xs = _shifted(2048)
+        mon.observe(Xs, bst.predict(Xs, raw_score=True))
+        mon.maybe_check(force=True)          # breaching snapshot
+        tr = QualityTracker(lambda rows: bst.predict(rows,
+                                                     raw_score=True),
+                            prof, config=_cfg(tpu_quality_window=200))
+        tr.add(X[:200], y[:200])
+    finally:
+        obs.disable()
+    events = load_events(str(tmp_path / "telem"))
+    assert validate_events(events) == []
+    d = drift_summary(events)
+    assert d["snapshots"] == 2 and d["drift_breaches"] == 1
+    assert d["quality_windows"] == 1 and d["quality_breaches"] == 0
+    assert d["psi_max"] > 0.25
+    assert d["last_snapshot"]["breach"] is True
+    assert d["last_window"]["auc"] > 0.8
+
+
+# ---------------------------------------------------------------------
+# parse_prometheus: labeled series (the bench/test shared parser)
+# ---------------------------------------------------------------------
+
+def test_parse_prometheus_labeled_series():
+    text = "\n".join([
+        "# HELP tpu_serve_drift_score Live-traffic drift.",
+        "# TYPE tpu_serve_drift_score gauge",
+        'tpu_serve_drift_score{model="a b",version="1",kind="psi_max"}'
+        " 0.125",
+        'tpu_serve_drift_score{model="a b",version="1",kind="ks_max"}'
+        " 0.5",
+        "tpu_serve_resident_bytes 4096",
+        "tpu_serve_request_latency_ms_sum 12.5",
+        "",
+        "not a metric line at all with trailing junk words",
+        "tpu_bad_value{x=\"1\"} notanumber",
+    ])
+    parsed = parse_prometheus(text)
+    assert parsed['tpu_serve_drift_score{model="a b",version="1",'
+                  'kind="psi_max"}'] == 0.125
+    assert parsed['tpu_serve_drift_score{model="a b",version="1",'
+                  'kind="ks_max"}'] == 0.5
+    assert parsed["tpu_serve_resident_bytes"] == 4096.0
+    assert parsed["tpu_serve_request_latency_ms_sum"] == 12.5
+    assert not any("bad_value" in k or "junk" in k for k in parsed)

@@ -12,10 +12,13 @@ the wrong way".  This tool does:
 ``path`` entries are bench-round JSON files, serving-round files
 (``SERVE_r*.json`` from ``tools/bench_serve.py``), online-loop rounds
 (``ONLINE_r*.json`` from ``tools/online_smoke.py``), streaming-ingest
-rounds (``INGEST_r*.json`` from ``tools/ingest_bench.py``), telemetry
-digest JSON files (``telemetry_report.py --json`` output), or
-directories to glob for ``BENCH_r*.json`` + ``SERVE_r*.json`` +
-``ONLINE_r*.json`` + ``INGEST_r*.json`` (default: the repo root).
+rounds (``INGEST_r*.json`` from ``tools/ingest_bench.py``), drift
+rounds (``DRIFT_r*.json`` from ``tools/drift_report.py --smoke`` —
+``drift_psi_max`` / ``quality_auc_delta`` trended, rounds with failed
+checks flagged like canaries), telemetry digest JSON files
+(``telemetry_report.py --json`` output), or directories to glob for
+``BENCH_r*.json`` + ``SERVE_r*.json`` + ``ONLINE_r*.json`` +
+``INGEST_r*.json`` + ``DRIFT_r*.json`` (default: the repo root).
 Rounds whose bench produced no parseable line (``"parsed": null`` —
 e.g. round 1's empty tail) are listed but carry no metrics.  Serving
 rounds trend rows/s + p50/p99 + batch occupancy under their own
@@ -108,6 +111,13 @@ _DIRECTIONS = [
     ("ingest_rows_per_s", True),
     ("ingest_wall_s", False),
     ("peak_traced_bytes", False),
+    # drift rounds (DRIFT_r*.json, tools/drift_report.py --smoke): the
+    # shifted-replay PSI (the detection margin — shrinking toward the
+    # warn threshold means the plane is losing sensitivity) and the
+    # label-flip windowed AUC drop the quality tracker caught
+    ("drift_psi_max", True),
+    ("drift_psi_iid", False),
+    ("quality_auc_delta", True),
 ]
 
 # a swap blip worse than this multiple of the steady p99 is flagged: the
@@ -192,6 +202,23 @@ def load_round(path: str) -> dict:
             row["note"] = ("online checks FAILED: " + ", ".join(failed)
                            + " — excluded from baselines")
             row["canary"] = "online-failed"
+        return row
+    if parsed.get("kind") == "drift":  # a tools/drift_report.py round
+        row["context"] = ("drift", parsed.get("backend"))
+        for name in ("drift_psi_max", "drift_psi_iid",
+                     "quality_auc_delta"):
+            v = parsed.get(name)
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                row["metrics"][name] = float(v)
+        checks = parsed.get("checks") or {}
+        failed = [k for k, v in checks.items() if not v]
+        if failed:
+            # a failed check means the differential itself broke (false
+            # alarm or missed shift) — flagged like a canary round, its
+            # scores never join the baseline window
+            row["note"] = ("drift checks FAILED: " + ", ".join(failed)
+                           + " — excluded from baselines")
+            row["canary"] = "drift-failed"
         return row
     if parsed.get("kind") == "serve":  # a bench_serve.py round
         row["context"] = ("serve", parsed.get("backend"),
@@ -339,6 +366,7 @@ def collect(paths: List[str]) -> List[dict]:
             files.extend(sorted(glob.glob(os.path.join(p, "SERVE_r*.json"))))
             files.extend(sorted(glob.glob(os.path.join(p, "ONLINE_r*.json"))))
             files.extend(sorted(glob.glob(os.path.join(p, "INGEST_r*.json"))))
+            files.extend(sorted(glob.glob(os.path.join(p, "DRIFT_r*.json"))))
         else:
             files.append(p)
     rows = []

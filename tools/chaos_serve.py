@@ -26,6 +26,11 @@ process, every suite round (``tools/run_suite.py`` runs this as the
 - **shed_priority** — a saturated queue sheds LOW-priority requests
   while HIGH is still admitted; the per-class shed/served counters land
   in /metrics and the 503 carries ``Retry-After``.
+- **drift** — seeded covariate-shifted traffic drives feature PSI past
+  ``tpu_drift_psi_warn`` within one cadence check (breach latched,
+  flight recorder dumped), while a clean replay of training-distribution
+  rows stays below the threshold — detection AND false-alarm sides of
+  the drift plane (obs/drift.py).
 
     python tools/chaos_serve.py --json     # one JSON verdict line
 """
@@ -390,6 +395,58 @@ def scenario_shed_priority(models, X, P):
 
 
 # ---------------------------------------------------------------------------
+def scenario_drift(models, X, P, art_dir):
+    """Seeded covariate shift breaches the drift monitor (flight dump
+    fired, breach latched); clean traffic stays quiet — the
+    false-alarm side of the differential matters as much as the
+    detection side."""
+    from lightgbm_tpu.serve import ModelRegistry
+    (m1, _), _ = models
+    rng = np.random.default_rng(7)
+    # pin the plane's knobs: every serve batch sampled, a small row
+    # floor so forced checks score, cadence driven by force=True
+    os.environ["LGBM_TPU_DRIFT_SAMPLE_RATE"] = "1.0"
+    os.environ["LGBM_TPU_DRIFT_MIN_ROWS"] = "64"
+    reg = ModelRegistry(config=_cfg(P), n_replicas=1)
+    try:
+        reg.add_model("default", m1)
+        mon = getattr(reg.resolve(None).router, "drift", None)
+        check("drift.monitor_armed", mon is not None,
+              "no .quality.json sidecar beside the chaos model?")
+        if mon is None:
+            return
+        # clean replay: the full training matrix in slices — a biased
+        # subsample (e.g. the first 128 rows over and over) would shift
+        # the PREDICTION histogram and fail the false-alarm side
+        for s in range(0, len(X), 120):
+            t = reg.submit(X[s:s + 120])
+            reg.result(t, timeout=30)
+        quiet = mon.maybe_check(force=True)
+        check("drift.clean_quiet", quiet is not None
+              and quiet["psi_max"] <= mon.psi_warn
+              and mon.breach is None,
+              quiet and {k: quiet[k] for k in ("psi_max", "pred_psi")})
+        n0 = len(glob.glob(os.path.join(art_dir, "FLIGHT_*.json")))
+        # covariate shift: scaled + offset marginals, same row shape
+        for _ in range(4):
+            t = reg.submit(rng.normal(size=(128, 6)) * 2.5 + 1.5)
+            reg.result(t, timeout=30)
+        flagged = mon.maybe_check(force=True)
+        check("drift.shifted_flagged", flagged is not None
+              and flagged["psi_max"] > mon.psi_warn
+              and mon.breach is not None,
+              flagged and {k: flagged[k] for k in ("psi_max",
+                                                   "pred_psi")})
+        n1 = len(glob.glob(os.path.join(art_dir, "FLIGHT_*.json")))
+        check("drift.breach_flight_dump", n1 > n0,
+              f"{n0} -> {n1} in {art_dir}")
+    finally:
+        os.environ.pop("LGBM_TPU_DRIFT_SAMPLE_RATE", None)
+        os.environ.pop("LGBM_TPU_DRIFT_MIN_ROWS", None)
+        reg.close()
+
+
+# ---------------------------------------------------------------------------
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description="Serving chaos matrix")
     ap.add_argument("--json", action="store_true",
@@ -410,6 +467,7 @@ def main(argv=None) -> int:
         scenario_canary_fail(models, X, P)
         scenario_rollback_trigger(models, X, P, art)
         scenario_shed_priority(models, X, P)
+        scenario_drift(models, X, P, art)
 
     record = {
         "kind": "chaos_serve",

@@ -1,0 +1,312 @@
+"""Profile-vs-live drift report + the CPU drift smoke (ISSUE 16).
+
+Report mode reads a model's ``.quality.json`` sidecar (obs/drift.py)
+and prints the reference profile; given ``--stream`` (a JSONL file of
+``{"x": [...]}`` rows — the online-loop stream format, labels ignored)
+it replays the rows through a ``DriftSketch`` and prints the
+profile-vs-sketch table: per-feature PSI/KS, the prediction-histogram
+scores, and the breach list vs ``tpu_drift_psi_warn``.
+
+    python tools/drift_report.py model.txt
+    python tools/drift_report.py model.txt --stream live.jsonl
+
+``--smoke`` is the self-contained end-to-end check the ``drift`` suite
+tier runs (tools/run_suite.py): train a small binary model (profile
+sidecar written at save), serve it through an in-process
+``ModelRegistry``, and prove the plane on CPU:
+
+- **clean traffic stays quiet**: an i.i.d. replay scores PSI below the
+  warn threshold — no breach, no false alarm;
+- **shifted traffic is flagged**: a seeded covariate-shift replay
+  (scaled + offset marginals) drives PSI past ``tpu_drift_psi_warn``
+  within one forced cadence check and latches the breach;
+- **merge = oracle**: two sketches fed disjoint halves of the replay
+  merge bit-exactly to the single-sketch counts (the ServeMetrics
+  contract);
+- **quality windows close the loop**: a label-flipped window drops
+  windowed AUC past ``tpu_quality_drop_warn`` and the breach lands in
+  the registry's ``models()`` annotation.
+
+The ``DRIFT_rN.json`` artifact carries ``drift_psi_max`` (shifted
+replay) and ``quality_auc_delta`` — ``tools/bench_history.py`` trends
+both and flags breach rounds like canaries.
+
+    python tools/drift_report.py --smoke --json
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+CHECKS = {}
+
+
+def check(name, ok, detail=""):
+    CHECKS[name] = bool(ok)
+    print(f"# {'ok ' if ok else 'FAIL'} {name}"
+          + (f" — {detail}" if detail and not ok else ""), flush=True)
+
+
+def _next_round(out_dir):
+    n = 0
+    for f in glob.glob(os.path.join(out_dir, "DRIFT_r*.json")):
+        m = re.search(r"DRIFT_r(\d+)\.json$", os.path.basename(f))
+        if m:
+            n = max(n, int(m.group(1)))
+    return n + 1
+
+
+# ---------------------------------------------------------------------------
+# report mode
+# ---------------------------------------------------------------------------
+
+def _load_stream_rows(path):
+    """Rows from a JSONL stream ({"x": [...]}; "features" accepted),
+    malformed lines skipped like online/loop.py's reader."""
+    rows, bad = [], 0
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+                rows.append([float(v) for v in
+                             rec.get("x", rec.get("features"))])
+            except (ValueError, TypeError, AttributeError):
+                bad += 1
+    if bad:
+        print(f"# skipped {bad} malformed stream line(s)")
+    return np.asarray(rows, np.float64) if rows else np.zeros((0, 0))
+
+
+def report(model_path: str, stream: str, psi_warn: float) -> int:
+    from lightgbm_tpu.obs.drift import (DriftSketch, QualityProfile,
+                                        coarsen, ks, profile_path, psi)
+    side = profile_path(model_path)
+    if not os.path.isfile(side):
+        print(f"# no profile sidecar at {side} — retrain with "
+              f"tpu_quality_profile=true and save_model()")
+        return 1
+    prof = QualityProfile.load(side)
+    meta = prof.meta
+    print(f"# profile {side}")
+    print(f"#   reference rows {meta.get('rows')}, "
+          f"{meta.get('num_features')} feature(s), "
+          f"train_auc {meta.get('train_auc')}")
+    numeric = prof.numeric_records()
+    if not stream:
+        print(f"#   {len(numeric)} numerical feature record(s), "
+              f"{len(prof.features) - len(numeric)} categorical "
+              f"(excluded from drift)")
+        for rec in numeric:
+            c = np.asarray(rec["counts"], np.float64)
+            top = int(np.argmax(c)) if c.size else -1
+            print(f"    {rec['name']:<24} bins={rec['num_bin']:<4} "
+                  f"mode_bin={top} nan_bin={rec['nan_bin']}")
+        return 0
+    X = _load_stream_rows(stream)
+    if not X.size:
+        print("# stream is empty — nothing to score")
+        return 1
+    sk = DriftSketch(prof)
+    sk.observe_features(X)
+    snap = sk.snapshot()
+    print(f"# live stream {stream}: {snap['feat_rows']} row(s)")
+    print(f"  {'feature':<24}{'psi':>10}{'ks':>10}  verdict")
+    breaches = []
+    for rec, live in zip(sk.records, snap["feat_counts"]):
+        rc, lc = coarsen(rec["counts"], live)
+        p, k = psi(rc, lc), ks(rc, lc)
+        verdict = ("BREACH" if p > psi_warn
+                   else "shift" if p > 0.1 else "ok")
+        if p > psi_warn:
+            breaches.append(rec["name"])
+        print(f"  {rec['name']:<24}{p:>10.4f}{k:>10.4f}  {verdict}")
+    if breaches:
+        print(f"# {len(breaches)} feature(s) past psi_warn={psi_warn}: "
+              + ", ".join(breaches))
+    else:
+        print(f"# no feature past psi_warn={psi_warn}")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# smoke mode
+# ---------------------------------------------------------------------------
+
+def smoke(args) -> int:
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.obs.drift import (DriftMonitor, DriftSketch,
+                                        QualityProfile, profile_path)
+    from lightgbm_tpu.serve import ModelRegistry
+    from lightgbm_tpu.serve.quality import QualityTracker
+
+    t0 = time.time()
+    art = tempfile.mkdtemp(prefix="drift_smoke_")
+    rng = np.random.default_rng(16)
+    # every cadence knob pinned: the smoke must not depend on ambient
+    # env; flight dumps land in the artifact dir, not the repo root
+    os.environ["LGBM_TPU_DRIFT_SAMPLE_RATE"] = "1.0"
+    os.environ["LGBM_TPU_DRIFT_MIN_ROWS"] = "64"
+    os.environ["LGBM_TPU_FLIGHT_DIR"] = art
+
+    P = {"objective": "binary", "num_leaves": 7, "min_data_in_leaf": 5,
+         "verbose": -1, "tpu_serve_replicas": 1,
+         "tpu_serve_max_batch": 256, "tpu_serve_rollback_watch_s": 0.0,
+         "tpu_quality_window": 256, "tpu_quality_drop_warn": 0.05}
+    cfg = Config.from_params(P)
+
+    Xt = rng.normal(size=(1200, 6))
+    yt = (Xt[:, 0] + 0.6 * Xt[:, 1] - 0.3 * Xt[:, 2]
+          > 0).astype(np.float64)
+    ds = lgb.Dataset(Xt, label=yt, params=P)
+    bst = lgb.train(P, ds, num_boost_round=8, verbose_eval=False)
+    model_path = os.path.join(art, "model.txt")
+    bst.save_model(model_path)
+    side = profile_path(model_path)
+    check("profile_sidecar_written", os.path.isfile(side), side)
+    prof = QualityProfile.load(side)
+    check("profile_has_reference",
+          prof.meta.get("rows") == 1200 and len(prof.numeric_records()) == 6
+          and prof.meta.get("train_auc") is not None, prof.meta)
+
+    reg = ModelRegistry(config=cfg)
+    reg.add_model("default", model_path)
+    router = reg.resolve(None).router
+    mon = getattr(router, "drift", None)
+    check("monitor_armed", mon is not None)
+    if mon is None:
+        print(json.dumps({"kind": "drift", "ok": False, "checks": CHECKS}))
+        return 1
+
+    # ---- clean traffic stays quiet ---------------------------------
+    for _ in range(4):
+        router.predict(rng.normal(size=(128, 6)))
+    iid = mon.maybe_check(force=True)
+    check("clean_traffic_quiet",
+          iid is not None and iid["psi_max"] <= mon.psi_warn
+          and not mon.breach,
+          iid and {k: iid[k] for k in ("psi_max", "pred_psi")})
+    psi_iid = iid["psi_max"] if iid else None
+
+    # ---- seeded covariate shift is flagged -------------------------
+    for _ in range(4):
+        Xs = rng.normal(size=(128, 6)) * 2.5 + 1.5
+        router.predict(Xs)
+    shifted = mon.maybe_check(force=True)
+    check("shifted_traffic_flagged",
+          shifted is not None and shifted["psi_max"] > mon.psi_warn,
+          shifted and {k: shifted[k] for k in ("psi_max", "pred_psi")})
+    check("breach_latched", mon.breach is not None
+          and "feature_psi" in (mon.breach or {}).get("kinds", ()),
+          mon.breach)
+    psi_shifted = shifted["psi_max"] if shifted else None
+
+    # ---- merge across replicas == single-sketch oracle -------------
+    Xm = rng.normal(size=(512, 6)) * 1.7 - 0.4
+    oracle, a, b = (DriftSketch(prof), DriftSketch(prof),
+                    DriftSketch(prof))
+    oracle.observe_features(Xm)
+    oracle.observe_preds(np.arange(512, dtype=np.float64) / 512)
+    a.observe_features(Xm[:200])
+    a.observe_preds(np.arange(200, dtype=np.float64) / 512)
+    b.observe_features(Xm[200:])
+    b.observe_preds(np.arange(200, 512, dtype=np.float64) / 512)
+    a.merge(b)
+    sa, so = a.snapshot(), oracle.snapshot()
+    merged_exact = (
+        sa["feat_rows"] == so["feat_rows"]
+        and sa["pred_rows"] == so["pred_rows"]
+        and all(np.array_equal(x, y) for x, y in
+                zip(sa["feat_counts"], so["feat_counts"]))
+        and np.array_equal(sa["pred_counts"], so["pred_counts"]))
+    check("sketch_merge_bit_exact", merged_exact)
+
+    # ---- quality window: label flip -> breach -> registry ----------
+    tracker = QualityTracker(
+        lambda X: router.predict(X, raw_score=True), prof, config=cfg,
+        registry=reg, model_name="default")
+    Xq = rng.normal(size=(256, 6))
+    yq = (Xq[:, 0] + 0.6 * Xq[:, 1] - 0.3 * Xq[:, 2] > 0)
+    tracker.add(Xq, 1.0 - yq.astype(np.float64))   # flipped labels
+    check("quality_breach_detected", tracker.breaches >= 1,
+          tracker.stats())
+    listing = {m["name"]: m for m in reg.models()}
+    qb = listing.get("default", {}).get("quality_breach")
+    check("registry_annotated", qb is not None
+          and qb.get("auc_delta") is not None, qb)
+    auc_delta = (qb or {}).get("auc_delta")
+    dumps = glob.glob(os.path.join(art, "FLIGHT_r*.json"))
+    check("breach_flight_dump", len(dumps) >= 1, art)
+
+    record = {
+        "kind": "drift",
+        "t": round(time.time(), 1),
+        "wall_s": round(time.time() - t0, 1),
+        "backend": "cpu",
+        "checks": CHECKS,
+        "ok": all(CHECKS.values()),
+        "drift_psi_max": psi_shifted,
+        "drift_psi_iid": psi_iid,
+        "quality_auc_delta": auc_delta,
+        "drift_breaches": mon.breach_count,
+        "artifacts_dir": art,
+    }
+    if not args.no_write:
+        n = _next_round(args.out)
+        path = os.path.join(args.out, f"DRIFT_r{n:02d}.json")
+        with open(path, "w") as fh:
+            json.dump(record, fh, indent=1)
+        print(f"# wrote {path}")
+    if args.json:
+        print(json.dumps(record))
+    else:
+        print(f"# {sum(CHECKS.values())}/{len(CHECKS)} checks passed "
+              f"({record['wall_s']}s)")
+    return 0 if record["ok"] else 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Quality-profile drift report / CPU drift smoke")
+    ap.add_argument("model", nargs="?", default="",
+                    help="model file (its .quality.json sidecar is read)")
+    ap.add_argument("--stream", default="",
+                    help='JSONL file of {"x": [...]} rows to score '
+                         "against the profile")
+    ap.add_argument("--psi-warn", type=float, default=0.25,
+                    help="breach threshold for the report table "
+                         "(default 0.25)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="run the self-contained end-to-end drift smoke")
+    ap.add_argument("--json", action="store_true",
+                    help="(smoke) print a machine-readable verdict line")
+    ap.add_argument("--out", default=REPO,
+                    help="DRIFT_rN.json artifact dir (default: repo root)")
+    ap.add_argument("--no-write", action="store_true",
+                    help="(smoke) skip writing the DRIFT_rN.json artifact")
+    args = ap.parse_args(argv)
+    if args.smoke:
+        return smoke(args)
+    if not args.model:
+        ap.error("model path required (or --smoke)")
+    return report(args.model, args.stream, args.psi_warn)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

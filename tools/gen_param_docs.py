@@ -262,6 +262,28 @@ ENV_VARS = [
      "device kernel; a huge value forces the host oracle.  Unset uses "
      "the built-in threshold (50k), which keeps tiny ad-hoc calls off "
      "the compile path."),
+    ("LGBM_TPU_DRIFT_SAMPLE_RATE",
+     "drift-plane override for `tpu_drift_sample_rate` — the fraction "
+     "of served feature rows the serve-side sketch samples (the "
+     "prediction histogram always takes every response).  `1.0` "
+     "sketches every batch — what the drift smoke pins; the default "
+     "0.05 keeps the off-path overhead negligible.  "
+     "`LGBM_TPU_DRIFT_CHECK_S`, `LGBM_TPU_DRIFT_MIN_ROWS` and "
+     "`LGBM_TPU_DRIFT_PSI_WARN` override the cadence, the row floor "
+     "and the breach threshold the same way; `LGBM_TPU_DRIFT=0` "
+     "disarms the monitor entirely."),
+    ("LGBM_TPU_QUALITY_WINDOW",
+     "quality-plane override for `tpu_quality_window` — labeled rows "
+     "per rolling evaluation window (the online loop's labeled stream "
+     "feeds it).  `LGBM_TPU_QUALITY_DROP_WARN` overrides the windowed-"
+     "AUC drop that counts as a breach."),
+    ("LGBM_TPU_SERVE_ROLLBACK_ON_DRIFT",
+     "registry override for `tpu_serve_rollback_on_drift` — opt a "
+     "fleet into automatic post-swap rollback on a latched drift or "
+     "quality breach.  Default off: breaches annotate the post-swap "
+     "health report and dump the flight recorder, but never gate — "
+     "drift is a property of TRAFFIC, and rolling back a good model "
+     "because the world changed is usually wrong."),
     ("LGBM_TPU_PEAK_FLOPS",
      "override the profile mode's device peak FLOP/s (used with "
      "`LGBM_TPU_PEAK_BW`) when the built-in per-chip table "

@@ -89,6 +89,16 @@ traffic, and a deliberately poisoned refit bounces off the canary
 gate with the old version still serving.  Its JSON carries
 ``online_refresh_s`` / ``online_swap_ok``, trended by
 ``tools/bench_history.py`` from the ``ONLINE_r*.json`` artifact.
+
+The ``drift`` tier (ISSUE 16) runs ``tools/drift_report.py --smoke
+--json``: the model-quality monitoring plane — profile sidecar written
+at ``save_model``, an i.i.d. replay scoring below ``tpu_drift_psi_warn``
+(no false alarm), a seeded covariate-shift replay breaching and
+latching within one cadence check, per-replica sketch merge bit-exact
+vs the single-sketch oracle, and a label-flipped quality window
+dropping windowed AUC past ``tpu_quality_drop_warn`` with the breach
+annotated in the registry.  Its ``DRIFT_r*.json`` carries
+``drift_psi_max`` / ``quality_auc_delta`` for ``bench_history``.
 """
 from __future__ import annotations
 
@@ -188,6 +198,13 @@ _TOOL_TIERS = {
     # shifted-tail sampling regression — re-proved on CPU each round;
     # its INGEST_rN.json carries ingest_rows_per_s for bench_history
     "ingest": ["ingest_bench.py", "--json"],
+    # drift/quality plane (ISSUE 16): profile sidecar written at save,
+    # i.i.d. replay quiet, seeded covariate shift breaches + latches,
+    # sketch merge bit-exact, label-flip quality breach annotated in the
+    # registry — the monitoring plane re-proved on CPU each round; its
+    # DRIFT_rN.json carries drift_psi_max / quality_auc_delta for
+    # bench_history
+    "drift": ["drift_report.py", "--smoke", "--json"],
 }
 
 
@@ -242,13 +259,13 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="Run the quick/slow test tiers and write SUITE_rN.json")
     ap.add_argument("--tiers", default="quick,slow,serve,faults,chaos,"
-                                       "online,ingest",
+                                       "online,ingest,drift",
                     help="comma list of tiers: pytest markers plus the "
                          "built-in 'serve' smoke, 'faults' matrix, "
-                         "'chaos' serving-chaos, 'online' closed-loop "
-                         "and 'ingest' streaming-ingestion legs "
-                         "(default quick,slow,serve,faults,chaos,"
-                         "online,ingest)")
+                         "'chaos' serving-chaos, 'online' closed-loop, "
+                         "'ingest' streaming-ingestion and 'drift' "
+                         "monitoring legs (default quick,slow,serve,"
+                         "faults,chaos,online,ingest,drift)")
     ap.add_argument("--select", default="",
                     help="pytest collection target (file or node id) "
                          "instead of the whole tests/ dir")

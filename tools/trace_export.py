@@ -75,12 +75,53 @@ def _synth_from_iterations(events):
     return out
 
 
+_OPS_TRACKS = {
+    # telemetry event -> (track name, duration-field, scale to ms)
+    "online_refresh": ("ops/online", "ms", 1.0),
+    "refit": ("ops/online", "wall_s", 1e3),
+    "drift_snapshot": ("ops/drift", None, 0.0),
+    "quality_window": ("ops/drift", None, 0.0),
+}
+
+
+def _synth_ops_tracks(events):
+    """Span rows for the operational planes — online refreshes/refits
+    as duration spans, drift snapshots and quality windows as instants —
+    so the ops cadence renders on its own Perfetto track beside the
+    request/iteration spans."""
+    out = []
+    for e in events:
+        kind = e.get("event")
+        spec = _OPS_TRACKS.get(kind)
+        if spec is None or not isinstance(e.get("t"), (int, float)):
+            continue
+        trace, dur_field, scale = spec
+        dur_ms = (float(e.get(dur_field, 0.0) or 0.0) * scale
+                  if dur_field else 0.0)
+        attrs = {k: v for k, v in e.items()
+                 if k not in ("event", "t", "_proc")
+                 and isinstance(v, (int, float, str, bool))}
+        attrs["synthesized"] = True
+        name = kind
+        if e.get("breach"):
+            name += "/BREACH"
+        out.append({"event": "span", "t": float(e["t"]) - dur_ms / 1e3,
+                    "dur_ms": dur_ms, "name": name, "trace_id": trace,
+                    "span_id": f"{kind}@{e['t']}",
+                    "_proc": e.get("_proc", 0), "attrs": attrs})
+    return out
+
+
 def events_to_chrome(events) -> dict:
     """Merged telemetry events -> a Chrome trace-event document (dict).
     Round-trips: ``json.dump`` the result and Perfetto loads it."""
     spans = _span_rows(events)
     if not spans:
         spans = _synth_from_iterations(events)
+    # the ops planes (online refresh/refit, drift/quality checks) ride
+    # along whenever present — they have no true span events, so the
+    # synthesized track is additive, not a fallback
+    spans = spans + _synth_ops_tracks(events)
     if not spans:
         return {"traceEvents": [], "displayTimeUnit": "ms"}
     t_min = min(e["t"] for e in spans)
