@@ -206,17 +206,29 @@ def _measure(params: dict, X, y, group, iters: int, metric_prefix: str):
     ds.construct()
     bin_time = time.time() - t_bin0
     booster = lgb.Booster(params=params, train_set=ds)
-    t0 = time.time()
-    booster.update()
-    jax.block_until_ready(booster._gbdt._train_score)
-    compile_time = time.time() - t0
-    t1 = time.time()
-    for _ in range(iters - 1):
+    # train-board exporter (ISSUE 17): bench drives Booster.update()
+    # directly (no engine.train), so it arms the board itself — purely
+    # env-gated (LGBM_TPU_TRAIN_METRICS; tpu_window.py's headline leg
+    # sets it and scrapes /metrics + /progress mid-leg).  Off by
+    # default: resolve_port(None) only honors the env var.
+    from lightgbm_tpu.obs import board as _board
+    train_board = _board.maybe_start(None, total_rounds=iters)
+    try:
+        t0 = time.time()
         booster.update()
-    # sync: updates dispatch asynchronously — without this the loop
-    # measures enqueue time, not compute (wildly optimistic at small iters)
-    jax.block_until_ready(booster._gbdt._train_score)
-    per_iter = (time.time() - t1) / max(iters - 1, 1)
+        jax.block_until_ready(booster._gbdt._train_score)
+        compile_time = time.time() - t0
+        t1 = time.time()
+        for _ in range(iters - 1):
+            booster.update()
+        # sync: updates dispatch asynchronously — without this the loop
+        # measures enqueue time, not compute (wildly optimistic at
+        # small iters)
+        jax.block_until_ready(booster._gbdt._train_score)
+        per_iter = (time.time() - t1) / max(iters - 1, 1)
+    finally:
+        if train_board is not None:
+            train_board.stop()
     mval = next((v for (_, m, v, _) in booster.eval_train()
                  if m.startswith(metric_prefix)), None)
     gbdt = booster._gbdt

@@ -311,12 +311,29 @@ class PredictorBase:
         space, forest, explain, fn = self._contrib_cache
         from ..utils.timetag import timetag
         out = np.zeros((X.shape[0], K, F + 1))
+        t_shap0 = time.perf_counter()
         with timetag("predict (treeshap scan)"):
             for lo in range(0, X.shape[0], self._CONTRIB_CHUNK):
                 chunk = X[lo:lo + self._CONTRIB_CHUNK]
                 bins = space.bin_matrix(chunk)
                 out[lo:lo + chunk.shape[0]] = np.asarray(
                     fn(forest, explain, jnp.asarray(bins)), np.float64)
+        # shap_cost reconciliation (ISSUE 17): the contribution pass is
+        # host-bracketed (np.asarray syncs each chunk), so its wall is
+        # honestly measured — score it against the TreeSHAP roofline
+        # like the per-iteration train phases
+        reconciler = getattr(self, "_reconciler", None)
+        if reconciler is not None and obs.tracing_enabled():
+            try:
+                T_, L_, P_ = np.shape(explain.path_node)
+                u = reconciler.score_shap(
+                    time.perf_counter() - t_shap0,
+                    N=X.shape[0], T=T_, L=L_, P=P_, F=F, K=K)
+                if u:
+                    obs.event("reconciliation", iteration=self.iter_,
+                              units={"shap": u})
+            except Exception:  # noqa: BLE001 — never fail a predict
+                pass
         return out.reshape(X.shape[0], K * (F + 1)) if K > 1 \
             else out[:, 0, :]
 
@@ -586,6 +603,20 @@ class GBDT(PredictorBase):
             enabled=bool(getattr(config, "tpu_watchdog", False)),
             seed=int(getattr(config, "seed", 0)),
             on_fatal=self._device_fatal_hook)
+        # live per-rank skew aggregation + measured-vs-model
+        # reconciliation (obs/ranks.py, ISSUE 17): the aggregator's
+        # exchange rides the fingerprint cadence and is a no-op
+        # single-process; the reconciler scores each clean iteration
+        # against the analytic cost models
+        from ..obs.ranks import RankAggregator, Reconciler
+        straggler_iters = int(getattr(config, "tpu_straggler_iters", 3))
+        self._ranks = (RankAggregator(
+            factor=float(getattr(config, "tpu_straggler_factor", 2.0)),
+            iters=straggler_iters) if straggler_iters > 0 else None)
+        self._reconciler = Reconciler()
+        qb = getattr(train_ds.metadata, "query_boundaries", None)
+        self._rank_sizes = (np.diff(np.asarray(qb, np.int64))
+                            if qb is not None else None)
 
         self.config = config
         self.train_ds = train_ds
@@ -1536,8 +1567,10 @@ class GBDT(PredictorBase):
         # gated hard: with neither gate configured this is one bool check.
         # Profile mode without a sink still takes this path — events
         # no-op, but the kernel attribution, memory census, and release
-        # audit must feed the digest bench.py embeds.
-        telem = obs.enabled() or obs.profile_enabled()
+        # audit must feed the digest bench.py embeds.  An armed train
+        # board (obs/board.py) counts too: its /metrics render is fed by
+        # the same iteration records.
+        telem = obs.enabled() or obs.profile_enabled() or obs.board_active()
         if telem:
             t_iter0 = time.perf_counter()
             phase0 = obs.phase_snapshot()
@@ -1752,7 +1785,8 @@ class GBDT(PredictorBase):
                           reason="no_splits")
             obs.end_span(it_span, stopped=True)
             return True
-        if health_on and self._fp_freq and self.iter_ % self._fp_freq == 0:
+        fp_tick = bool(self._fp_freq) and self.iter_ % self._fp_freq == 0
+        if health_on and fp_tick:
             self._health_fingerprint()
         if telem:
             self._emit_iteration_record(t_iter0, phase0, compiles0,
@@ -1760,6 +1794,11 @@ class GBDT(PredictorBase):
                                         waves_total, kern_rows,
                                         overlap_waves=overlap_total,
                                         fused_grad=fused_now)
+            if self._ranks is not None and fp_tick:
+                # cross-rank stats exchange piggybacked on the
+                # fingerprint cadence (the fleet already synchronizes
+                # there) — feeds the live straggler detector
+                self._ranks.exchange(self.iter_)
         self.iter_ += 1
         return False
 
@@ -1852,6 +1891,22 @@ class GBDT(PredictorBase):
             cum_row_iters_per_s=round(
                 N * self._telem_iters / max(self._telem_train_s, 1e-9), 1),
             **wave_fields)
+        if self._ranks is not None:
+            self._ranks.accumulate(phase_s)
+        if recompiles == 0:
+            # measured-vs-model reconciliation (ISSUE 17): score this
+            # iteration's phase walls against the analytic cost models.
+            # Same compile guard as the profile attribution below —
+            # trace/compile time inside phase_s would poison the ratio.
+            units = self._reconciler.score(
+                phase_s=phase_s, iter_s=iter_s, N=N,
+                kern_rows=kern_rows, waves=waves,
+                wave_cost_args=getattr(self, "_wave_cost_args", None),
+                splits=splits, part_batched=part_batched,
+                rank_sizes=self._rank_sizes)
+            if units:
+                obs.event("reconciliation", iteration=self.iter_,
+                          units=units)
         if obs.profile_enabled():
             if kern_rows and kern_rows > 0 and recompiles == 0 \
                     and getattr(self, "_wave_cost_args", None):

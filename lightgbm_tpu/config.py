@@ -497,6 +497,25 @@ class Config:
                                         # storm, a TrainingHealthError,
                                         # or GET /debug/flight; 0
                                         # disables (LGBM_TPU_FLIGHT env)
+    tpu_train_metrics_port: int = -1    # live train introspection board
+                                        # (obs/board.py): HTTP port for
+                                        # GET /metrics + /progress +
+                                        # /debug/flight during training.
+                                        # -1 disables, 0 picks an
+                                        # ephemeral port and logs it, >0
+                                        # binds port+rank per process
+                                        # (LGBM_TPU_TRAIN_METRICS env
+                                        # wins: a port number, or
+                                        # off/false to disarm)
+    tpu_straggler_factor: float = 2.0   # live straggler detector: a rank
+                                        # whose per-iteration hist/split
+                                        # wall exceeds the fleet median
+                                        # by this factor is suspect
+                                        # (multi-process runs only)
+    tpu_straggler_iters: int = 3        # consecutive suspect iterations
+                                        # before a straggler event is
+                                        # emitted (+ flight dump on
+                                        # rank 0); 0 disables detection
 
     # ---- Serving (serve/ subsystem) ----
     tpu_serve_max_batch: int = 1024     # row cap per coalesced device
@@ -941,6 +960,12 @@ class Config:
             log.fatal("tpu_explain_max_wait_ms should be >= 0")
         if self.tpu_flight_len < 0:
             log.fatal("tpu_flight_len should be >= 0")
+        if not (-1 <= self.tpu_train_metrics_port <= 65535):
+            log.fatal("tpu_train_metrics_port should be in [-1, 65535]")
+        if self.tpu_straggler_factor <= 1.0:
+            log.fatal("tpu_straggler_factor should be > 1")
+        if self.tpu_straggler_iters < 0:
+            log.fatal("tpu_straggler_iters should be >= 0")
         if self.tpu_on_device_error not in ("abort", "fallback", "retry"):
             log.fatal("tpu_on_device_error should be abort, fallback or "
                       "retry")

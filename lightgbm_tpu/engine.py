@@ -204,6 +204,21 @@ def train(params: Dict[str, Any], train_set: Dataset,
             lambda reason: ckpt_mgr.save(
                 booster, booster._gbdt.iter_ - num_init,
                 eval_history, reason=reason))
+    # live train introspection board (obs/board.py): armed alongside
+    # the telemetry sink when tpu_train_metrics_port /
+    # LGBM_TPU_TRAIN_METRICS asks for it.  start_round anchors the
+    # board at the trainer's CURRENT counter (checkpoint resume and
+    # init_model continue both included), so /progress ETA measures
+    # this run's live rate over the genuinely remaining rounds — never
+    # wall-clock-since-boot after a crash-resume.
+    from .obs import board as _board
+    train_board = _board.maybe_start(
+        booster.config,
+        total_rounds=booster._gbdt.iter_ + (num_boost_round - start_round),
+        start_round=booster._gbdt.iter_)
+    if train_board is not None:
+        train_board.set_provider("watchdog",
+                                 booster._gbdt._guard.snapshot)
     try:
         for i in range(start_round, num_boost_round):
             if stopped_in_replay or preempted:
@@ -244,6 +259,8 @@ def train(params: Dict[str, Any], train_set: Dataset,
                 if ckpt_mgr.should_save(i + 1):
                     ckpt_mgr.save(booster, i + 1, eval_history)
     finally:
+        if train_board is not None:
+            train_board.stop()
         for s, h in prev_handlers.items():
             try:
                 _signal.signal(s, h)

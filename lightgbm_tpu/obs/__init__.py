@@ -22,6 +22,9 @@ Perfetto by ``tools/trace_export.py``; ``LGBM_TPU_FLIGHT=<n>`` (or
 ``tpu_flight_len``) sizes the flight recorder ring dumped as
 ``FLIGHT_rN.json`` on degradations and health aborts.
 """
+from .board import TrainBoard
+from .board import active as board_active
+from .board import current as train_board
 from .core import (TIMETAG_ENABLED, add, count, counter_value,
                    counters_snapshot, current_phase, digest, disable,
                    enable, enabled, event, gauge, phase, phase_delta,
@@ -46,6 +49,7 @@ from .spans import (Span, begin_span, current_context, emit_span,
                     flight_enabled, flight_len, flight_len_from_env,
                     flight_snapshot, new_span_id, new_trace_id, span,
                     span_record_enabled, trace_enabled)
+from .ranks import RankAggregator, Reconciler, StragglerDetector, skew_table
 from .trace import compile_count, compile_seconds, install_recompile_hook
 
 __all__ = [
@@ -69,4 +73,6 @@ __all__ = [
     "enable_trace", "end_span", "flight_dump", "flight_enabled",
     "flight_len", "flight_len_from_env", "flight_snapshot", "new_span_id",
     "new_trace_id", "span", "span_record_enabled", "trace_enabled",
+    "TrainBoard", "board_active", "train_board",
+    "RankAggregator", "Reconciler", "StragglerDetector", "skew_table",
 ]

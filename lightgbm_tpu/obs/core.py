@@ -55,6 +55,7 @@ _profile_active = False         # set by obs.profile (avoids import cycle)
 _spans_active = False           # set by obs.spans (trace mode)
 _span_phase_hook = None         # obs.spans phase->span promotion hook
 _flight_hook = None             # obs.spans flight-recorder event forward
+_board_hook = None              # obs.board live-exporter event forward
 _mem_probe = None               # obs.memory per-phase-exit hook
 _reset_hooks = []               # submodule state cleared by reset()
 
@@ -75,6 +76,16 @@ def _set_flight_hook(hook) -> None:
     ring even with no sink configured (one None check when disarmed)."""
     global _flight_hook
     _flight_hook = hook
+
+
+def _set_board_hook(hook) -> None:
+    """obs/board.py installs this so the live train exporter sees every
+    event (and phase timers accumulate) even with no sink configured —
+    same reasoning as the flight hook: core can't import board."""
+    global _board_hook
+    _board_hook = hook
+    if hook is not None:
+        _ensure_atexit()
 
 
 def _set_profile_active(on: bool) -> None:
@@ -103,7 +114,7 @@ def enabled() -> bool:
 def tracing_enabled() -> bool:
     """True when phase timers accumulate and :func:`sync` blocks."""
     return (TIMETAG_ENABLED or _path is not None or _profile_active
-            or _spans_active)
+            or _spans_active or _board_hook is not None)
 
 
 def enable(path: str) -> None:
@@ -250,6 +261,8 @@ def event(name: str, **fields) -> None:
     unwrapped automatically."""
     if _flight_hook is not None:
         _flight_hook(name, fields)
+    if _board_hook is not None:
+        _board_hook(name, fields)
     if _path is None:
         return
     rec = {"event": name, "t": round(time.time(), 6)}
@@ -277,13 +290,13 @@ def write_record(rec: dict) -> None:
 
 def count(name: str, n=1) -> None:
     """Bump a monotonic counter (no-op when disabled)."""
-    if _path is not None:
+    if _path is not None or _board_hook is not None:
         _counters[name] += n
 
 
 def gauge(name: str, value) -> None:
     """Record the latest value of a gauge (no-op when disabled)."""
-    if _path is not None:
+    if _path is not None or _board_hook is not None:
         _gauges[name] = value
 
 

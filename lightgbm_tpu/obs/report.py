@@ -1,8 +1,16 @@
 """Merge telemetry JSONL files into per-phase / per-iteration summaries.
 
-Library backing for ``tools/telemetry_report.py`` (and for tests): pure
-stdlib, no jax import, so the report tool starts instantly even on a box
-without an accelerator runtime.
+Pure stdlib, no jax import, so the report starts instantly even on a
+box without an accelerator runtime.  Also the CLI::
+
+    python -m lightgbm_tpu.obs.report <path> [--json]
+
+``<path>`` is a telemetry dir (merges every
+``telemetry.{process_index}.jsonl`` in it), one ``.jsonl`` file, or a
+glob.  Default output is the human-readable table; ``--json`` prints
+the machine digest (the same shape bench.py embeds as its
+``telemetry`` field).  ``tools/telemetry_report.py`` remains as a thin
+shim over this entry point.
 """
 from __future__ import annotations
 
@@ -191,6 +199,53 @@ def summarize(events: List[dict]) -> dict:
     drift = drift_summary(events)
     if drift:
         out["drift"] = drift
+    recon = reconciliation_summary(events)
+    if recon:
+        out["reconciliation"] = recon
+    stragglers = [{k: e.get(k) for k in ("rank", "phase", "iteration",
+                                         "ratio", "median_s", "rank_s",
+                                         "consecutive")}
+                  for e in events if e.get("event") == "straggler"]
+    if stragglers:
+        out["stragglers"] = stragglers
+    return out
+
+
+def reconciliation_summary(events: List[dict]) -> dict:
+    """Aggregate ``reconciliation`` events per cost-model unit: scored
+    iterations, mean/last measured-over-modeled ratio, and the worst
+    ratio with its iteration — the post-hoc companion of the live
+    board's reconciliation row (a unit whose mean ratio drifts far
+    above 1 is where docs/ROOFLINE.md's model is optimistic on this
+    backend)."""
+    per_unit: dict = {}
+    for e in events:
+        if e.get("event") != "reconciliation":
+            continue
+        for unit, u in (e.get("units") or {}).items():
+            ratio = u.get("ratio")
+            if ratio is None:
+                continue
+            agg = per_unit.setdefault(unit, {
+                "iterations": 0, "ratio_sum": 0.0, "last_ratio": None,
+                "worst_ratio": None, "worst_iteration": None})
+            agg["iterations"] += 1
+            agg["ratio_sum"] += float(ratio)
+            agg["last_ratio"] = float(ratio)
+            agg["last_measured_s"] = u.get("measured_s")
+            agg["last_modeled_s"] = u.get("modeled_s")
+            if (agg["worst_ratio"] is None
+                    or float(ratio) > agg["worst_ratio"]):
+                agg["worst_ratio"] = float(ratio)
+                agg["worst_iteration"] = e.get("iteration")
+    out = {}
+    for unit, agg in per_unit.items():
+        n = agg.pop("iterations")
+        s = agg.pop("ratio_sum")
+        out[unit] = dict(iterations=n, mean_ratio=round(s / n, 4),
+                         **{k: (round(v, 4)
+                                if isinstance(v, float) else v)
+                            for k, v in agg.items()})
     return out
 
 
@@ -856,6 +911,22 @@ EVENT_SCHEMAS = {
         "ndcg": (_NUM, False),
         "breach": (bool, True),
     },
+    # live introspection plane (obs/ranks.py, ISSUE 17)
+    "straggler": {
+        "rank": (int, True),        # the offending process index
+        "phase": (str, True),       # which phase lagged (ranks.PHASES)
+        "iteration": (int, True),
+        "ratio": (_NUM, True),      # rank wall over fleet median
+        "median_s": (_NUM, True),   # per-iteration fleet median wall
+        "rank_s": (_NUM, True),     # per-iteration offender wall
+        "consecutive": (int, True),  # iterations the streak lasted
+        "breach": (bool, False),
+    },
+    "reconciliation": {
+        "iteration": (int, True),
+        "units": (dict, True),      # unit -> {measured_s, modeled_s,
+                                    #          ratio}
+    },
 }
 
 
@@ -1120,6 +1191,29 @@ def render(digest: dict) -> str:
                 parts.append(f"ndcg {lw['ndcg']}")
             out.append(f"  last window: {lw.get('model')} "
                        f"v{lw.get('version')} " + ", ".join(parts))
+    if digest.get("stragglers"):
+        out.append("")
+        out.append(f"{'straggler breaches':<28}{'rank':>6}{'iter':>7}"
+                   f"{'ratio':>8}{'median_s':>10}{'rank_s':>10}")
+        for s in digest["stragglers"]:
+            out.append(f"{(s.get('phase') or '?'):<28}"
+                       f"{(s.get('rank') if s.get('rank') is not None else '?'):>6}"
+                       f"{(s.get('iteration') if s.get('iteration') is not None else '?'):>7}"
+                       f"{(s.get('ratio') or 0.0):>8.2f}"
+                       f"{(s.get('median_s') or 0.0):>10.4f}"
+                       f"{(s.get('rank_s') or 0.0):>10.4f}")
+    if digest.get("reconciliation"):
+        out.append("")
+        out.append(f"{'reconciliation (meas/model)':<28}{'iters':>6}"
+                   f"{'mean':>8}{'last':>8}{'worst':>8}{'@iter':>7}")
+        for unit, u in sorted(digest["reconciliation"].items(),
+                              key=lambda kv: -(kv[1]["mean_ratio"] or 0)):
+            worst_it = u.get("worst_iteration")
+            out.append(f"{unit:<28}{u['iterations']:>6}"
+                       f"{u['mean_ratio']:>8.2f}"
+                       f"{(u.get('last_ratio') or 0.0):>8.2f}"
+                       f"{(u.get('worst_ratio') or 0.0):>8.2f}"
+                       f"{(worst_it if worst_it is not None else '-'):>7}")
     if digest.get("trace"):
         t = digest["trace"]
         out.append("")
@@ -1138,3 +1232,37 @@ def render(digest: dict) -> str:
     if digest.get("parse_errors"):
         out.append(f"\n(parse errors skipped: {digest['parse_errors']})")
     return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    """CLI entry: ``python -m lightgbm_tpu.obs.report <path> [--json]``
+    (folded in from the old tools/telemetry_report.py stub)."""
+    import argparse
+    import sys
+
+    ap = argparse.ArgumentParser(
+        prog="python -m lightgbm_tpu.obs.report",
+        description="Summarize lightgbm_tpu telemetry JSONL files")
+    ap.add_argument("path", help="telemetry dir, one .jsonl file, or a "
+                                 "glob")
+    ap.add_argument("--json", action="store_true",
+                    help="print the machine-readable digest instead of "
+                         "the table")
+    args = ap.parse_args(argv)
+
+    files = telemetry_files(args.path)
+    if not files:
+        print(f"no telemetry files under {args.path!r}", file=sys.stderr)
+        return 1
+    digest = summarize(load_events(args.path))
+    if args.json:
+        print(json.dumps(digest))
+    else:
+        print(f"merged {len(files)} file(s)")
+        print(render(digest))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -88,6 +88,11 @@ _FLIGHT_EVENTS = frozenset((
     # score trail leading up to a breach is exactly what the breach's
     # own flight dump must contain
     "drift_snapshot", "quality_window",
+    # live introspection plane (obs/ranks.py): the straggler breach
+    # belongs in the ring it triggers a dump of (reconciliation stays
+    # telemetry-only: one record per iteration would crowd the ring the
+    # way per-chunk ingest records would)
+    "straggler",
 ))
 
 

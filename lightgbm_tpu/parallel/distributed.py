@@ -345,3 +345,16 @@ def rank_allgather_stats(vec):
         return None
     v = np.ascontiguousarray(np.asarray(vec, np.float64).reshape(-1))
     return _allgather_exact(v).reshape(jax.process_count(), -1)
+
+
+def train_stats_exchange(vec):
+    """Per-iteration training-stats exchange for the live straggler
+    detector (obs/ranks.py): every rank contributes its windowed phase
+    walls, every rank gets the ``[num_processes, len(vec)]`` matrix
+    back.  Delegates to :func:`rank_allgather_stats` — the same
+    bit-exact uint32-pair allgather the divergence audit rides — and is
+    called ONLY on the fingerprint cadence, which already synchronizes
+    the fleet, so the exchange piggybacks on an existing barrier rather
+    than adding a per-iteration sync point.  None when single-process
+    or before the runtime is up (callers skip detection entirely)."""
+    return rank_allgather_stats(vec)

@@ -159,6 +159,22 @@ class DeviceGuard:
             return max(4.0 * p99, self.stall_floor_s)
         return self.stall_floor_s
 
+    def snapshot(self) -> dict:
+        """Scrapeable retry/stall state for the live train board
+        (obs/board.py provider hook).  ``_deadline_s`` takes the lock
+        itself, so it is resolved BEFORE the state read — never while
+        holding it."""
+        deadline = self._deadline_s()
+        with self._lock:
+            return {
+                "active": self.active,
+                "policy": self.policy,
+                "retries_budget": self.retries,
+                "retry_count": self.retry_count,
+                "stall_count": self.stall_count,
+                "deadline_s": round(deadline, 3),
+            }
+
     def _on_stall(self, point: str, iteration, t0: float,
                   deadline: float) -> None:
         from .. import obs

@@ -667,6 +667,48 @@ def test_tpu_window_checklist_stubbed(tmp_path):
     assert irows[0]["metrics"]["ingest_rows_per_s"] == 250000.0
 
 
+def test_tpu_window_leg_triage_classes(tmp_path):
+    """ISSUE 17 wedge triage: every non-clean leg gets one of the four
+    classes; a fully clean window gets no triage block at all."""
+    tw = _import_tool("tpu_window")
+    clean = {"rc": 0, "parsed": {"backend": "tpu"}}
+    assert tw.leg_triage(clean) is None
+    # green-but-on-CPU is only a finding on a real (non-dry) window
+    cpu = {"rc": 0, "parsed": {"backend": "cpu"}}
+    assert tw.leg_triage(cpu) == "cpu-fallback"
+    assert tw.leg_triage(cpu, dry_run=True) is None
+    assert tw.leg_triage({"rc": -1, "tail": []}) == "timeout"
+    assert tw.leg_triage({"rc": 1, "wedge_class": "transient",
+                          "tail": []}) == "backend-wedge"
+    # no wedge_class recorded, but the tail still smells like a wedge
+    assert tw.leg_triage({"rc": 1, "tail": ["...", "backend wedge "
+                          "detected"]}) == "backend-wedge"
+    assert tw.leg_triage({"rc": 1, "tail": ["ValueError: bad "
+                          "param"]}) == "failure"
+
+    results = {"bench": {"rc": -1, "tail": []},
+               "bench_serve": {"rc": 1, "wedge_class": "transient",
+                               "tail": []},
+               "trace": {"rc": 0, "parsed": {}}}
+    tri = tw.triage_legs(results)
+    assert tri["legs"] == {"bench": "timeout",
+                           "bench_serve": "backend-wedge"}
+    assert tri["classes"] == ["backend-wedge", "timeout"]
+    assert tw.triage_legs({"trace": {"rc": 0, "parsed": {}}}) is None
+
+    # bench_history surfaces the block in the round's note
+    rec = {"round": 3, "timestamp": "2026-08-07T00:00:00",
+           "backend": "cpu (forced)", "dry_run": True,
+           "parsed": None, "triage": tri, "legs": results}
+    p = tmp_path / "BENCH_manual_r03.json"
+    p.write_text(json.dumps(rec))
+    bh = _import_tool("bench_history")
+    rows = bh.collect([str(p)])
+    assert rows[0]["triage"] == tri["legs"]
+    assert "triage[bench:timeout, bench_serve:backend-wedge]" \
+        in rows[0]["note"]
+
+
 def test_tpu_window_dry_run_end_to_end(tmp_path):
     """Acceptance: `tpu_window.py --dry-run` executes real capture legs
     on CPU and emits a well-formed BENCH_manual artifact + health

@@ -427,6 +427,54 @@ def test_trace_export_empty_stream(tmp_path):
     assert doc["traceEvents"] == []
 
 
+def test_trace_export_straggler_and_reconciliation_tracks():
+    """ISSUE 17: the introspection plane's events render as instants on
+    their own tracks — straggler breaches flagged like drift latches."""
+    import trace_export
+    events = [
+        {"event": "straggler", "t": 10.0, "rank": 1,
+         "phase": "tree growth", "iteration": 4, "ratio": 3.5,
+         "median_s": 0.01, "rank_s": 0.035, "consecutive": 3,
+         "breach": True, "_proc": 0},
+        {"event": "reconciliation", "t": 11.0, "iteration": 5,
+         "units": {"partition": {"measured_s": 0.02, "modeled_s": 0.01,
+                                 "ratio": 2.0}}, "_proc": 0},
+    ]
+    doc = trace_export.events_to_chrome(events)
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    st = next(e for e in xs if e["args"]["trace_id"] == "ops/straggler")
+    # a straggler always carries breach=True -> the BREACH suffix
+    assert st["name"] == "straggler/BREACH"
+    assert st["args"]["rank"] == 1 and st["args"]["ratio"] == 3.5
+    assert st["args"]["synthesized"] is True
+    rc = next(e for e in xs if e["args"]["trace_id"] == "ops/reconcile")
+    assert rc["name"] == "reconciliation"
+    assert rc["args"]["iteration"] == 5
+    # the nested units dict is not a scalar: filtered from attrs, not
+    # a crash
+    assert "units" not in rc["args"]
+
+
+def test_trace_export_unknown_event_kind_roundtrips():
+    """An event kind the exporter has never heard of must pass through
+    without crashing — future planes can add kinds freely."""
+    import trace_export
+    events = [
+        {"event": "from_the_future", "t": 1.0, "payload": {"a": [1, 2]},
+         "_proc": 0},
+        {"event": "reconciliation", "t": 2.0, "iteration": 1,
+         "units": {}, "_proc": 0},
+    ]
+    doc = trace_export.events_to_chrome(events)   # must not raise
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert all(e["args"]["trace_id"] != "from_the_future" for e in xs)
+    # and the schema validator skips unknown kinds instead of flagging
+    # them
+    from lightgbm_tpu.obs.report import validate_events
+    problems = validate_events(events)
+    assert not any("from_the_future" in p for p in problems)
+
+
 # ---------------------------------------------------------------------------
 # training iteration spans (same schema, same timeline)
 # ---------------------------------------------------------------------------

@@ -159,6 +159,19 @@ def _round_tag(path: str, payload: dict) -> str:
     return f"r{int(n):02d}" if isinstance(n, int) else os.path.basename(path)
 
 
+def _apply_triage(row: dict, payload: dict) -> None:
+    """Fold the window's own failure classification (ISSUE 17) into the
+    row: WHY a round produced no clean point — rendered verbatim so a
+    timeout round never reads like a code regression."""
+    tri = payload.get("triage")
+    if not (isinstance(tri, dict) and tri.get("legs")):
+        return
+    row["triage"] = dict(tri["legs"])
+    legs_s = ", ".join(f"{k}:{v}" for k, v in sorted(tri["legs"].items()))
+    row["note"] = ((row.get("note", "") + "; ") if row.get("note")
+                   else "") + f"triage[{legs_s}]"
+
+
 def load_round(path: str) -> dict:
     """One trajectory row from a bench-round file or a telemetry digest.
 
@@ -170,8 +183,12 @@ def load_round(path: str) -> dict:
     row = {"round": _round_tag(path, payload), "path": path, "metrics": {}}
     parsed = payload.get("parsed", payload)
     if parsed is None:
+        # the fully-failed window: no bench line at all — the triage
+        # block (when the window wrote one) is the only story the row
+        # can tell
         row["note"] = "no parsed bench line"
         row["context"] = None
+        _apply_triage(row, payload)
         return row
     if parsed.get("kind") == "ingest":  # a tools/ingest_bench.py round
         row["context"] = ("ingest", parsed.get("backend"),
@@ -315,6 +332,9 @@ def load_round(path: str) -> dict:
         # as a trajectory point (VERDICT round-5 weak #4).
         row["canary"] = str(backend)
         row["note"] = f"{backend} canary — excluded from baselines"
+    # triage comes after the canary note, which assigns rather than
+    # appends
+    _apply_triage(row, payload)
     for k, v in parsed.items():
         if isinstance(v, bool) or k == "n":
             continue

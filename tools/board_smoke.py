@@ -1,0 +1,179 @@
+"""Train-board exporter smoke — the ``board`` suite tier (ISSUE 17).
+
+Runs a short CPU train with the train-side metrics exporter armed
+(``tpu_train_metrics_port=0`` → ephemeral port) while a poller thread
+scrapes ``GET /metrics`` and ``GET /progress`` concurrently, then
+proves the introspection plane end to end:
+
+- **board_started / board_stopped**: the engine arms the exporter and
+  tears it down with the run;
+- **prometheus_parses**: the text exposition parses through the SAME
+  reader the serving plane uses (``serve.metrics.parse_prometheus``)
+  and carries the train series (iteration, eta, row_iters_per_s);
+- **progress_fields**: /progress answers with the full JSON contract
+  (iteration/total_rounds/eta_s/recent/checkpoint/...);
+- **iteration_advances**: successive scrapes see the iteration move;
+- **eta_converging**: every sampled ETA is finite and the estimate
+  shrinks as the run completes (smoothed, so monotone within slack);
+- **flight_endpoint**: /debug/flight serves the live ring;
+- **overhead_ok**: train-thread seconds spent inside the board hook
+  stay under 5% of train wall — the same off-path guard
+  tests/test_obs.py pins for the telemetry sink.
+
+    python tools/board_smoke.py --json
+
+Last stdout line is the ``{"ok": ..., "checks": ...}`` verdict map
+(the tools/run_suite.py tool-tier contract).  Exit 0 iff all pass.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+import threading
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# the env override beats the config knob — pin it so an outer
+# LGBM_TPU_TRAIN_METRICS=off can't turn the smoke into a no-op
+os.environ["LGBM_TPU_TRAIN_METRICS"] = "0"
+
+ROUNDS = 20
+POLL_S = 0.02
+PROGRESS_KEYS = ("iteration", "total_rounds", "start_round", "eta_s",
+                 "ema_iter_s", "row_iters_per_s", "recent", "checkpoint",
+                 "uptime_s")
+
+
+def _fetch(url: str, timeout: float = 3.0) -> bytes:
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read()
+
+
+def run_smoke() -> dict:
+    import numpy as np
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.obs import board
+    from lightgbm_tpu.serve.metrics import parse_prometheus
+
+    rng = np.random.default_rng(7)
+    X = rng.normal(size=(4000, 12))
+    y = (X[:, 0] + 0.4 * X[:, 1] - 0.2 * X[:, 2] > 0).astype(np.float64)
+    params = {"objective": "binary", "num_leaves": 15,
+              "min_data_in_leaf": 5, "verbose": -1,
+              "tpu_train_metrics_port": 0}
+    ds = lgb.Dataset(X, label=y, params=params)
+
+    samples = []          # (t, iteration, eta_s) per successful scrape
+    state = {"board": None, "metrics": None, "progress": None,
+             "flight": None, "errors": 0, "stop": False}
+
+    def poll():
+        while not state["stop"]:
+            b = board.current()
+            if b is None or not b.port:
+                time.sleep(POLL_S)
+                continue
+            state["board"] = b
+            try:
+                mtext = _fetch(b.url + "/metrics").decode()
+                pr = json.loads(_fetch(b.url + "/progress"))
+                state["metrics"] = mtext
+                state["progress"] = pr
+                if state["flight"] is None:
+                    state["flight"] = json.loads(
+                        _fetch(b.url + "/debug/flight"))
+                if pr.get("iteration") is not None:
+                    samples.append((time.time(), int(pr["iteration"]),
+                                    pr.get("eta_s")))
+            except Exception:
+                state["errors"] += 1
+            time.sleep(POLL_S)
+
+    poller = threading.Thread(target=poll, daemon=True)
+    poller.start()
+    t0 = time.perf_counter()
+    lgb.train(params, ds, num_boost_round=ROUNDS)
+    wall = time.perf_counter() - t0
+    state["stop"] = True
+    poller.join(timeout=5)
+
+    checks = {}
+    checks["board_started"] = state["metrics"] is not None
+    checks["board_stopped"] = not board.active()
+
+    parsed = {}
+    if state["metrics"]:
+        try:
+            parsed = parse_prometheus(state["metrics"])
+        except Exception:
+            parsed = {}
+    checks["prometheus_parses"] = all(
+        k in parsed for k in ("tpu_train_iteration",
+                              "tpu_train_eta_seconds",
+                              "tpu_train_row_iters_per_s",
+                              "tpu_train_total_rounds"))
+
+    pr = state["progress"] or {}
+    checks["progress_fields"] = all(k in pr for k in PROGRESS_KEYS)
+
+    iters = [s[1] for s in samples]
+    checks["iteration_advances"] = bool(iters) and max(iters) > min(iters)
+
+    etas = [s[2] for s in samples if s[2] is not None]
+    finite = bool(etas) and all(
+        isinstance(e, (int, float)) and math.isfinite(e) and e >= 0
+        for e in etas)
+    # smoothed estimate: require net convergence (last well below the
+    # peak), not strict per-sample monotonicity — the EMA wobbles
+    checks["eta_converging"] = (finite
+                                and etas[-1] <= max(etas) + 1e-9
+                                and etas[-1] < 0.5 * max(etas) + 1e-9)
+
+    fl = state["flight"] or {}
+    checks["flight_endpoint"] = bool(fl.get("enabled")) and \
+        isinstance(fl.get("events"), list)
+
+    b = state["board"]
+    hook_s = float(getattr(b, "hook_s", 0.0)) if b is not None else -1.0
+    checks["overhead_ok"] = b is not None and hook_s < 0.05 * wall
+
+    return {
+        "kind": "board",
+        "t": round(time.time(), 1),
+        "rounds": ROUNDS,
+        "wall_s": round(wall, 3),
+        "hook_s": round(hook_s, 6),
+        "scrapes": len(samples),
+        "scrape_errors": state["errors"],
+        "port": getattr(b, "port", None) if b is not None else None,
+        "eta_first": etas[0] if etas else None,
+        "eta_last": etas[-1] if etas else None,
+        "checks": checks,
+        "ok": all(checks.values()),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Exporter-armed CPU train smoke (board suite tier)")
+    ap.add_argument("--json", action="store_true",
+                    help="print only the JSON verdict line")
+    args = ap.parse_args(argv)
+    record = run_smoke()
+    if not args.json:
+        for k, v in record["checks"].items():
+            print(f"  {'PASS' if v else 'FAIL'}  {k}")
+    print(json.dumps(record))
+    return 0 if record["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -121,6 +121,20 @@ ENV_VARS = [
      "overload storm, a `TrainingHealthError`/divergence abort, or on "
      "demand via `GET /debug/flight`.  `LGBM_TPU_FLIGHT_DIR` chooses "
      "the dump directory (default: the working directory)."),
+    ("LGBM_TPU_TRAIN_METRICS",
+     "train-side metrics exporter port (overrides the "
+     "`tpu_train_metrics_port` parameter): `0` binds an ephemeral "
+     "port, `N>0` binds `N + process_index` (each rank of a multi-host "
+     "run exports locally without colliding), `off`/`false`/`-1` "
+     "disarms.  While a train runs, `GET /metrics` serves the "
+     "Prometheus exposition (iteration, ETA, cumulative "
+     "`row_iters_per_s`, per-phase wall fractions, checkpoint age, "
+     "watchdog/retry/stall counters, recompiles, collective bytes, "
+     "straggler skew, measured-vs-model reconciliation ratios), "
+     "`GET /progress` the JSON progress view (smoothed ETA, last-K "
+     "iteration records, live `vs_baseline`), and `GET /debug/flight` "
+     "the live flight ring.  `tools/train_watch.py <url>` tails it as "
+     "a console view."),
     ("LGBM_TPU_SERVE_SLO_P99_MS",
      "serving-engine override for `tpu_serve_slo_p99_ms` — the p99 "
      "latency objective the `/metrics` + `/health` SLO-burn gauge "

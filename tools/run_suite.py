@@ -99,6 +99,16 @@ vs the single-sketch oracle, and a label-flipped quality window
 dropping windowed AUC past ``tpu_quality_drop_warn`` with the breach
 annotated in the registry.  Its ``DRIFT_r*.json`` carries
 ``drift_psi_max`` / ``quality_auc_delta`` for ``bench_history``.
+
+The ``board`` tier (ISSUE 17) runs ``tools/board_smoke.py --json``:
+the live-training-introspection smoke — a short CPU train with the
+train-side metrics exporter armed (``tpu_train_metrics_port=0``) while
+a concurrent poller scrapes it: the Prometheus exposition parses
+through the SAME reader the serving plane uses
+(``serve.metrics.parse_prometheus``), ``/progress`` answers the full
+JSON contract with a finite, converging ETA, ``/debug/flight`` serves
+the live ring, and the train-thread seconds spent inside the board
+hook stay under the 5% off-path overhead guard.
 """
 from __future__ import annotations
 
@@ -205,6 +215,12 @@ _TOOL_TIERS = {
     # DRIFT_rN.json carries drift_psi_max / quality_auc_delta for
     # bench_history
     "drift": ["drift_report.py", "--smoke", "--json"],
+    # live training introspection (ISSUE 17): exporter-armed CPU train
+    # scraped concurrently — Prometheus exposition parses through the
+    # shared serve reader, /progress carries a finite converging ETA,
+    # the flight endpoint answers, and the board hook stays inside the
+    # 5% off-path overhead guard
+    "board": ["board_smoke.py", "--json"],
 }
 
 
@@ -259,13 +275,14 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="Run the quick/slow test tiers and write SUITE_rN.json")
     ap.add_argument("--tiers", default="quick,slow,serve,faults,chaos,"
-                                       "online,ingest,drift",
+                                       "online,ingest,drift,board",
                     help="comma list of tiers: pytest markers plus the "
                          "built-in 'serve' smoke, 'faults' matrix, "
                          "'chaos' serving-chaos, 'online' closed-loop, "
-                         "'ingest' streaming-ingestion and 'drift' "
-                         "monitoring legs (default quick,slow,serve,"
-                         "faults,chaos,online,ingest,drift)")
+                         "'ingest' streaming-ingestion, 'drift' "
+                         "monitoring and 'board' train-introspection "
+                         "legs (default quick,slow,serve,"
+                         "faults,chaos,online,ingest,drift,board)")
     ap.add_argument("--select", default="",
                     help="pytest collection target (file or node id) "
                          "instead of the whole tests/ dir")

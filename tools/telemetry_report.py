@@ -1,19 +1,12 @@
-"""Merge LGBM_TPU_TELEMETRY JSONL files into a per-phase / per-iteration
-summary.
+"""Deprecated shim: the telemetry summarizer now lives at
+``python -m lightgbm_tpu.obs.report <path> [--json]`` (the CLI moved
+into the library so the report, its renderer, and its schemas version
+together).  This wrapper keeps existing invocations working:
 
-Usage:
     python tools/telemetry_report.py <path> [--json]
-
-``<path>`` is the telemetry directory (merges every
-``telemetry.{process_index}.jsonl`` in it), a single ``.jsonl`` file, or
-a glob.  Default output is a human-readable table; ``--json`` prints the
-machine-readable digest (the same shape bench.py embeds as its
-``telemetry`` field).
 """
 from __future__ import annotations
 
-import argparse
-import json
 import os
 import sys
 
@@ -21,31 +14,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 # note: import the submodule explicitly — lightgbm_tpu.obs exports a
 # report() FUNCTION (the timetag phase report) under the same name
-from lightgbm_tpu.obs.report import (load_events, render,  # noqa: E402
-                                     summarize, telemetry_files)
-
-
-def main() -> int:
-    ap = argparse.ArgumentParser(
-        description="Summarize lightgbm_tpu telemetry JSONL files")
-    ap.add_argument("path", help="telemetry dir, one .jsonl file, or a glob")
-    ap.add_argument("--json", action="store_true",
-                    help="print the machine-readable digest instead of "
-                         "the table")
-    args = ap.parse_args()
-
-    files = telemetry_files(args.path)
-    if not files:
-        print(f"no telemetry files under {args.path!r}", file=sys.stderr)
-        return 1
-    digest = summarize(load_events(args.path))
-    if args.json:
-        print(json.dumps(digest))
-    else:
-        print(f"merged {len(files)} file(s)")
-        print(render(digest))
-    return 0
-
+from lightgbm_tpu.obs.report import main  # noqa: E402
 
 if __name__ == "__main__":
+    print("note: tools/telemetry_report.py is a shim; use "
+          "`python -m lightgbm_tpu.obs.report` directly", file=sys.stderr)
     sys.exit(main())

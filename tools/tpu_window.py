@@ -62,7 +62,13 @@ Artifacts (``--out``, default repo root):
 - ``BENCH_manual_r{N}.json`` — one bench_history.py-compatible record:
   the clean bench's parsed JSON line (which now embeds
   ``health_checks``/``health_failures``) plus every leg's rc/seconds/
-  parsed output and the merged health summary;
+  parsed output and the merged health summary.  Since ISSUE 17 the
+  headline leg runs with the train-side metrics exporter armed
+  (``LGBM_TPU_TRAIN_METRICS``) and a mid-leg scraper embeds the live
+  ``/progress`` snapshot + measured-vs-model ``reconciliation`` table
+  at top level, and a ``triage`` block classifies every non-clean leg
+  (``timeout`` / ``backend-wedge`` / ``cpu-fallback`` / ``failure``)
+  so the record says WHY a window yielded no clean point;
 - ``HEALTH_manual_r{N}.json`` — the health/fingerprint/divergence digest
   per leg + event-schema validation verdict;
 - ``tpu_window_r{N}/`` — per-leg telemetry dirs + the profiler trace.
@@ -81,9 +87,12 @@ import glob
 import json
 import os
 import re
+import socket
 import subprocess
 import sys
+import threading
 import time
+import urllib.request
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -162,6 +171,15 @@ def next_round(out_dir: str) -> int:
     return n + 1
 
 
+def _free_port() -> int:
+    """A currently-free TCP port for the bench leg's train board — the
+    subprocess needs a KNOWN port (ephemeral 0 would hide it from the
+    mid-leg scraper).  Tiny bind race, acceptable for a manual tool."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
 def checklist_legs(art_dir: str, dry_run: bool, py: str = sys.executable):
     """The ROOFLINE.md first-window checklist as (name, argv, env) legs.
     Every leg runs with health monitoring on and its own telemetry dir,
@@ -190,9 +208,17 @@ def checklist_legs(art_dir: str, dry_run: bool, py: str = sys.executable):
     trace_env = {"LGBM_TPU_HEALTH": "monitor"}
     if dry_run:
         trace_env["JAX_PLATFORMS"] = "cpu"
+    # the headline leg runs with the train-side metrics exporter armed
+    # (ISSUE 17): the window scrapes /metrics + /progress MID-LEG and
+    # embeds the live measured-vs-model reconciliation table into
+    # BENCH_manual_rN — proof the introspection plane works on the real
+    # backend, not just in the CPU smoke
+    board_port = _free_port()
     return [
         {"name": "bench", "argv": [py, bench],
-         "env": env_for("bench"), "parse_json": True},
+         "env": env_for("bench",
+                        {"LGBM_TPU_TRAIN_METRICS": str(board_port)}),
+         "scrape_port": board_port, "parse_json": True},
         {"name": "bench_profile", "argv": [py, bench],
          "env": env_for("bench_profile", {"LGBM_TPU_PROFILE": "1"}),
          "parse_json": True},
@@ -297,6 +323,96 @@ def _run_one(leg, runner, timeout):
         return -2, "", f"{type(exc).__name__}: {exc}", False
 
 
+def _scrape_board(port: int, state: dict, stop: threading.Event,
+                  poll_s: float = 0.15) -> None:
+    """Poller thread body: keep the LAST successful /progress +
+    /metrics snapshot from a leg's train board.  Misses are normal
+    (the board only exists while the subprocess trains)."""
+    base = f"http://127.0.0.1:{port}"
+    while not stop.is_set():
+        try:
+            with urllib.request.urlopen(base + "/progress",
+                                        timeout=2) as resp:
+                pr = json.loads(resp.read())
+            state["progress"] = pr
+            if pr.get("reconciliation"):
+                # bench arms several boards back to back (headline +
+                # embedded rank leg); keep the last snapshot that
+                # carries the reconciliation table so a later tiny
+                # leg's board can't blank the embed
+                state["progress_recon"] = pr
+            with urllib.request.urlopen(base + "/metrics",
+                                        timeout=2) as resp:
+                state["metrics_text"] = resp.read().decode()
+            state["scrapes"] = state.get("scrapes", 0) + 1
+        except Exception:
+            pass
+        stop.wait(poll_s)
+
+
+def _board_snapshot(state: dict):
+    """Trim a scraped board state into the record's ``board`` block:
+    the reconciliation table + headline progress, plus proof the
+    exposition parses through the shared serve reader."""
+    pr = state.get("progress_recon") or state.get("progress")
+    if not pr:
+        return None
+    snap = {
+        "scrapes": state.get("scrapes", 0),
+        "iteration": pr.get("iteration"),
+        "total_rounds": pr.get("total_rounds"),
+        "eta_s": pr.get("eta_s"),
+        "row_iters_per_s": pr.get("row_iters_per_s"),
+        "vs_baseline": pr.get("vs_baseline"),
+        "reconciliation": pr.get("reconciliation"),
+        "stragglers": pr.get("stragglers"),
+    }
+    mtext = state.get("metrics_text")
+    if mtext:
+        try:
+            from lightgbm_tpu.serve.metrics import parse_prometheus
+            snap["metrics_series"] = len(parse_prometheus(mtext))
+        except Exception:
+            snap["metrics_series"] = None
+    return snap
+
+
+def leg_triage(rec: dict, dry_run: bool = False):
+    """Why did this leg not yield a clean point?  ``None`` for a clean
+    leg; else one of ``timeout`` (the subprocess hit the window's
+    deadline), ``backend-wedge`` (transient runtime failure shape —
+    robust/watchdog.py classify_text — that exhausted its retries),
+    ``cpu-fallback`` (ran green but on the CPU backend, so the number
+    is not a device point), or ``failure`` (a real error: retrying
+    would only repeat it)."""
+    parsed = rec.get("parsed") or {}
+    if rec.get("rc", 1) == 0:
+        if not dry_run and parsed.get("backend") == "cpu":
+            return "cpu-fallback"
+        return None
+    if rec.get("rc") == -1:
+        return "timeout"
+    if rec.get("wedge_class"):
+        return "backend-wedge"
+    from lightgbm_tpu.robust.watchdog import classify_text
+    tail = "\n".join(rec.get("tail") or [])
+    if classify_text(tail) is not None:
+        return "backend-wedge"
+    return "failure"
+
+
+def triage_legs(results: dict, dry_run: bool = False):
+    """The record's top-level ``triage`` block (ISSUE 17): per-leg
+    classification of every non-clean leg so bench_history.py can say
+    WHY a window produced no clean point.  ``None`` when every leg was
+    clean (the block's absence IS the clean signal)."""
+    legs = {name: cls for name, rec in results.items()
+            for cls in [leg_triage(rec, dry_run=dry_run)] if cls}
+    if not legs:
+        return None
+    return {"legs": legs, "classes": sorted(set(legs.values()))}
+
+
 def run_legs(legs, runner=subprocess.run, timeout: int = 1800,
              wedge_retries: int = 1, backoff_s: float = 5.0):
     """Run the checklist legs; a leg that dies in a WEDGE-shaped way
@@ -316,6 +432,15 @@ def run_legs(legs, runner=subprocess.run, timeout: int = 1800,
               flush=True)
         attempts = 0
         wedge_class = None
+        scrape_state, scrape_stop = None, None
+        if leg.get("scrape_port"):
+            # mid-leg board scrape (ISSUE 17): runs across retries too —
+            # the last snapshot before a wedge is still a diagnostic
+            scrape_state, scrape_stop = {}, threading.Event()
+            threading.Thread(
+                target=_scrape_board,
+                args=(leg["scrape_port"], scrape_state, scrape_stop),
+                daemon=True).start()
         delays = backoff_delays(max(wedge_retries, 0), base_s=backoff_s,
                                 cap_s=8 * backoff_s)
         while True:
@@ -333,6 +458,11 @@ def run_legs(legs, runner=subprocess.run, timeout: int = 1800,
             time.sleep(delay)
             attempts += 1
         rec = {"rc": rc, "seconds": round(time.time() - t0, 1)}
+        if scrape_stop is not None:
+            scrape_stop.set()
+            board = _board_snapshot(scrape_state)
+            if board is not None:
+                rec["board"] = board
         if attempts:
             rec["wedge_retries"] = attempts
             rec["wedge_class"] = wedge_class
@@ -437,6 +567,16 @@ def run_checklist(out_dir: str, n: int, dry_run: bool,
                              for r in results.values()
                              if r.get("recovered")),
         "health": health,
+        # live-introspection embed (ISSUE 17): the mid-leg board scrape
+        # of the headline bench — its measured-vs-model reconciliation
+        # table rides in the manual record so a TPU window prices the
+        # cost models against real device walls
+        "board": (results.get("bench") or {}).get("board"),
+        "reconciliation": ((results.get("bench") or {}).get("board")
+                           or {}).get("reconciliation"),
+        # wedge triage (ISSUE 17): why each non-clean leg failed —
+        # absent when the window was clean
+        "triage": triage_legs(results, dry_run=dry_run),
         "trace_dir": os.path.relpath(trace_dir, out_dir),
         "trace_files": sum(len(fs) for _, _, fs in os.walk(trace_dir)),
         "artifacts_dir": os.path.relpath(art_dir, out_dir),
@@ -515,6 +655,18 @@ def run_checklist(out_dir: str, n: int, dry_run: bool,
     print(f"# health: {health['verdict']} "
           f"({health['failures']} failures, schema "
           f"{'ok' if health['events_ok'] else 'PROBLEMS'})")
+    if record["triage"]:
+        tr = record["triage"]
+        legs_s = ", ".join(f"{k}={v}" for k, v in sorted(
+            tr["legs"].items()))
+        print(f"# triage: {legs_s}")
+    if record["board"]:
+        b = record["board"]
+        rec_units = sorted((b.get("reconciliation") or {})
+                           .get("units", {}) or {})
+        print(f"# board: {b.get('scrapes', 0)} scrapes, iteration "
+              f"{b.get('iteration')}, reconciliation units "
+              f"{rec_units or 'none'}")
     record["bench_path"] = bench_path
     record["health_path"] = health_path
     return record

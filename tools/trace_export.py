@@ -81,6 +81,12 @@ _OPS_TRACKS = {
     "refit": ("ops/online", "wall_s", 1e3),
     "drift_snapshot": ("ops/drift", None, 0.0),
     "quality_window": ("ops/drift", None, 0.0),
+    # live-introspection plane (ISSUE 17): straggler breaches and the
+    # measured-vs-model reconciliation cadence as instants on their own
+    # tracks (a straggler event always carries breach=True, so it
+    # renders as .../BREACH like a drift latch)
+    "straggler": ("ops/straggler", None, 0.0),
+    "reconciliation": ("ops/reconcile", None, 0.0),
 }
 
 

@@ -1,0 +1,180 @@
+"""Tail a training run as one line per boosting iteration (ISSUE 17).
+
+Two sources, same console view:
+
+- **a live run**: point at the train board's base URL (the
+  ``tpu_train_metrics_port`` / ``LGBM_TPU_TRAIN_METRICS`` exporter the
+  engine arms; the URL is logged at train start) and the watcher polls
+  ``GET /progress``, printing each NEW iteration from the ``recent``
+  ring plus an ETA/vs-baseline footer when the run finishes or the
+  board goes away;
+- **a finished (or still-writing) telemetry dir**: point at a
+  ``LGBM_TPU_TELEMETRY`` sink (dir or single ``.jsonl``) and the
+  watcher renders its ``iteration`` events; ``--follow`` keeps
+  re-reading so a live run's sink tails like ``tail -f``.
+
+    python tools/train_watch.py http://127.0.0.1:9187
+    python tools/train_watch.py /tmp/telem
+    python tools/train_watch.py /tmp/telem --follow
+
+Line format (``format_iteration``)::
+
+    iter    42/500  0.213s  1.23e+07 row-it/s  valid_0.auc=0.9312  [recompiled]
+
+Exit code 0; 1 when the source yields nothing (bad URL / empty dir).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+POLL_S = 0.5          # /progress + --follow poll cadence
+_METRIC_KEYS = 2      # metrics shown per line before "..."
+
+
+def format_iteration(rec: dict, total=None) -> str:
+    """One console line for an iteration record — accepts both a board
+    ``/progress`` ``recent`` entry and a telemetry ``iteration`` event
+    (same field names: iteration / iter_s / metrics / recompiles /
+    cum_row_iters_per_s)."""
+    it = rec.get("iteration")
+    head = f"iter {it if it is not None else '?':>5}"
+    if total:
+        head += f"/{int(total)}"
+    it_s = rec.get("iter_s")
+    parts = [head, f"{it_s:.3f}s" if it_s is not None else "?s"]
+    rps = rec.get("cum_row_iters_per_s")
+    if rps:
+        parts.append(f"{float(rps):.2e} row-it/s")
+    metrics = rec.get("metrics") or {}
+    for k in sorted(metrics)[:_METRIC_KEYS]:
+        try:
+            parts.append(f"{k}={float(metrics[k]):.4f}")
+        except (TypeError, ValueError):
+            parts.append(f"{k}={metrics[k]}")
+    if len(metrics) > _METRIC_KEYS:
+        parts.append("...")
+    if rec.get("recompiles"):
+        parts.append("[recompiled]")
+    return "  ".join(parts)
+
+
+def _fmt_eta(eta_s) -> str:
+    if eta_s is None:
+        return "?"
+    eta_s = float(eta_s)
+    if eta_s >= 3600:
+        return f"{eta_s / 3600:.1f}h"
+    if eta_s >= 60:
+        return f"{eta_s / 60:.1f}m"
+    return f"{eta_s:.0f}s"
+
+
+def _get_json(url: str, timeout: float = 3.0):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read().decode("utf-8", "replace"))
+
+
+def watch_url(base: str, out=sys.stdout, poll_s: float = POLL_S,
+              max_s: float = 0.0) -> int:
+    """Poll a live board's /progress until the run completes or the
+    exporter stops answering; print each new iteration once."""
+    base = base.rstrip("/")
+    seen = -1
+    printed = 0
+    last = None
+    t0 = time.time()
+    misses = 0
+    while True:
+        try:
+            pr = _get_json(base + "/progress")
+            misses = 0
+        except Exception:
+            misses += 1
+            if misses >= 3:   # board gone: run finished or URL is wrong
+                break
+            time.sleep(poll_s)
+            continue
+        last = pr
+        total = pr.get("total_rounds")
+        for rec in pr.get("recent") or []:
+            it = rec.get("iteration", -1)
+            if it is not None and it > seen:
+                seen = it
+                printed += 1
+                print(format_iteration(rec, total=total), file=out)
+        it_now = pr.get("iteration")
+        if (total and it_now is not None
+                and it_now + 1 >= int(total)):
+            break
+        if max_s and time.time() - t0 > max_s:
+            break
+        time.sleep(poll_s)
+    if last is not None:
+        vsb = last.get("vs_baseline")
+        print(f"-- iteration {last.get('iteration')}"
+              f"/{last.get('total_rounds')}"
+              f"  eta {_fmt_eta(last.get('eta_s'))}"
+              + (f"  vs_baseline {vsb:.3f}" if vsb else ""), file=out)
+    return 0 if printed or last is not None else 1
+
+
+def watch_path(path: str, out=sys.stdout, follow: bool = False,
+               poll_s: float = POLL_S, max_s: float = 0.0) -> int:
+    """Render a telemetry sink's iteration events; --follow re-reads the
+    file set so a still-writing run tails live.  Re-reading (not seek
+    bookkeeping) keeps multi-process sinks (telemetry.{i}.jsonl) simple;
+    these files are small."""
+    from lightgbm_tpu.obs.report import load_events
+
+    seen = -1
+    printed = 0
+    t0 = time.time()
+    while True:
+        events = [e for e in load_events(path)
+                  if e.get("event") == "iteration"]
+        events.sort(key=lambda e: (e.get("iteration") or 0))
+        for e in events:
+            it = e.get("iteration", -1)
+            if it is not None and it > seen:
+                seen = it
+                printed += 1
+                print(format_iteration(e), file=out)
+        if not follow:
+            break
+        if max_s and time.time() - t0 > max_s:
+            break
+        time.sleep(poll_s)
+    return 0 if printed else 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Tail a live run (board URL) or a telemetry dir as "
+                    "one line per boosting iteration")
+    ap.add_argument("source", help="board base URL (http://host:port) "
+                                   "or telemetry dir / .jsonl file")
+    ap.add_argument("--follow", action="store_true",
+                    help="keep re-reading a telemetry path (live sink)")
+    ap.add_argument("--max-seconds", type=float, default=0.0,
+                    help="stop watching after this long (0 = until done)")
+    args = ap.parse_args(argv)
+    if args.source.startswith(("http://", "https://")):
+        return watch_url(args.source, max_s=args.max_seconds)
+    if not os.path.exists(args.source):
+        print(f"error: no such path or URL: {args.source}",
+              file=sys.stderr)
+        return 1
+    return watch_path(args.source, follow=args.follow,
+                      max_s=args.max_seconds)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
