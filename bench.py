@@ -57,7 +57,8 @@ def _telemetry_digest():
     line (the ISSUE 8 flatten below read an always-absent key)."""
     try:
         from lightgbm_tpu import obs
-        if not (obs.enabled() or obs.profile_enabled()):
+        if not (obs.enabled() or obs.profile_enabled()
+                or obs.xprof_digest()):
             return None
         d = obs.digest()
         try:
@@ -107,6 +108,20 @@ def _embed_observability(result: dict) -> None:
     if kernels:
         result["kernel_roofline"] = {
             k: v["roofline_frac"] for k, v in kernels.items()}
+    # measured roofline (obs/xprof.py): trace-attributed per-kernel
+    # fractions — the MEASURED companion of kernel_roofline's
+    # host-bracketed estimate — plus the compile plane, flattened so
+    # bench_history can trend both round over round
+    xp = (td.get("xprof") or {}).get("kernels") or {}
+    measured = {k: v["roofline_frac"] for k, v in xp.items()
+                if v.get("roofline_frac") is not None}
+    if measured:
+        result["kernel_measured"] = measured
+    comp = td.get("compile") or {}
+    if comp:
+        result["compile_cache_hits"] = comp.get("cache_hits", 0)
+        result["compile_cache_misses"] = comp.get("cache_misses", 0)
+        result["retraces"] = comp.get("retraces", 0)
     wave = td.get("wave_pipeline") or {}
     # flat wave-pipeline stamps: bench_history trends these so a silent
     # histogram-mode downgrade is flagged like a perf regression
@@ -213,20 +228,36 @@ def _measure(params: dict, X, y, group, iters: int, metric_prefix: str):
     # default: resolve_port(None) only honors the env var.
     from lightgbm_tpu.obs import board as _board
     train_board = _board.maybe_start(None, total_rounds=iters)
+    # measured-roofline window (obs/xprof.py): LGBM_TPU_XPROF traces a
+    # few steady-state updates (the compile-warmup update is skipped),
+    # parses + attributes the capture and emits kernel_measured events
+    # that _embed_observability flattens into the JSON line.  The
+    # capture brackets itself inside the timed loop: an xprof bench is
+    # an attribution run, its per_iter is not a headline number.
+    from lightgbm_tpu.obs import xprof as _xprof
+    xprof_win = _xprof.maybe_window(
+        booster.config, context=_xprof.train_context(booster),
+        sync=lambda: jax.block_until_ready(booster._gbdt._train_score))
     try:
         t0 = time.time()
         booster.update()
         jax.block_until_ready(booster._gbdt._train_score)
         compile_time = time.time() - t0
+        if xprof_win is not None:
+            xprof_win.step()  # warmup update: stays outside the window
         t1 = time.time()
         for _ in range(iters - 1):
             booster.update()
+            if xprof_win is not None:
+                xprof_win.step()
         # sync: updates dispatch asynchronously — without this the loop
         # measures enqueue time, not compute (wildly optimistic at
         # small iters)
         jax.block_until_ready(booster._gbdt._train_score)
         per_iter = (time.time() - t1) / max(iters - 1, 1)
     finally:
+        if xprof_win is not None:
+            xprof_win.close()
         if train_board is not None:
             train_board.stop()
     mval = next((v for (_, m, v, _) in booster.eval_train()

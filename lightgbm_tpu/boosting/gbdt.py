@@ -672,6 +672,11 @@ class GBDT(PredictorBase):
         if obs.profile_enabled():
             self._wrap_profiled()
             obs.memory_snapshot("train_init", buffers=self._census_buffers())
+        elif obs.resolve_window(config):
+            # xprof plane armed without profile mode: the jit units
+            # still get their retrace/capture wrappers (profile_wrap is
+            # identity-plus-watcher when profiling is off)
+            self._wrap_profiled()
         if obs.enabled():
             obs.event("train_start", num_data=N,
                       num_features=train_ds.num_features, num_class=K,

@@ -429,6 +429,22 @@ class Config:
                                         # tpu_telemetry); breaks async
                                         # pipelining — attribution runs
                                         # only, never benchmarks
+    tpu_xprof: bool = False             # measured-roofline capture
+                                        # (obs/xprof.py): arm a windowed
+                                        # jax.profiler trace around
+                                        # tpu_xprof_iters mid-train
+                                        # iterations (warmup/compile
+                                        # iteration skipped), parse the
+                                        # trace, attribute device ops by
+                                        # lgbm/* scope and emit
+                                        # kernel_measured roofline events
+                                        # into the telemetry dir.
+                                        # LGBM_TPU_XPROF env wins: 1/true
+                                        # arms, a number > 1 sets the
+                                        # window width, 0/false disarms
+    tpu_xprof_iters: int = 3            # captured iterations per xprof
+                                        # window when tpu_xprof is armed
+                                        # (LGBM_TPU_XPROF=<n> overrides)
     tpu_trace: bool = False             # trace mode (obs/spans.py): emit
                                         # span events (trace_id/span_id/
                                         # parent_id, one schema for serve

@@ -21,6 +21,11 @@ model-state fingerprints, and the cross-rank divergence audit.
 Perfetto by ``tools/trace_export.py``; ``LGBM_TPU_FLIGHT=<n>`` (or
 ``tpu_flight_len``) sizes the flight recorder ring dumped as
 ``FLIGHT_rN.json`` on degradations and health aborts.
+``LGBM_TPU_XPROF=1`` (or ``tpu_xprof``) arms the measured-roofline
+plane (``xprof``): a windowed ``jax.profiler`` capture around a few
+mid-train iterations, parsed and attributed per ``lgbm/*`` scope into
+``kernel_measured`` events, plus compile walls / cache traffic /
+retrace attribution as ``compile`` events.
 """
 from .board import TrainBoard
 from .board import active as board_active
@@ -51,6 +56,11 @@ from .spans import (Span, begin_span, current_context, emit_span,
                     span_record_enabled, trace_enabled)
 from .ranks import RankAggregator, Reconciler, StragglerDetector, skew_table
 from .trace import compile_count, compile_seconds, install_recompile_hook
+from .xprof import (WindowedCapture, attribute, compile_digest,
+                    install_compile_observer, maybe_window,
+                    measured_rooflines, parse_trace_dir, record_measured,
+                    resolve_trace_dir, resolve_window, trace_files,
+                    train_context, watch_jit, xprof_digest)
 
 __all__ = [
     "TIMETAG_ENABLED", "add", "count", "counter_value",
@@ -75,4 +85,9 @@ __all__ = [
     "new_trace_id", "span", "span_record_enabled", "trace_enabled",
     "TrainBoard", "board_active", "train_board",
     "RankAggregator", "Reconciler", "StragglerDetector", "skew_table",
+    "WindowedCapture", "attribute", "compile_digest",
+    "install_compile_observer", "maybe_window", "measured_rooflines",
+    "parse_trace_dir", "record_measured", "resolve_trace_dir",
+    "resolve_window", "trace_files", "train_context", "watch_jit",
+    "xprof_digest",
 ]

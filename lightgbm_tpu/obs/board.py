@@ -44,7 +44,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from ..utils import log
-from . import core, spans
+from . import core, spans, xprof
 
 # bench.py REF_ROW_ITERS_PER_SEC (HIGGS 10.5M rows x 500 iters / 238.5s
 # reference GPU wall) — the fallback denominator while BASELINE.json
@@ -382,6 +382,26 @@ class TrainBoard:
               "Seconds spent in XLA compilation this process.")
         out.append("tpu_train_compile_seconds_total "
                    + _fmt(round(core.counter_value("jax/compile_s"), 3)))
+        _head(out, "tpu_train_compile_cache_hits_total", "counter",
+              "Persistent compile-cache hits this process.")
+        out.append("tpu_train_compile_cache_hits_total "
+                   + _fmt(core.counter_value("jax/compile_cache_hits")))
+        _head(out, "tpu_train_compile_cache_misses_total", "counter",
+              "Persistent compile-cache misses this process.")
+        out.append("tpu_train_compile_cache_misses_total "
+                   + _fmt(core.counter_value("jax/compile_cache_misses")))
+        _head(out, "tpu_train_retraces_total", "counter",
+              "Jit retraces attributed to an argument-signature change.")
+        out.append("tpu_train_retraces_total "
+                   + _fmt(core.counter_value("jax/retraces")))
+        comp = xprof.compile_digest()
+        if comp.get("by_jit"):
+            _head(out, "tpu_train_compile_wall_seconds", "counter",
+                  "Backend-compile wall seconds attributed per jit "
+                  "(dispatching phase).")
+            for jit, ent in sorted(comp["by_jit"].items()):
+                out.append('tpu_train_compile_wall_seconds{jit="%s"} %s'
+                           % (jit, _fmt(ent.get("wall_s"))))
         coll = [(k, v) for k, v in core.counters_snapshot().items()
                 if k.startswith("collective/") and k.endswith("bytes")]
         _head(out, "tpu_train_collective_bytes_total", "counter",
@@ -463,6 +483,9 @@ class TrainBoard:
         core._set_board_hook(self._note)
         from .trace import install_recompile_hook
         install_recompile_hook()
+        # compile-plane gauges (cache hits/misses, per-jit walls) need
+        # the jax.monitoring listeners live for the board's lifetime
+        xprof.install_compile_observer()
         if not spans.flight_enabled():
             # the board's /debug/flight and the straggler dump both
             # want a ring; arm the default size unless the env says no

@@ -434,12 +434,19 @@ def digest() -> dict:
     }
     from .memory import memory_digest
     from .profile import profile_digest
+    from .xprof import compile_digest, xprof_digest
     kernels = profile_digest()
     if kernels:
         d["kernels"] = kernels
     mem = memory_digest()
     if mem:
         d["memory"] = mem
+    xp = xprof_digest()
+    if xp:
+        d["xprof"] = xp
+    comp = compile_digest()
+    if comp:
+        d["compile"] = comp
     return d
 
 

@@ -238,12 +238,19 @@ class _Profiled:
 
 def wrap(name: str, fn):
     """Instrument a jitted callable under ``lgbm/<name>``-style naming.
-    Identity when profiling is off — the disabled path costs nothing."""
-    if not _on or fn is None:
+    Identity when profiling is off — the disabled path costs nothing.
+
+    When the xprof plane is armed, the retrace watcher composes outside
+    the profiled wrapper (the wrapper still needs the raw ``lower()``),
+    so every ``wrap`` point gets retrace attribution for free."""
+    if fn is None:
         return fn
-    if isinstance(fn, _Profiled):
+    from . import xprof  # lazy: avoids import work on the off path
+    if isinstance(fn, xprof._Watched):  # already fully wrapped
         return fn
-    return _Profiled(name, fn)
+    if _on and not isinstance(fn, _Profiled):
+        fn = _Profiled(name, fn)
+    return xprof.watch_jit(name, fn)
 
 
 def profile_digest() -> dict:

@@ -279,3 +279,22 @@ class Reconciler:
                               self._roofline(flops, nbytes))
         except Exception:  # noqa: BLE001 — scoring must not fail predict
             return None
+
+    def score_measured(self, rows) -> Optional[dict]:
+        """Fold ``kernel_measured`` rows (obs/xprof.py) into the same
+        ``units`` shape ``score`` emits — one unit per trace-attributed
+        kernel that carries a model join.  Where ``score`` ratios a
+        coarse host phase wall against the models, this ratios the
+        per-kernel trace truth: the two agreeing is the cost model
+        validated end to end; diverging, the phase wall is hiding
+        dispatch gaps or unattributed work."""
+        units = {}
+        for row in rows or ():
+            model_ms = row.get("model_ms")
+            if not model_ms:
+                continue
+            u = self._unit(float(row.get("measured_ms", 0.0)) / 1e3,
+                           float(model_ms) / 1e3)
+            if u:
+                units[row.get("kernel", "?")] = u
+        return units or None

@@ -175,6 +175,12 @@ def summarize(events: List[dict]) -> dict:
     kernels = kernel_summary(events)
     if kernels:
         out["kernels"] = kernels
+    xp = xprof_summary(events)
+    if xp:
+        out["xprof"] = xp
+    comp = compile_summary(events)
+    if comp:
+        out["compile"] = comp
     mem = memory_summary(events)
     if mem:
         out["memory"] = mem
@@ -301,6 +307,82 @@ def kernel_summary(events: List[dict]) -> dict:
         a["roofline_s"] = round(a["roofline_s"], 9)
         a["roofline_frac"] = round(a["roofline_s"] / ach, 6) if ach else 0.0
     return dict(sorted(agg.items()))
+
+
+def xprof_summary(events: List[dict]) -> dict:
+    """Aggregate ``kernel_measured`` events (obs/xprof.py) per kernel:
+    attributed op count, trace-measured ms, and — for scopes with an
+    analytic model — the cost-model ms, roofline fraction and
+    HBM/MXU boundedness.  Unattributed residual rows keep their device
+    label so multi-device windows stay distinguishable.  This is the
+    MEASURED column of docs/ROOFLINE.md; ``kernel_summary`` above is
+    the host-sync-bracketed estimate from profile mode."""
+    agg: dict = {}
+    window = 0.0
+    for e in events:
+        if e.get("event") != "kernel_measured":
+            continue
+        key = e.get("kernel", "?")
+        if key == "unattributed" and e.get("device"):
+            key = "unattributed(%s)" % e["device"]
+        a = agg.setdefault(key, {"ops": 0, "measured_ms": 0.0})
+        a["ops"] += int(e.get("ops", 0) or 0)
+        a["measured_ms"] += float(e.get("measured_ms", 0.0) or 0.0)
+        for f in ("model_ms", "roofline_frac", "bound",
+                  "occupancy", "model"):
+            if e.get(f) is not None:
+                a[f] = e[f]
+        window = max(window, float(e.get("window_ms", 0.0) or 0.0))
+    if not agg:
+        return {}
+    for a in agg.values():
+        a["measured_ms"] = round(a["measured_ms"], 4)
+    return {"window_ms": round(window, 3),
+            "kernels": dict(sorted(agg.items()))}
+
+
+def compile_summary(events: List[dict]) -> dict:
+    """Fold ``compile`` events (obs/xprof.py) into the compile-plane
+    digest: backend-compile count + wall attributed per jit, persistent
+    compile-cache hit/miss traffic, and retraces with the argument
+    signatures that forced them."""
+    out = {"compiles": 0, "wall_s": 0.0, "by_jit": {},
+           "cache_hits": 0, "cache_misses": 0, "retraces": 0}
+    retrace_jits: dict = {}
+    seen = False
+    for e in events:
+        if e.get("event") != "compile":
+            continue
+        seen = True
+        kind = e.get("kind")
+        if kind == "backend_compile":
+            out["compiles"] += 1
+            w = float(e.get("wall_s", 0.0) or 0.0)
+            out["wall_s"] += w
+            ent = out["by_jit"].setdefault(
+                e.get("jit") or "<top>", {"count": 0, "wall_s": 0.0})
+            ent["count"] += 1
+            ent["wall_s"] += w
+        elif kind == "cache_hit":
+            out["cache_hits"] += 1
+        elif kind == "cache_miss":
+            out["cache_misses"] += 1
+        elif kind == "retrace":
+            out["retraces"] += 1
+            jit = e.get("jit") or "?"
+            lst = retrace_jits.setdefault(jit, [])
+            for c in (e.get("changed") or [])[:4]:
+                if c not in lst:
+                    lst.append(c)
+    if not seen:
+        return {}
+    out["wall_s"] = round(out["wall_s"], 4)
+    for ent in out["by_jit"].values():
+        ent["wall_s"] = round(ent["wall_s"], 4)
+    out["by_jit"] = dict(sorted(out["by_jit"].items()))
+    if retrace_jits:
+        out["retrace_jits"] = dict(sorted(retrace_jits.items()))
+    return out
 
 
 def memory_summary(events: List[dict]) -> dict:
@@ -680,6 +762,37 @@ EVENT_SCHEMAS = {
         "roofline_frac": (_NUM, True),
         "device": (str, True),
     },
+    # measured-roofline rows (obs/xprof.py): trace-attributed device-op
+    # time per lgbm/* scope joined against the analytic cost models.
+    # Model fields (flops/bytes/model_ms/roofline_frac/bound/model) are
+    # present only for scopes an analytic model covers; 'unattributed'
+    # residual rows carry measured fields only.
+    "kernel_measured": {
+        "kernel": (str, True),
+        "measured_ms": (_NUM, True),
+        "window_ms": (_NUM, True),
+        "ops": (int, True),
+        "source": (str, True),
+        "device": (str, False),
+        "occupancy": (_NUM, False),
+        "flops": (_NUM, False),
+        "bytes": (_NUM, False),
+        "model": (str, False),
+        "model_ms": (_NUM, False),
+        "roofline_frac": (_NUM, False),
+        "bound": (str, False),
+    },
+    # compile-plane events (obs/xprof.py): kind is backend_compile
+    # (per-jit wall), cache_hit / cache_miss (persistent compile
+    # cache), or retrace (with the argument-signature diff that
+    # forced it)
+    "compile": {
+        "kind": (str, True),
+        "jit": (str, False),
+        "wall_s": (_NUM, False),
+        "changed": (list, False),
+        "signatures": (int, False),
+    },
     "memory_census": {
         "phase": (str, True),
         "buffers": (dict, True),
@@ -1035,6 +1148,37 @@ def render(digest: dict) -> str:
                        f"{k['achieved_s']:>9.3f}s"
                        f"{k['roofline_s']:>9.4f}s"
                        f"{k['roofline_frac']:>8.4f}")
+    if digest.get("xprof"):
+        xp = digest["xprof"]
+        out.append("")
+        out.append(f"measured roofline (xprof window "
+                   f"{xp.get('window_ms', 0):.1f} ms):")
+        out.append(f"{'kernel':<28}{'ops':>6}{'measured':>11}"
+                   f"{'model':>11}{'frac':>8}{'bound':>7}")
+        for name, k in sorted(xp.get("kernels", {}).items(),
+                              key=lambda kv: -kv[1]["measured_ms"]):
+            model_ms = k.get("model_ms")
+            frac = k.get("roofline_frac")
+            out.append(
+                f"{name:<28}{k['ops']:>6}"
+                f"{k['measured_ms']:>9.3f}ms"
+                + (f"{model_ms:>9.3f}ms" if model_ms is not None
+                   else f"{'—':>11}")
+                + (f"{frac:>8.4f}" if frac is not None else f"{'—':>8}")
+                + f"{k.get('bound', '—'):>7}")
+    if digest.get("compile"):
+        c = digest["compile"]
+        out.append("")
+        out.append(f"compile plane: {c['compiles']} backend compile(s) "
+                   f"({c['wall_s']:.2f} s), cache {c['cache_hits']} hit(s) "
+                   f"/ {c['cache_misses']} miss(es), "
+                   f"{c['retraces']} retrace(s)")
+        for jit, ent in sorted((c.get("by_jit") or {}).items(),
+                               key=lambda kv: -kv[1]["wall_s"]):
+            out.append(f"  {jit:<26} {ent['count']:>4} compile(s)"
+                       f"{ent['wall_s']:>9.3f}s")
+        for jit, changed in (c.get("retrace_jits") or {}).items():
+            out.append(f"  retrace {jit}: {'; '.join(changed[:3])}")
     if digest.get("memory"):
         m = digest["memory"]
         out.append("")

@@ -1,0 +1,438 @@
+"""Measured-roofline plane (obs/xprof.py, ISSUE 18): the stdlib trace
+parser must survive garbage artifacts (explicit empty result, never a
+crash), attribute device-op durations by lgbm/* scope, join the
+analytic cost models into kernel_measured rows, and round-trip end to
+end on a CPU capture; tpu_window.py triages unparseable captures;
+trace_export.py and bench_history.py consume the same rows."""
+import gzip
+import json
+import os
+import sys
+import types
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import obs
+from lightgbm_tpu.obs import xprof
+from lightgbm_tpu.obs.report import load_events, validate_events
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = os.path.join(REPO, "tools")
+
+
+def _import_tool(name):
+    sys.path.insert(0, TOOLS)
+    try:
+        return __import__(name)
+    finally:
+        sys.path.remove(TOOLS)
+
+
+def _fixture_doc():
+    """A hand-built Chrome trace shaped like a jax.profiler export: one
+    device track (pid 1, XLA-marked thread), one host python track
+    (pid 2), scoped ops by name and by metadata args, an unscoped
+    device op, and executor plumbing (``::``) that must never count as
+    kernel work."""
+    return {"traceEvents": [
+        {"ph": "M", "pid": 1, "name": "process_name",
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "M", "pid": 1, "tid": 10, "name": "thread_name",
+         "args": {"name": "XLA op profile"}},
+        {"ph": "M", "pid": 2, "name": "process_name",
+         "args": {"name": "python"}},
+        {"ph": "M", "pid": 2, "tid": 20, "name": "thread_name",
+         "args": {"name": "python"}},
+        # scope in the op name itself
+        {"ph": "X", "pid": 1, "tid": 10, "ts": 100.0, "dur": 400.0,
+         "name": "lgbm/wave_hist/fusion.1"},
+        # scope only in metadata args (the TPU named_scope path)
+        {"ph": "X", "pid": 1, "tid": 10, "ts": 520.0, "dur": 80.0,
+         "name": "fusion.2",
+         "args": {"long_name": "lgbm/wave_partition/fusion.2"}},
+        # unscoped device op -> the unattributed residual
+        {"ph": "X", "pid": 1, "tid": 10, "ts": 620.0, "dur": 50.0,
+         "name": "copy.3"},
+        # infra plumbing: excluded from track busy/residual entirely
+        {"ph": "X", "pid": 1, "tid": 10, "ts": 0.0, "dur": 1000.0,
+         "name": "tensorflow::ThunkExecutor::Execute"},
+        # host TraceAnnotation span (core.phase): spaced name verbatim
+        {"ph": "X", "pid": 2, "tid": 20, "ts": 90.0, "dur": 700.0,
+         "name": "lgbm/tree growth"},
+        # host interpreter noise: no scope, not a device track
+        {"ph": "X", "pid": 2, "tid": 20, "ts": 95.0, "dur": 5.0,
+         "name": "numpy.ndarray.sum"},
+    ]}
+
+
+def _write_gz(path, doc):
+    with gzip.open(path, "wb") as fh:
+        fh.write(json.dumps(doc).encode())
+
+
+_CTX = {"rows": 4096, "features": 12, "bins": 255, "leaves": 31,
+        "iters": 2}
+
+
+# ---------------------------------------------------------------------------
+# parser robustness: garbage in, explicit empty out
+# ---------------------------------------------------------------------------
+
+def test_parse_empty_and_missing_dir(tmp_path):
+    for p in (str(tmp_path), str(tmp_path / "nope"), ""):
+        parsed = xprof.parse_trace_dir(p)
+        assert parsed["files"] == 0 and parsed["parsed"] == 0
+        assert parsed["ops"] == [] and parsed["errors"] == []
+        attrib = xprof.attribute(parsed)
+        assert attrib["kernels"] == {} and attrib["window_ms"] == 0.0
+        assert xprof.measured_rooflines(attrib, _CTX) == []
+
+
+def test_parse_corrupt_artifacts_explicit_empty(tmp_path):
+    """Truncated gzip, non-gzip bytes, gzip-wrapped garbage json, a
+    non-object root, and a missing traceEvents list all parse to the
+    explicit empty result with one error entry each — no exception."""
+    good = json.dumps(_fixture_doc()).encode()
+    (tmp_path / "trunc.trace.json.gz").write_bytes(
+        gzip.compress(good)[:-10])
+    (tmp_path / "notgzip.trace.json.gz").write_bytes(b"this is not gzip")
+    (tmp_path / "badjson.trace.json.gz").write_bytes(
+        gzip.compress(b"{nope"))
+    (tmp_path / "rootlist.trace.json").write_text("[1, 2]")
+    (tmp_path / "noevents.trace.json").write_text('{"foo": 1}')
+    parsed = xprof.parse_trace_dir(str(tmp_path))
+    assert parsed["files"] == 5
+    assert parsed["parsed"] == 0
+    assert len(parsed["errors"]) == 5
+    assert parsed["ops"] == [] and parsed["tracks"] == {}
+    attrib = xprof.attribute(parsed)
+    assert attrib["kernels"] == {}
+    assert len(attrib["errors"]) == 5
+    # one good artifact beside the garbage still attributes
+    _write_gz(str(tmp_path / "ok.trace.json.gz"), _fixture_doc())
+    parsed = xprof.parse_trace_dir(str(tmp_path))
+    assert parsed["parsed"] == 1 and len(parsed["errors"]) == 5
+    assert xprof.attribute(parsed)["kernels"]
+
+
+# ---------------------------------------------------------------------------
+# attribution + model join on the hand-built fixture
+# ---------------------------------------------------------------------------
+
+def test_fixture_attribution(tmp_path):
+    _write_gz(str(tmp_path / "fix.trace.json.gz"), _fixture_doc())
+    parsed = xprof.parse_trace_dir(str(tmp_path))
+    assert parsed["parsed"] == 1 and not parsed["errors"]
+    attrib = xprof.attribute(parsed)
+    k = attrib["kernels"]
+    assert k["lgbm/wave_hist"]["measured_ms"] == pytest.approx(0.4)
+    assert k["lgbm/wave_hist"]["devices"] == ["/device:TPU:0"]
+    # scope found in metadata args, not the op name
+    assert k["lgbm/wave_partition"]["measured_ms"] == pytest.approx(0.08)
+    # host annotation keeps its spaced phase name verbatim
+    assert k["lgbm/tree growth"]["measured_ms"] == pytest.approx(0.7)
+    assert k["lgbm/tree growth"]["devices"] == ["host"]
+    dev = attrib["devices"]["/device:TPU:0"]
+    # the :: infra op never counts; copy.3 is the only residual
+    assert dev["ops"] == 3
+    assert dev["busy_ms"] == pytest.approx(0.53)
+    assert dev["unattributed_ms"] == pytest.approx(0.05)
+    # window spans the earliest..latest X event (the infra op included)
+    assert attrib["window_ms"] == pytest.approx(1.0)
+
+
+def test_measured_rooflines_model_join(tmp_path):
+    _write_gz(str(tmp_path / "fix.trace.json.gz"), _fixture_doc())
+    attrib = xprof.attribute(xprof.parse_trace_dir(str(tmp_path)))
+    rows = xprof.measured_rooflines(attrib, _CTX)
+    byk = {r["kernel"]: r for r in rows}
+    hist = byk["lgbm/wave_hist"]
+    assert hist["source"] == "xprof" and hist["ops"] == 1
+    assert hist["model"] == "wave_kernel" and hist["model_ms"] > 0
+    assert hist["roofline_frac"] == pytest.approx(
+        hist["model_ms"] / hist["measured_ms"], rel=1e-3)
+    assert hist["bound"] in ("mxu", "hbm")
+    part = byk["lgbm/wave_partition"]
+    assert part["model"] == "partition" and part["model_ms"] > 0
+    # the residual rides as its own per-device, measured-only row
+    un = byk["unattributed"]
+    assert un["measured_ms"] == pytest.approx(0.05)
+    assert un["device"] == "/device:TPU:0"
+    assert "model_ms" not in un
+    assert un["occupancy"] == pytest.approx(0.05 / 1.0, rel=1e-3)
+
+
+def test_record_measured_events_validate(tmp_path):
+    """Emitted kernel_measured events pass the event schema and fold
+    into the obs digest's xprof block."""
+    sink = tmp_path / "telem"
+    obs.reset()
+    obs.enable(str(sink))
+    try:
+        _write_gz(str(tmp_path / "fix.trace.json.gz"), _fixture_doc())
+        attrib = xprof.attribute(xprof.parse_trace_dir(str(tmp_path)))
+        rows = xprof.record_measured(attrib, _CTX,
+                                     trace_dir=str(tmp_path))
+        digest = obs.digest()
+        xp = digest["xprof"]
+        assert xp["trace_parsed"] == 1
+        assert xp["kernels"]["lgbm/wave_hist"]["roofline_frac"] > 0
+    finally:
+        obs.reset()
+    events = load_events(str(sink))
+    km = [e for e in events if e.get("event") == "kernel_measured"]
+    assert len(km) == len(rows) and len(km) >= 4
+    assert validate_events(events, kinds=("kernel_measured",)) == []
+
+
+# ---------------------------------------------------------------------------
+# arming + retrace attribution
+# ---------------------------------------------------------------------------
+
+def test_resolve_window_env_and_config(monkeypatch):
+    monkeypatch.delenv("LGBM_TPU_XPROF", raising=False)
+    assert xprof.resolve_window(None) == 0
+    cfg = types.SimpleNamespace(tpu_xprof=True, tpu_xprof_iters=4)
+    assert xprof.resolve_window(cfg) == 4
+    # a falsy env DISARMS even when config arms
+    monkeypatch.setenv("LGBM_TPU_XPROF", "0")
+    assert xprof.resolve_window(cfg) == 0
+    monkeypatch.setenv("LGBM_TPU_XPROF", "off")
+    assert xprof.resolve_window(cfg) == 0
+    # truthy env arms with the config/default iters
+    monkeypatch.setenv("LGBM_TPU_XPROF", "1")
+    assert xprof.resolve_window(None) == 3
+    assert xprof.resolve_window(cfg) == 4
+    # a number > 1 sets the window directly
+    monkeypatch.setenv("LGBM_TPU_XPROF", "7")
+    assert xprof.resolve_window(None) == 7
+
+
+def test_watch_jit_retrace_attribution(tmp_path, monkeypatch):
+    """A signature change after the first call is a retrace: counted,
+    and the compile event names the argument that forced it."""
+    monkeypatch.setenv("LGBM_TPU_XPROF", "1")
+    sink = tmp_path / "telem"
+    obs.reset()
+    obs.enable(str(sink))
+    try:
+        fn = xprof.watch_jit("lgbm/test_fn", lambda x: x)
+        fn(np.zeros((4, 2)))
+        fn(np.zeros((4, 2)))  # same signature: no retrace
+        fn(np.zeros((8, 2)))  # shape change
+        fn(np.zeros((8, 2), dtype=np.float32))  # dtype change
+        assert xprof.compile_digest()["retraces"] == 2
+    finally:
+        obs.reset()
+    re_ev = [e for e in load_events(str(sink))
+             if e.get("event") == "compile" and e.get("kind") == "retrace"]
+    assert len(re_ev) == 2
+    assert all(e["jit"] == "lgbm/test_fn" for e in re_ev)
+    assert any("arg0" in c for e in re_ev for c in e["changed"])
+    assert validate_events(re_ev, kinds=("compile",)) == []
+
+
+def test_watch_jit_identity_when_disarmed(monkeypatch):
+    monkeypatch.delenv("LGBM_TPU_XPROF", raising=False)
+    fn = lambda x: x  # noqa: E731
+    assert xprof.watch_jit("lgbm/test_fn", fn) is fn
+    assert xprof.watch_jit("lgbm/test_fn", None) is None
+
+
+# ---------------------------------------------------------------------------
+# end-to-end on CPU: capture -> parse -> attribute (slow: compile-heavy)
+# ---------------------------------------------------------------------------
+
+def test_e2e_capture_parse_attribute(tmp_path, monkeypatch):
+    """LGBM_TPU_XPROF arms a mid-train capture window; after training
+    the digest carries trace-attributed lgbm/* kernels with nonzero
+    measured ms and the emitted events validate against the schemas."""
+    monkeypatch.setenv("LGBM_TPU_XPROF", "2")
+    monkeypatch.setenv("LGBM_TPU_XPROF_DIR", str(tmp_path / "cap"))
+    sink = tmp_path / "telem"
+    obs.reset()
+    obs.enable(str(sink))
+    try:
+        rng = np.random.default_rng(3)
+        X = rng.normal(size=(500, 10))
+        y = (X[:, 0] + 0.4 * X[:, 1] > 0).astype(np.float64)
+        params = {"objective": "binary", "num_leaves": 7,
+                  "min_data_in_leaf": 5, "verbose": -1}
+        ds = lgb.Dataset(X, label=y, params=params)
+        lgb.train(params, ds, num_boost_round=5)
+        digest = obs.digest()
+        xp = digest.get("xprof") or {}
+        assert xp.get("trace_parsed", 0) >= 1, xp
+        assert not xp.get("errors")
+        lgbm = {k: v for k, v in (xp.get("kernels") or {}).items()
+                if k.startswith("lgbm/") and v.get("measured_ms", 0) > 0}
+        assert lgbm, xp
+    finally:
+        obs.reset()
+    events = load_events(str(sink))
+    km = [e for e in events if e.get("event") == "kernel_measured"]
+    assert km
+    assert validate_events(
+        events, kinds=("kernel_measured", "compile")) == []
+
+
+# ---------------------------------------------------------------------------
+# tpu_window: the trace leg parses its own capture
+# ---------------------------------------------------------------------------
+
+def _trace_leg_runner(write):
+    """A canned runner for the trace leg: 'succeeds' (TRACE_OK, rc 0)
+    after dropping whatever *write* leaves in the leg's trace dir —
+    argv is [py, -c, code, rows, trace_dir]."""
+    def run(argv, **kw):
+        d = os.path.join(argv[-1], "plugins", "profile", "t1")
+        os.makedirs(d, exist_ok=True)
+        write(d)
+        return types.SimpleNamespace(returncode=0, stdout="TRACE_OK\n",
+                                     stderr="")
+    return run
+
+
+def test_tpu_window_unparseable_trace_triage(tmp_path):
+    """A captured-but-unparseable trace becomes an unparseable-trace
+    triage classification instead of silently passing trace_files > 0
+    — even though the capture subprocess exited green."""
+    tw = _import_tool("tpu_window")
+
+    def write(d):
+        with open(os.path.join(d, "host.trace.json.gz"), "wb") as fh:
+            fh.write(b"definitely not a gzip stream")
+
+    rec = tw.run_checklist(str(tmp_path), 3, dry_run=True,
+                           runner=_trace_leg_runner(write),
+                           backend="cpu (dry-run)", only={"trace"})
+    assert rec["legs"]["trace"]["rc"] == 0
+    assert rec["trace_files"] == 1
+    assert rec["trace_parse"]["parsed"] == 0
+    assert rec["trace_parse"]["errors"]
+    assert rec["kernel_measured"] == []
+    assert rec["legs"]["trace"]["trace_unparseable"] is True
+    assert rec["triage"]["legs"]["trace"] == "unparseable-trace"
+    assert "unparseable-trace" in rec["triage"]["classes"]
+    # the classification round-trips through the artifact on disk
+    payload = json.loads(
+        (tmp_path / "BENCH_manual_r03.json").read_text())
+    assert payload["triage"]["legs"]["trace"] == "unparseable-trace"
+
+
+def test_tpu_window_embeds_measured_table(tmp_path):
+    """A parseable capture embeds the per-kernel measured table into
+    BENCH_manual_rN and trends through bench_history as
+    kernel_measured/* — no triage block."""
+    tw = _import_tool("tpu_window")
+
+    def write(d):
+        _write_gz(os.path.join(d, "host.trace.json.gz"), _fixture_doc())
+
+    rec = tw.run_checklist(str(tmp_path), 4, dry_run=True,
+                           runner=_trace_leg_runner(write),
+                           backend="cpu (dry-run)", only={"trace"})
+    assert rec["triage"] is None
+    assert rec["trace_parse"]["parsed"] == 1
+    assert rec["trace_parse"]["kernels_attributed"] >= 2
+    kernels = {r["kernel"] for r in rec["kernel_measured"]}
+    assert {"lgbm/wave_hist", "lgbm/wave_partition",
+            "unattributed"} <= kernels
+    bh = _import_tool("bench_history")
+    rows = bh.collect([str(tmp_path / "BENCH_manual_r04.json")])
+    assert rows[0].get("measured")
+    assert any(k.startswith("kernel_measured/")
+               for k in rows[0]["metrics"])
+
+
+# ---------------------------------------------------------------------------
+# trace_export: device-op summaries on their own Perfetto track
+# ---------------------------------------------------------------------------
+
+def test_trace_export_xprof_tracks_roundtrip():
+    """kernel_measured + compile events render on their own ops/*
+    tracks; an UNKNOWN kernel scope round-trips verbatim through the
+    Chrome-trace document (json there and back) rather than being
+    dropped or renamed."""
+    te = _import_tool("trace_export")
+    events = [
+        {"event": "kernel_measured", "t": 100.0,
+         "kernel": "lgbm/wave_hist", "ops": 3, "measured_ms": 4.0,
+         "window_ms": 10.0, "source": "xprof",
+         "device": "/device:TPU:0", "roofline_frac": 0.8,
+         "bound": "hbm"},
+        {"event": "kernel_measured", "t": 100.0,
+         "kernel": "lgbm/some_future_kernel", "ops": 1,
+         "measured_ms": 1.5, "window_ms": 10.0, "source": "xprof",
+         "device": "/device:TPU:0"},
+        {"event": "compile", "t": 101.0, "kind": "backend_compile",
+         "jit": "lgbm/tree growth", "wall_s": 2.0},
+        {"event": "compile", "t": 102.0, "kind": "cache_miss"},
+    ]
+    doc = json.loads(json.dumps(te.events_to_chrome(events)))
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    names = {e["name"] for e in xs}
+    assert {"lgbm/wave_hist", "lgbm/some_future_kernel",
+            "compile/backend_compile", "compile/cache_miss"} <= names
+    tracks = {e["args"]["name"] for e in doc["traceEvents"]
+              if e["ph"] == "M"}
+    assert {"ops/xprof", "ops/compile"} <= tracks
+    k = next(e for e in xs if e["name"] == "lgbm/wave_hist")
+    assert k["dur"] == pytest.approx(4.0 * 1e3)  # ms -> us
+    assert k["args"]["roofline_frac"] == 0.8
+    assert k["args"]["synthesized"] is True
+    unk = next(e for e in xs if e["name"] == "lgbm/some_future_kernel")
+    assert unk["args"]["kernel"] == "lgbm/some_future_kernel"
+    c = next(e for e in xs if e["name"] == "compile/backend_compile")
+    assert c["dur"] == pytest.approx(2.0e6)  # wall_s -> us
+
+
+# ---------------------------------------------------------------------------
+# bench_history: trend + divergence gating
+# ---------------------------------------------------------------------------
+
+def test_bench_history_measured_divergence_flags():
+    bh = _import_tool("bench_history")
+    rows = [
+        {"round": "r01", "context": ("a",),
+         "metrics": {"kernel_measured/lgbm/wave_hist": 0.9}},
+        {"round": "r02", "context": ("a",),
+         "metrics": {"kernel_measured/lgbm/wave_hist": 0.4,
+                     "kernel_measured/lgbm/wave_partition": 0.8,
+                     "kernel_measured/lgbm/split_scan": 2.6}},
+    ]
+    flags = bh.find_measured_divergence(rows)
+    assert {f["metric"] for f in flags} == {
+        "kernel_measured/lgbm/wave_hist",
+        "kernel_measured/lgbm/split_scan"}
+    assert all(f["round"] == "r02" for f in flags)
+    sides = {f["metric"]: f["side"] for f in flags}
+    assert sides["kernel_measured/lgbm/wave_hist"] == "off-roofline"
+    assert sides["kernel_measured/lgbm/split_scan"] == \
+        "model-underprices"
+    # canary rounds never gate: r01's clean fracs become latest
+    rows[1]["canary"] = "cpu-forced"
+    assert bh.find_measured_divergence(rows) == []
+
+
+def test_bench_history_divergence_gates_exit(tmp_path, monkeypatch,
+                                             capsys):
+    """A > 2x measured-vs-model divergence fails --fail-on-regression
+    exactly like a mode regression."""
+    bh = _import_tool("bench_history")
+    (tmp_path / "BENCH_r09.json").write_text(json.dumps({
+        "metric": "train_throughput", "value": 100.0,
+        "unit": "row_iters/s", "rows": 100, "iters": 3,
+        "num_leaves": 31, "max_bin": 255,
+        "kernel_measured": {"lgbm/wave_hist": 0.3}}))
+    monkeypatch.setattr(sys, "argv",
+                        ["bench_history.py", str(tmp_path),
+                         "--fail-on-regression"])
+    assert bh.main() == 1
+    assert "MEASURED-VS-MODEL DIVERGENCE" in capsys.readouterr().out
+    # the same round without the gate is informational only
+    monkeypatch.setattr(sys, "argv",
+                        ["bench_history.py", str(tmp_path)])
+    assert bh.main() == 0

@@ -64,6 +64,11 @@ _DIRECTIONS = [
     ("rank_vs_baseline", True),
     ("rank_train_ndcg10", True),
     ("kernel_roofline/*", True),
+    # trace-attributed measured rooflines (ISSUE 18, obs/xprof.py): the
+    # fraction of the analytic roofline each kernel actually achieves
+    # in a profiler window — the MEASURED companion of the
+    # host-bracketed kernel_roofline estimate above
+    ("kernel_measured/*", True),
     # wave-pipeline stamps (ISSUE 8): more kernel launches per tree, or a
     # capacity drop, is a scheduling regression even when throughput
     # noise hides it
@@ -126,6 +131,12 @@ _DIRECTIONS = [
 # request path
 _SWAP_BLIP_FLAG = 2.0
 
+# a trace-measured kernel more than this multiple off its analytic
+# model (in either direction) is flagged: the cost models arbitrate the
+# repo's perf claims, so a 2x divergence means either the kernel or the
+# model is lying (ISSUE 18)
+_DIVERGENCE_FLAG = 2.0
+
 # the headline columns of the human table, in order
 _TABLE_COLS = ["value", "vs_baseline", "per_iter_s", "compile_s",
                "train_auc", "waves_per_tree", "rank_row_iters_per_s",
@@ -184,11 +195,12 @@ def load_round(path: str) -> dict:
     parsed = payload.get("parsed", payload)
     if parsed is None:
         # the fully-failed window: no bench line at all — the triage
-        # block (when the window wrote one) is the only story the row
-        # can tell
+        # block (when the window wrote one) and any trace-attributed
+        # measured rows are the only story the row can tell
         row["note"] = "no parsed bench line"
         row["context"] = None
         _apply_triage(row, payload)
+        _fold_measured(row, {}, payload)
         return row
     if parsed.get("kind") == "ingest":  # a tools/ingest_bench.py round
         row["context"] = ("ingest", parsed.get("backend"),
@@ -343,6 +355,7 @@ def load_round(path: str) -> dict:
     if isinstance(parsed.get("kernel_roofline"), dict):
         for k, v in parsed["kernel_roofline"].items():
             row["metrics"][f"kernel_roofline/{k}"] = float(v)
+    _fold_measured(row, parsed, payload)
     td = parsed.get("telemetry")
     if isinstance(td, dict):
         _fold_digest(row["metrics"], td)
@@ -361,6 +374,32 @@ def load_round(path: str) -> dict:
     return row
 
 
+def _fold_measured(row: dict, parsed: dict, payload: dict) -> None:
+    """Fold measured-roofline rows (ISSUE 18) into a trajectory row.
+
+    bench.py embeds a flat ``{kernel: roofline_frac}`` dict on the
+    bench line; tpu_window.py embeds the full ``kernel_measured`` row
+    list at the record's top level.  Both trend as
+    ``kernel_measured/<kernel>``, and the full rows ride on the row
+    for ``find_measured_divergence``."""
+    km = parsed.get("kernel_measured")
+    if isinstance(km, dict):
+        for k, v in km.items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                row["metrics"][f"kernel_measured/{k}"] = float(v)
+    km_rows = payload.get("kernel_measured")
+    if isinstance(km_rows, list):
+        measured = [r for r in km_rows
+                    if isinstance(r, dict) and r.get("kernel")]
+        for r in measured:
+            frac = r.get("roofline_frac")
+            if isinstance(frac, (int, float)):
+                row["metrics"].setdefault(
+                    f"kernel_measured/{r['kernel']}", float(frac))
+        if measured:
+            row["measured"] = measured
+
+
 def _fold_digest(metrics: dict, digest: dict) -> None:
     """Pull trajectory-worthy numbers out of an obs digest."""
     wp = digest.get("wave_pipeline") or {}
@@ -376,6 +415,14 @@ def _fold_digest(metrics: dict, digest: dict) -> None:
     for k, v in (digest.get("kernels") or {}).items():
         metrics.setdefault(f"kernel_roofline/{k}",
                            float(v.get("roofline_frac", 0.0)))
+    for k, v in ((digest.get("xprof") or {}).get("kernels") or {}).items():
+        if isinstance(v, dict) and v.get("roofline_frac") is not None:
+            metrics.setdefault(f"kernel_measured/{k}",
+                               float(v["roofline_frac"]))
+    comp = digest.get("compile") or {}
+    for name in ("cache_hits", "cache_misses", "retraces"):
+        if isinstance(comp.get(name), (int, float)):
+            metrics.setdefault(f"compile_{name}", float(comp[name]))
 
 
 def collect(paths: List[str]) -> List[dict]:
@@ -480,6 +527,44 @@ def find_mode_regressions(rows: List[dict]) -> List[dict]:
     return out
 
 
+def find_measured_divergence(rows: List[dict],
+                             factor: float = _DIVERGENCE_FLAG
+                             ) -> List[dict]:
+    """Measured-vs-model divergence (ISSUE 18): kernels on the latest
+    non-canary round carrying trace-attributed measured rows whose
+    roofline fraction is more than ``factor`` x off the analytic model
+    in either direction — ``frac < 1/factor`` means the kernel runs far
+    off the roofline the model promises (a real perf bug or a wrong
+    machine-peak assumption), ``frac > factor`` means the model
+    under-prices the op, so every prediction built on it (wave
+    scheduling, reconciliation, A/B expectations) is wrong.  Reported
+    and exit-code gated like ``find_mode_regressions``: categorical
+    flags a threshold on throughput would never catch."""
+    rows = [r for r in rows if not r.get("canary")]
+    latest = next(
+        (r for r in reversed(rows)
+         if any(k.startswith("kernel_measured/") for k in r["metrics"])),
+        None)
+    if latest is None:
+        return []
+    out = []
+    for k in sorted(latest["metrics"]):
+        if not k.startswith("kernel_measured/"):
+            continue
+        frac = latest["metrics"][k]
+        if frac <= 0:
+            continue
+        if frac > factor or frac < 1.0 / factor:
+            out.append({
+                "metric": k, "round": latest["round"],
+                "roofline_frac": round(frac, 4),
+                "divergence": round(max(frac, 1.0 / frac), 2),
+                "side": ("model-underprices" if frac > 1
+                         else "off-roofline"),
+            })
+    return sorted(out, key=lambda r: -r["divergence"])
+
+
 def find_swap_blips(rows: List[dict]) -> List[dict]:
     """Serving rounds whose hot-swap blip p99 exceeded
     ``_SWAP_BLIP_FLAG`` x their steady p99 (stamped by ``load_round``),
@@ -524,7 +609,8 @@ def canary_trend(rows: List[dict]) -> List[dict]:
 
 def render(rows: List[dict], regressions: List[dict],
            mode_regressions: List[dict] = (),
-           swap_blips: List[dict] = ()) -> str:
+           swap_blips: List[dict] = (),
+           measured_divergence: List[dict] = ()) -> str:
     cols = [c for c in _TABLE_COLS
             if any(c in r["metrics"] for r in rows)]
     out = [f"{'round':<6}{'context':<34}"
@@ -569,6 +655,16 @@ def render(rows: List[dict], regressions: List[dict],
         for g in swap_blips:
             out.append(f"  {g['round']}: blip {g['value']:g}ms vs steady "
                        f"{g['steady']:g}ms ({g['ratio']:g}x)")
+    if measured_divergence:
+        out.append("")
+        out.append(f"MEASURED-VS-MODEL DIVERGENCE (> {_DIVERGENCE_FLAG:g}x "
+                   "off the analytic roofline — the kernel or the cost "
+                   "model is lying):")
+        for g in measured_divergence:
+            out.append(f"  {g['metric']:<40} frac "
+                       f"{g['roofline_frac']:g} "
+                       f"({g['divergence']:g}x {g['side']}) "
+                       f"[{g['round']}]")
     trend = [t for t in canary_trend(rows)
              if "per_iter_s_change_frac" in t or "value_change_frac" in t]
     if trend:
@@ -612,15 +708,18 @@ def main() -> int:
     regressions = find_regressions(rows, args.threshold)
     mode_regressions = find_mode_regressions(rows)
     swap_blips = find_swap_blips(rows)
+    measured_divergence = find_measured_divergence(rows)
     if args.json:
         print(json.dumps({"rounds": rows, "regressions": regressions,
                           "mode_regressions": mode_regressions,
                           "swap_blips": swap_blips,
+                          "measured_divergence": measured_divergence,
                           "canary_trend": canary_trend(rows)}))
     else:
-        print(render(rows, regressions, mode_regressions, swap_blips))
-    if ((regressions or mode_regressions or swap_blips)
-            and args.fail_on_regression):
+        print(render(rows, regressions, mode_regressions, swap_blips,
+                     measured_divergence))
+    if ((regressions or mode_regressions or swap_blips
+         or measured_divergence) and args.fail_on_regression):
         return 1
     return 0
 

@@ -151,6 +151,32 @@ ENV_VARS = [
      "compile_s figure says which kind of compile it measured).  Must "
      "be set before the first `jit` compilation it should capture; "
      "enabling is best-effort (a cache failure never aborts training)."),
+    ("LGBM_TPU_XPROF",
+     "measured-roofline capture window (overrides the `tpu_xprof` / "
+     "`tpu_xprof_iters` parameters; `obs/xprof.py`): `1`/`true` arms a "
+     "windowed `jax.profiler` trace around `tpu_xprof_iters` (default "
+     "3) mid-train iterations — warmup/compile iterations are skipped "
+     "— a number > 1 sets the window length directly, and `0`/`off` "
+     "disarms even when the parameter is set.  When the window closes "
+     "the trace artifacts are parsed (stdlib-only Chrome-trace reader), "
+     "device-op durations are bucketed by the `lgbm/*` scopes plus an "
+     "`unattributed` residual, and the attribution joins the analytic "
+     "cost models (`wave_kernel_cost`/`partition_cost`/"
+     "`rank_pair_cost`/`shap_cost`) into `kernel_measured` events and "
+     "the digest's measured-roofline table (see ROOFLINE.md).  Arming "
+     "also installs the compile observer: per-jit backend-compile "
+     "walls, persistent-cache hit/miss counts and retrace attribution "
+     "as `compile` events, digest lines, and board `/metrics` gauges.  "
+     "Works on any backend; capture adds profiler overhead INSIDE the "
+     "window only (off-window step cost is guarded < 5% by "
+     "`tools/xprof_smoke.py`)."),
+    ("LGBM_TPU_XPROF_DIR",
+     "where the capture window writes its trace artifacts (default: an "
+     "`xprof` sibling of the telemetry sink, or a tempdir when no sink "
+     "is configured).  The parsed per-kernel attribution records the "
+     "directory in the digest so a window's raw artifacts can be "
+     "re-read later (e.g. `tools/tpu_window.py`'s trace leg parses its "
+     "own capture and embeds the table into `BENCH_manual_rN`)."),
     ("LGBM_TPU_SERVE_MAX_BATCH",
      "serving-engine override for `tpu_serve_max_batch` (the per-batch "
      "row cap of `serve.PredictorSession`); lets an operator retune a "

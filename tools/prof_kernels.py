@@ -45,6 +45,11 @@ Env knobs: ``PROF_ROWS`` (1_000_000), ``PROF_FEATURES`` (28),
 ``PROF_REPEAT`` (3), ``PROF_LEGS``, ``PROF_JSON=1`` (append one
 machine-readable JSON line), ``PROF_INTERPRET=1`` (Pallas interpreter
 mode — the CPU smoke path CI exercises between TPU windows).
+``PROF_TRACE_DIR=<dir>`` switches to trace-report mode: instead of
+running legs, parse an existing ``jax.profiler`` capture through
+``obs/xprof.py`` and print its measured-roofline table (the same
+``kernel_measured`` rows training runs emit); ``PROF_TRACE_ITERS``
+(1) tells the cost models how many iterations the window covered.
 
 With a telemetry sink configured (``LGBM_TPU_TELEMETRY``) every timed leg
 also emits a ``kernel_profile`` event, so ``tools/telemetry_report.py``
@@ -400,12 +405,57 @@ def leg_gathers(p, results, n_rep: int):
     _report(results, "vec3 gather", dt)
 
 
+def report_trace(trace_dir: str, rows: int, F: int, leaves: int,
+                 max_bin: int) -> int:
+    """Measured-roofline table from an existing profiler capture.
+
+    ``PROF_TRACE_DIR=<dir>`` replaces the microbench legs with the
+    obs/xprof.py pipeline over a trace some training run (or
+    tpu_window leg) already captured: parse, attribute per ``lgbm/*``
+    scope, join against the cost models under the PROF_* problem shape
+    — the exact ``kernel_measured`` rows the digest/report render, so
+    the harness and the training plane arbitrate from ONE table."""
+    from lightgbm_tpu.obs import xprof
+    parsed = xprof.parse_trace_dir(trace_dir)
+    if parsed["files"] == 0:
+        print(f"no trace artifacts under {trace_dir}", flush=True)
+        return 1
+    attrib = xprof.attribute(parsed)
+    context = {"rows": rows, "features": F, "bins": max_bin,
+               "leaves": leaves, "mode": MODE,
+               "iters": _env_int("PROF_TRACE_ITERS", 1)}
+    rows_out = xprof.measured_rooflines(attrib, context)
+    if parsed["errors"]:
+        print("parse errors: " + "; ".join(parsed["errors"]), flush=True)
+    print(f"trace: {parsed['parsed']}/{parsed['files']} artifact(s), "
+          f"window {attrib['window_ms']:.1f} ms", flush=True)
+    print(f"{'kernel':<30}{'ops':>7}{'measured':>11}{'model':>11}"
+          f"{'frac':>8}{'bound':>7}", flush=True)
+    for r in sorted(rows_out, key=lambda r: -r["measured_ms"]):
+        model = (f"{r['model_ms']:>9.3f}ms" if r.get("model_ms") is not None
+                 else f"{'—':>11}")
+        frac = (f"{r['roofline_frac']:>8.4f}"
+                if r.get("roofline_frac") is not None else f"{'—':>8}")
+        print(f"{r['kernel']:<30}{r['ops']:>7}{r['measured_ms']:>9.3f}ms"
+              f"{model}{frac}{r.get('bound', '—'):>7}", flush=True)
+    if os.environ.get("PROF_JSON", "") not in ("", "0"):
+        print(json.dumps({
+            "tool": "prof_kernels", "source": "xprof",
+            "trace_dir": trace_dir, "window_ms": attrib["window_ms"],
+            "parse_errors": parsed["errors"],
+            "kernel_measured": rows_out}))
+    return 0
+
+
 def main() -> int:
     rows = _env_int("PROF_ROWS", 1_000_000)
     F = _env_int("PROF_FEATURES", 28)
     leaves = _env_int("PROF_LEAVES", 255)
     max_bin = _env_int("PROF_MAXBIN", 255)
     n_rep = _env_int("PROF_REPEAT", 3)
+    trace_dir = os.environ.get("PROF_TRACE_DIR", "")
+    if trace_dir:
+        return report_trace(trace_dir, rows, F, leaves, max_bin)
     legs = [s for s in os.environ.get(
         "PROF_LEGS",
         "kernel,kernelpacked,kernelfused,kernelint16,kernelint8,fusedgrad,"

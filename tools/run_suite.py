@@ -109,6 +109,18 @@ through the SAME reader the serving plane uses
 JSON contract with a finite, converging ETA, ``/debug/flight`` serves
 the live ring, and the train-thread seconds spent inside the board
 hook stay under the 5% off-path overhead guard.
+
+The ``xprof`` tier (ISSUE 18) runs ``tools/xprof_smoke.py --json``:
+the measured-roofline smoke — a tiny CPU train with the windowed
+profiler capture armed (``LGBM_TPU_XPROF``) plus a cold persistent
+compile cache: the trace parses with the stdlib-only reader, >= 3
+distinct ``lgbm/*`` kernels attribute with nonzero measured ms and at
+least one carries the analytic cost-model join, the emitted
+``kernel_measured`` / ``compile`` events validate against their
+schemas and render the digest's measured-roofline table, backend
+compile walls + cache hit/miss + retrace gauges show on ``/metrics``,
+and the disarmed per-iteration ``step()`` hook stays under the same
+5% off-path overhead guard the board tier pins.
 """
 from __future__ import annotations
 
@@ -221,6 +233,12 @@ _TOOL_TIERS = {
     # the flight endpoint answers, and the board hook stays inside the
     # 5% off-path overhead guard
     "board": ["board_smoke.py", "--json"],
+    # measured-roofline plane (ISSUE 18): windowed profiler capture on a
+    # tiny CPU train -> stdlib trace parse -> >=3 lgbm/* kernels
+    # attributed with a cost-model join, kernel_measured/compile events
+    # validating, compile walls + cache hit/miss on the board, and the
+    # disarmed step() hook inside the same 5% off-path overhead guard
+    "xprof": ["xprof_smoke.py", "--json"],
 }
 
 
@@ -275,14 +293,15 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="Run the quick/slow test tiers and write SUITE_rN.json")
     ap.add_argument("--tiers", default="quick,slow,serve,faults,chaos,"
-                                       "online,ingest,drift,board",
+                                       "online,ingest,drift,board,xprof",
                     help="comma list of tiers: pytest markers plus the "
                          "built-in 'serve' smoke, 'faults' matrix, "
                          "'chaos' serving-chaos, 'online' closed-loop, "
                          "'ingest' streaming-ingestion, 'drift' "
-                         "monitoring and 'board' train-introspection "
-                         "legs (default quick,slow,serve,"
-                         "faults,chaos,online,ingest,drift,board)")
+                         "monitoring, 'board' train-introspection and "
+                         "'xprof' measured-roofline legs (default quick,"
+                         "slow,serve,faults,chaos,online,ingest,drift,"
+                         "board,xprof)")
     ap.add_argument("--select", default="",
                     help="pytest collection target (file or node id) "
                          "instead of the whole tests/ dir")

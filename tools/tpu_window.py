@@ -33,7 +33,15 @@ capture checklist with health monitoring enabled:
    including the wave-partition legs (batched one-pass split apply vs
    the sequential per-split oracle, against ``partition_cost``) and the
    packed/fused kernel-layout legs (triple vs lane-pair vs fused);
-5. a ``jax.profiler`` trace capture of a short training run;
+5. a ``jax.profiler`` trace capture of a short training run, taken
+   with telemetry armed so the ``lgbm/*`` scope annotations land in
+   the artifacts; the window then parses its OWN capture through the
+   measured-roofline plane (``obs/xprof.py``, ISSUE 18) and embeds
+   the per-kernel ``kernel_measured`` table (achieved ms vs cost-model
+   ms, roofline fraction, boundedness) into ``BENCH_manual_r{N}`` —
+   a captured-but-unparseable trace is classified into ``triage`` as
+   ``unparseable-trace`` instead of silently passing the file-count
+   check;
 6. ``tools/bench_serve.py --json`` — the serving engine's closed-loop +
    Poisson open-loop numbers on the live backend, written as
    ``SERVE_manual_r{N}.json`` (bench_history.py trends it alongside
@@ -205,9 +213,15 @@ def checklist_legs(art_dir: str, dry_run: bool, py: str = sys.executable):
         return env
 
     trace_rows = "2000" if dry_run else "50000"
-    trace_env = {"LGBM_TPU_HEALTH": "monitor"}
-    if dry_run:
-        trace_env["JAX_PLATFORMS"] = "cpu"
+    # the trace leg runs with telemetry ARMED: core.phase only stamps
+    # the lgbm/* TraceAnnotations the measured-roofline parser
+    # attributes by when a sink is live (obs/core._trace_annotation),
+    # so a bare capture would parse to zero attributed kernels.
+    # LGBM_TPU_XPROF=0 disarms the in-process capture window — the
+    # leg's outer jax.profiler.trace IS the capture here, and a nested
+    # profiler session would abort it.
+    trace_env = env_for("trace", {"LGBM_TPU_XPROF": "0"},
+                        dry_env={"JAX_PLATFORMS": "cpu"})
     # the headline leg runs with the train-side metrics exporter armed
     # (ISSUE 17): the window scrapes /metrics + /progress MID-LEG and
     # embeds the live measured-vs-model reconciliation table into
@@ -383,9 +397,15 @@ def leg_triage(rec: dict, dry_run: bool = False):
     deadline), ``backend-wedge`` (transient runtime failure shape —
     robust/watchdog.py classify_text — that exhausted its retries),
     ``cpu-fallback`` (ran green but on the CPU backend, so the number
-    is not a device point), or ``failure`` (a real error: retrying
-    would only repeat it)."""
+    is not a device point), ``unparseable-trace`` (the capture leg
+    left artifacts the measured-roofline parser could not read —
+    ISSUE 18 — so the window yielded no per-kernel truth), or
+    ``failure`` (a real error: retrying would only repeat it)."""
     parsed = rec.get("parsed") or {}
+    if rec.get("trace_unparseable"):
+        # checked BEFORE the rc == 0 early-return: the capture
+        # subprocess exits green even when its artifacts are garbage
+        return "unparseable-trace"
     if rec.get("rc", 1) == 0:
         if not dry_run and parsed.get("backend") == "cpu":
             return "cpu-fallback"
@@ -534,6 +554,36 @@ def export_serve_trace(art_dir: str):
         return None, 0
 
 
+def ingest_trace(trace_dir: str, dry_run: bool):
+    """Parse the trace leg's OWN capture through the measured-roofline
+    plane (obs/xprof.py, ISSUE 18) and join it against the analytic
+    cost models at the leg's training shape.
+
+    Returns ``(rows, summary)`` — the per-kernel ``kernel_measured``
+    table plus a parse summary.  The parser itself never raises on bad
+    artifacts (truncated gzip, corrupt json → ``errors`` entries), so
+    a captured-but-unparseable trace surfaces as ``parsed == 0`` with
+    the per-file failures listed, not as an exception."""
+    from lightgbm_tpu.obs import xprof
+    parsed = xprof.parse_trace_dir(trace_dir)
+    attrib = xprof.attribute(parsed)
+    # _TRACE_CODE's shape: rows x 12 features, 31 leaves, default bins,
+    # 2 traced updates
+    context = {"rows": 2000 if dry_run else 50000, "features": 12,
+               "leaves": 31, "bins": 255, "iters": 2}
+    rows = xprof.measured_rooflines(attrib, context)
+    lgbm = [r for r in rows if r["kernel"].startswith("lgbm/")
+            and r.get("measured_ms", 0) > 0]
+    summary = {
+        "files": attrib["files"],
+        "parsed": attrib["parsed"],
+        "errors": attrib["errors"][:5],
+        "window_ms": attrib["window_ms"],
+        "kernels_attributed": len(lgbm),
+    }
+    return rows, summary
+
+
 def run_checklist(out_dir: str, n: int, dry_run: bool,
                   runner=subprocess.run, timeout: int = 1800,
                   backend: str = "", only=None,
@@ -546,6 +596,24 @@ def run_checklist(out_dir: str, n: int, dry_run: bool,
     results = run_legs(legs, runner=runner, timeout=timeout,
                        wedge_retries=wedge_retries)
     health = collect_health(art_dir)
+    trace_n_files = sum(len(fs) for _, _, fs in os.walk(trace_dir))
+    # parse the trace leg's own capture (ISSUE 18): the per-kernel
+    # measured table rides in BENCH_manual_rN, and a captured-but-
+    # unparseable trace becomes a triage class instead of silently
+    # passing the trace_files > 0 check
+    kernel_measured, trace_parse = [], None
+    trace_rec = results.get("trace")
+    if trace_rec is not None:
+        try:
+            kernel_measured, trace_parse = ingest_trace(trace_dir,
+                                                        dry_run)
+        except Exception as exc:  # noqa: BLE001 — record must survive
+            trace_parse = {"files": trace_n_files, "parsed": 0,
+                           "errors": [f"{type(exc).__name__}: {exc}"],
+                           "window_ms": 0.0, "kernels_attributed": 0}
+        trace_rec["trace_parse"] = trace_parse
+        if trace_n_files > 0 and not trace_parse.get("parsed"):
+            trace_rec["trace_unparseable"] = True
     bench_parsed = (results.get("bench") or {}).get("parsed")
     record = {
         "n": n,
@@ -578,7 +646,13 @@ def run_checklist(out_dir: str, n: int, dry_run: bool,
         # absent when the window was clean
         "triage": triage_legs(results, dry_run=dry_run),
         "trace_dir": os.path.relpath(trace_dir, out_dir),
-        "trace_files": sum(len(fs) for _, _, fs in os.walk(trace_dir)),
+        "trace_files": trace_n_files,
+        # the measured-roofline embed (ISSUE 18): per-kernel achieved
+        # ms joined against the analytic cost models, straight from the
+        # trace leg's own capture — bench_history.py trends the
+        # roofline fractions from these rows
+        "kernel_measured": kernel_measured,
+        "trace_parse": trace_parse,
         "artifacts_dir": os.path.relpath(art_dir, out_dir),
     }
     bench_path = os.path.join(out_dir, f"BENCH_manual_r{n:02d}.json")
