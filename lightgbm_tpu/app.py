@@ -282,13 +282,19 @@ def run_serve(cfg: Config, params: Dict) -> None:
     serve it behind the failover router."""
     if not cfg.input_model:
         log.fatal("task=serve needs input_model (alias: model_file)")
-    from .serve import ModelRegistry, PredictServer
+    from .serve import ForestArena, ModelRegistry, PredictServer
     reg = ModelRegistry(config=cfg)
     reg.add_model("default", cfg.input_model)
+    # multi-tenant arena rides the same fleet surface: POST
+    # /models/{name}/swap with {"arena": true} admits a tenant into the
+    # shared pack, /predict routes by model= name
+    reg.attach_arena(ForestArena(config=cfg))
     router = reg.resolve(None).router
     n = router.warmup()
     log.info("serve: %d replica(s) warmed %d bucket shapes "
-             "(max_batch=%d)", len(router.replicas), n, router.max_batch)
+             "(max_batch=%d); arena attached (budget %s)",
+             len(router.replicas), n, router.max_batch,
+             reg.arena.budget_bytes or "unbounded")
     PredictServer(reg, host=cfg.tpu_serve_host,
                   port=cfg.tpu_serve_port).serve_forever()
 

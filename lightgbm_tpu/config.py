@@ -650,6 +650,29 @@ class Config:
                                         # — the zero-cold-start half of
                                         # zero-downtime; false flips
                                         # immediately after the canary
+    tpu_serve_aot: bool = True          # arm the AOT executable store
+                                        # when a directory is set: a
+                                        # warmed store lets a cold
+                                        # process serve request #1 with
+                                        # ZERO JIT compiles (serve/
+                                        # aot.py); false disarms without
+                                        # unsetting the directory
+    tpu_serve_aot_dir: str = ""         # AOT executable store directory
+                                        # — serialized per-bucket
+                                        # executables keyed by forest
+                                        # content + backend + jax
+                                        # version; empty = store off
+                                        # (LGBM_TPU_SERVE_AOT_DIR env
+                                        # wins)
+    tpu_serve_arena_bytes: int = 0      # device-byte budget for the
+                                        # multi-tenant forest arena
+                                        # (serve/arena.py): admissions
+                                        # past the budget LRU-evict the
+                                        # coldest tenant (re-admitted
+                                        # transparently on its next
+                                        # request); 0 = unbounded
+                                        # (LGBM_TPU_SERVE_ARENA_BYTES
+                                        # env)
 
     # ---- Explanation serving (explain/ subsystem) ----
     tpu_explain: bool = True            # arm POST /explain and
@@ -970,6 +993,8 @@ class Config:
             log.fatal("tpu_serve_shed_normal_frac should be in [0, 1]")
         if self.tpu_serve_rollback_watch_s < 0:
             log.fatal("tpu_serve_rollback_watch_s should be >= 0")
+        if self.tpu_serve_arena_bytes < 0:
+            log.fatal("tpu_serve_arena_bytes should be >= 0")
         if self.tpu_explain_max_batch < 1:
             log.fatal("tpu_explain_max_batch should be >= 1")
         if self.tpu_explain_max_wait_ms < 0:

@@ -42,10 +42,14 @@ class ForestArrays(NamedTuple):
     class_id: object        # i32 [T] (tree t updates score column class_id[t])
     internal_count: object = None   # i32 [T, M] (with_counts only)
     leaf_count: object = None       # i32 [T, M+1] (with_counts only)
+    model_id: object = None         # i32 [T] (multi-tenant arena lane:
+    #                                 tree t belongs to tenant model_id[t];
+    #                                 None outside serve/arena.py packs)
 
 
 def stack_forest(trees_np: list, class_ids: np.ndarray,
-                 min_words: int = 0, with_counts: bool = False
+                 min_words: int = 0, with_counts: bool = False,
+                 model_ids: Optional[np.ndarray] = None
                  ) -> ForestArrays:
     """Stack per-tree numpy array dicts (from ``GBDT._tree_arrays_np``)
     into one device-ready batch, padded to the widest tree.
@@ -55,7 +59,8 @@ def stack_forest(trees_np: list, class_ids: np.ndarray,
     False and routes right.  ``with_counts`` additionally stacks the
     per-node ``internal_count``/``leaf_count`` cover counts (the tree
     dicts must carry them — ``_tree_arrays_np(..., with_counts=True)``)
-    for the explain/ TreeSHAP path."""
+    for the explain/ TreeSHAP path.  ``model_ids`` stamps the per-tree
+    tenant lane the multi-tenant arena scan masks on (serve/arena.py)."""
     import jax.numpy as jnp
 
     M = max(max(t["split_feature"].shape[0] for t in trees_np), 1)
@@ -84,6 +89,8 @@ def stack_forest(trees_np: list, class_ids: np.ndarray,
                         if with_counts else None),
         leaf_count=(batch("leaf_count", (M + 1,), np.int32)
                     if with_counts else None),
+        model_id=(jnp.asarray(np.asarray(model_ids, np.int32))
+                  if model_ids is not None else None),
     )
 
 
@@ -143,6 +150,65 @@ def forest_predict_fn(meta: DeviceMeta, K: int, early_stop: Optional[dict] = Non
 
         (score, _, _, _), _ = jax.lax.scan(
             body, (score0, comp0, active0, jnp.int32(0)), forest)
+        return score
+
+    return jax.jit(predict)
+
+
+def arena_predict_fn(meta: DeviceMeta, K: int):
+    """Build ``predict(forest, bins, row_model) -> [N, K] f32`` for a
+    multi-tenant arena pack (serve/arena.py): the stacked forest holds
+    EVERY resident tenant's trees with a per-tree ``model_id`` lane, and
+    ``row_model`` ([N] i32) says which tenant each row belongs to.  The
+    scan is the ``forest_predict_fn`` body with one extra mask — a tree
+    contributes to a row only when ``row_model[i] == model_id[t]`` — so
+    one compiled executable serves every resident tenant and a microbatch
+    can mix tenants freely.  ``K`` is the max trees-per-iteration across
+    tenants; a tenant with fewer classes simply never writes the higher
+    columns.  No early stop: the margin heuristic is per-model state and
+    the arena targets many small forests where it never pays anyway."""
+    import jax
+    import jax.numpy as jnp
+
+    from .predict import predict_leaf_bins
+
+    @jax.named_scope("lgbm/arena_predict")
+    def predict(forest: ForestArrays, bins, row_model):
+        N = bins.shape[0]
+        score0 = jnp.zeros((N, K), jnp.float32)
+        comp0 = jnp.zeros((N, K), jnp.float32)
+
+        def body(carry, tree):
+            score, comp = carry
+            k = tree.class_id
+            lv = tree.leaf_value
+            arrs = TreeArrays(
+                split_feature=tree.split_feature,
+                threshold_bin=tree.threshold_bin,
+                default_left=tree.default_left,
+                left_child=tree.left_child, right_child=tree.right_child,
+                split_gain=None, internal_value=None, internal_count=None,
+                internal_weight=None,
+                leaf_value=lv, leaf_count=None, leaf_weight=None,
+                num_leaves=tree.num_leaves, cat_bitset=tree.cat_bitset)
+            leaf = predict_leaf_bins(arrs, bins, meta)
+            hit = row_model == tree.model_id
+            # same Kahan compensation as forest_predict_fn, but a miss
+            # must freeze BOTH score and comp: a masked-to-zero add
+            # would still fold the residual compensation into the score
+            # (t_sum = score - comp), and arena parity is asserted
+            # bit-identical against per-model sessions — a row's
+            # (score, comp) trajectory has to be exactly the sequence
+            # its own model's scan produces
+            y = lv[leaf] - comp[:, k]
+            t_sum = score[:, k] + y
+            comp = comp.at[:, k].set(
+                jnp.where(hit, (t_sum - score[:, k]) - y, comp[:, k]))
+            score = score.at[:, k].set(
+                jnp.where(hit, t_sum, score[:, k]))
+            return (score, comp), None
+
+        (score, _), _ = jax.lax.scan(body, (score0, comp0), forest)
         return score
 
     return jax.jit(predict)

@@ -899,6 +899,47 @@ EVENT_SCHEMAS = {
         "replica": (int, True),
         "draining": (bool, True),
     },
+    # zero-cold-start plane (serve/aot.py): a present-but-untrusted
+    # store entry fell back to a JIT compile — the loud part of the
+    # "never crash" contract
+    "aot_fallback": {
+        "kind": (str, True),
+        "entry": (str, True),
+        "reason": (str, True),
+    },
+    "serve_replica_restart": {
+        "replica": (int, True),
+        "boot_ms": (_NUM, True),
+        "boot_compiles": (int, True),
+        "aot": (bool, True),
+    },
+    # multi-tenant arena plane (serve/arena.py): residency transitions
+    "arena_admit": {
+        "model": (str, True),
+        "tenants": (int, True),
+        "resident": (int, True),
+        "bytes": (int, True),
+        "readmit": (bool, True),
+    },
+    "arena_evict": {
+        "model": (str, True),
+        "reason": (str, True),
+        "bytes": (int, False),
+    },
+    "arena_repack": {
+        "generation": (int, True),
+        "tenants": (int, True),
+        "trees": (int, True),
+        "bytes": (int, True),
+        "ms": (_NUM, True),
+    },
+    "arena_swap": {
+        "model": (str, True),
+        "ok": (bool, True),
+        "version": (int, False),
+        "generation": (int, False),
+        "error": (str, False),
+    },
     # trace plane (obs/spans.py) + the HTTP access log (serve/server.py)
     "span": {
         "name": (str, True),

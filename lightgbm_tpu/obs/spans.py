@@ -93,6 +93,13 @@ _FLIGHT_EVENTS = frozenset((
     # telemetry-only: one record per iteration would crowd the ring the
     # way per-chunk ingest records would)
     "straggler",
+    # zero-cold-start plane (serve/aot.py + serve/arena.py +
+    # router.restart_replica): a store entry silently re-paying JIT, a
+    # tenant bouncing in and out of residency, or a replica reboot are
+    # exactly the moments-before a cold-start or capacity post-mortem
+    # replays
+    "aot_fallback", "serve_replica_restart", "arena_admit",
+    "arena_evict", "arena_repack", "arena_swap",
 ))
 
 

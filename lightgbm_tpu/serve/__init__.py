@@ -26,7 +26,17 @@ microbatcher behind a threaded HTTP front end.
   /models/{name}/rollback, /debug/flight
 - ``metrics``  — lock-cheap counters/histogram + SLO-burn behind
   /metrics, with the minimal text-format parser for reading it back
+- ``aot``      — ``AOTStore``: persisted pre-compiled bucket
+  executables (``jax.experimental.serialize_executable``) so a cold
+  process serves request #1 with ZERO JIT compiles; corrupt/stale
+  entries fall back to JIT loudly (``aot_fallback``), never crash
+- ``arena``    — ``ForestArena``: many tenant forests packed into one
+  device-resident stacked forest with a per-tree model-id lane,
+  cross-model microbatching, and LRU residency under a byte budget
+  (``tpu_serve_arena_bytes``) with transparent re-admission
 """
+from .aot import AOTStore, resolve_aot_dir
+from .arena import ForestArena
 from .batcher import (PRIORITIES, DeadlineExceeded, MicroBatcher,
                       ServeOverloadError, normalize_priority)
 from .metrics import ServeMetrics, parse_prometheus
@@ -36,8 +46,9 @@ from .router import NoReplicaAvailable, ReplicaRouter
 from .server import PredictServer
 from .session import PredictorSession
 
-__all__ = ["PRIORITIES", "DeadlineExceeded", "MicroBatcher",
-           "ModelRegistry", "NoReplicaAvailable", "PredictServer",
-           "PredictorSession", "ReplicaRouter", "ServeBinSpace",
-           "ServeMetrics", "ServeOverloadError", "SwapRejected",
-           "UnknownModelError", "normalize_priority", "parse_prometheus"]
+__all__ = ["AOTStore", "ForestArena", "PRIORITIES", "DeadlineExceeded",
+           "MicroBatcher", "ModelRegistry", "NoReplicaAvailable",
+           "PredictServer", "PredictorSession", "ReplicaRouter",
+           "ServeBinSpace", "ServeMetrics", "ServeOverloadError",
+           "SwapRejected", "UnknownModelError", "normalize_priority",
+           "parse_prometheus", "resolve_aot_dir"]

@@ -1,0 +1,182 @@
+"""Ahead-of-time executable store: zero-compile cold starts.
+
+A fresh serving process pays ``ceil(log2(max_batch)) + 1`` JIT compiles
+(one per pow2 bucket, plus the explain family when armed) before request
+#1 meets SLO.  The persistent XLA cache (utils/compile_cache.py) shaves
+the backend compile but still traces, lowers, and probes the cache on
+the request path.  This store removes the compiler from the boot path
+entirely: a warmed process serializes its compiled bucket executables
+(``jax.experimental.serialize_executable``) and a cold process loads
+them back as ready-to-call executables — request #1 runs at steady-state
+latency with the obs compile counter pinned at 0.
+
+Key schema (one entry per executable)::
+
+    sha256(kind | backend | jax version | bucket | rows-cap | K |
+           num_features | early-stop spec | forest leaf shapes+dtypes |
+           bin-space digest (meta array CONTENT) | device)
+
+The forest and ``DeviceMeta`` arrays are CLOSURE CONSTANTS baked into
+the executable by ``jax.jit(...).lower().compile()`` — two models with
+identical shapes but different thresholds produce different programs —
+so the key hashes the bin-space content, not just shapes.  Backend and
+jax version ride in both the key and the entry header: a cross-backend
+or cross-version entry is STALE, and every failed load (truncated file,
+unpicklable payload, deserialization error) falls back to JIT loudly —
+an ``aot_fallback`` telemetry event + the ``tpu_serve_aot_fallbacks``
+metric — and never crashes the serving process.
+
+Armed via ``tpu_serve_aot_dir`` / ``$LGBM_TPU_SERVE_AOT_DIR`` (the env
+var wins, matching every other serve knob); ``tpu_serve_aot=false``
+disarms without unsetting the directory.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+from typing import Optional
+
+import numpy as np
+
+from .. import obs
+from ..robust import faults
+from ..utils import log
+from ..utils.compile_cache import atomic_write_bytes, store_entries
+
+_MAGIC = "lgbm-aot-v1"
+_SUFFIX = ".aot"
+
+
+def resolve_aot_dir(config=None) -> Optional[str]:
+    """The AOT store directory in effect, or None (store unarmed).
+    ``$LGBM_TPU_SERVE_AOT_DIR`` wins over ``tpu_serve_aot_dir``;
+    ``tpu_serve_aot=false`` disarms both."""
+    if config is not None and not getattr(config, "tpu_serve_aot", True):
+        return None
+    p = (os.environ.get("LGBM_TPU_SERVE_AOT_DIR", "").strip()
+         or str(getattr(config, "tpu_serve_aot_dir", "") or "").strip())
+    return os.path.abspath(os.path.expanduser(p)) if p else None
+
+
+class AOTStore:
+    """One directory of serialized executables, content-keyed.
+
+    ``load`` returns ``(status, fn)`` with status in {"hit", "miss",
+    "fallback"}: a *miss* is a cold store (nothing to say), a *fallback*
+    is an entry that EXISTS but cannot be trusted — corrupt bytes, a
+    different backend/jax version, a deserialization failure — reported
+    via the ``aot_fallback`` event so a fleet silently re-paying JIT
+    compiles is visible, then served by the JIT path as if the store
+    were cold."""
+
+    def __init__(self, path: str):
+        self.path = str(path)
+        self.loaded = 0
+        self.saved = 0
+        self.fallbacks = 0
+        self.save_errors = 0
+
+    # ---- keying ------------------------------------------------------
+    @staticmethod
+    def _digest_tree(tree) -> str:
+        """Content digest of a pytree of arrays (forest / DeviceMeta):
+        the executable bakes these in as constants, so identical shapes
+        with different values are different programs."""
+        import jax
+        h = hashlib.sha256()
+        for leaf in jax.tree_util.tree_leaves(tree):
+            a = np.asarray(leaf)
+            h.update(str(a.dtype).encode())
+            h.update(str(a.shape).encode())
+            h.update(np.ascontiguousarray(a).tobytes())
+        return h.hexdigest()
+
+    @staticmethod
+    def backend() -> str:
+        import jax
+        try:
+            return str(jax.default_backend())
+        except Exception:  # noqa: BLE001 — backend not up
+            return "unknown"
+
+    def key(self, kind: str, bucket: int, content_digest: str,
+            extra: str = "") -> str:
+        import jax
+        parts = "|".join([_MAGIC, kind, self.backend(), jax.__version__,
+                          str(int(bucket)), content_digest, extra])
+        return hashlib.sha256(parts.encode()).hexdigest()[:32]
+
+    def _entry_path(self, kind: str, key: str) -> str:
+        return os.path.join(self.path, f"{kind}_{key}{_SUFFIX}")
+
+    # ---- load / save -------------------------------------------------
+    def load(self, kind: str, key: str):
+        """(status, fn): "hit" + a ready executable, "miss" + None for
+        a cold store, "fallback" + None for a present-but-untrusted
+        entry (already reported loudly)."""
+        path = self._entry_path(kind, key)
+        if not os.path.exists(path):
+            return "miss", None
+        try:
+            faults.check("serve_aot_load")
+            with open(path, "rb") as fh:
+                blob = pickle.load(fh)
+            if not (isinstance(blob, dict) and blob.get("magic") == _MAGIC):
+                raise ValueError("bad magic / not an AOT entry")
+            import jax
+            if blob.get("backend") != self.backend():
+                raise ValueError(
+                    f"backend mismatch (entry {blob.get('backend')!r}, "
+                    f"process {self.backend()!r})")
+            if blob.get("jax") != jax.__version__:
+                raise ValueError(
+                    f"jax version mismatch (entry {blob.get('jax')!r}, "
+                    f"process {jax.__version__!r})")
+            from jax.experimental import serialize_executable as se
+            fn = se.deserialize_and_load(
+                blob["payload"], blob["in_tree"], blob["out_tree"])
+            self.loaded += 1
+            return "hit", fn
+        except Exception as exc:  # noqa: BLE001 — fall back to JIT, loudly
+            self.fallbacks += 1
+            log.warning("AOT store: entry %s unusable (%s: %s) — falling "
+                        "back to JIT compile", os.path.basename(path),
+                        type(exc).__name__, exc)
+            obs.event("aot_fallback", kind=kind,
+                      entry=os.path.basename(path),
+                      reason=f"{type(exc).__name__}: {exc}")
+            obs.count("serve/aot_fallbacks")
+            return "fallback", None
+
+    def save(self, kind: str, key: str, compiled, note: dict = None) -> bool:
+        """Serialize a compiled executable into the store (atomic).
+        Returns False on failure — a store write failure costs the next
+        boot a compile, never this process a request."""
+        try:
+            import jax
+            from jax.experimental import serialize_executable as se
+            payload, in_tree, out_tree = se.serialize(compiled)
+            blob = {"magic": _MAGIC, "backend": self.backend(),
+                    "jax": jax.__version__, "kind": kind,
+                    "payload": payload, "in_tree": in_tree,
+                    "out_tree": out_tree, "note": dict(note or {})}
+            atomic_write_bytes(self._entry_path(kind, key),
+                               pickle.dumps(blob, protocol=4))
+            self.saved += 1
+            return True
+        except Exception as exc:  # noqa: BLE001
+            self.save_errors += 1
+            log.warning("AOT store: failed to persist %s/%s (%s: %s)",
+                        kind, key, type(exc).__name__, exc)
+            return False
+
+    # ---- introspection -----------------------------------------------
+    def entries(self) -> list:
+        return store_entries(self.path, _SUFFIX)
+
+    def stats(self) -> dict:
+        return {"dir": self.path, "entries": len(self.entries()),
+                "loaded": self.loaded, "saved": self.saved,
+                "fallbacks": self.fallbacks,
+                "save_errors": self.save_errors}

@@ -1,0 +1,830 @@
+"""Multi-tenant forest arena: many small models, one executable.
+
+The registry scales versions of a FEW models; the "thousands of small
+tenant models" direction (ROADMAP 3, PAPER.md layers 5-7) breaks it:
+every ``PredictorSession`` owns its own bucket family (compiles x
+models), its own device-resident ``ForestArrays`` (HBM x models), and
+its own microbatcher (heavy-tail tenants never fill a wave).  The arena
+packs every resident tenant's trees into ONE stacked forest with a
+per-tree ``model_id`` lane (core/forest.py ``arena_predict_fn``), so:
+
+- **one executable serves every tenant** — per-model routing is baked
+  into the scan as a ``row_model[i] == model_id[t]`` mask, the same
+  trick as the padded query blocks of the rank scorer;
+- **cross-model microbatching** — requests for different tenants share
+  one device launch (each ``Request`` carries its tenant; the execute
+  callback builds the per-row model-id vector), so Zipf-tail traffic
+  amortizes into full waves instead of thousands of 1-row launches;
+- **LRU residency under a byte budget** — ``tpu_serve_arena_bytes``
+  bounds the packed forest; admission past the budget evicts the
+  least-recently-used tenant (its host trees are kept, so its next
+  request re-admits it transparently), with evictions + occupancy
+  surfaced through ``/metrics`` and ``/models``.
+
+Parity contract: an arena-packed tenant predicts BIT-IDENTICALLY to its
+own ``PredictorSession``.  The union bin space quantizes DECISIONS, not
+data — it holds every resident model's thresholds, so each node compare
+stays exact — and the arena scan freezes a row's Kahan (score, comp)
+state across other tenants' trees, so the accumulation trajectory is
+exactly the per-model sequence.  Tenants that type the SAME column
+differently (categorical in one model, numerical in another) get
+distinct physical columns in the union space — the numerical side's
+splits are remapped to an appended column and its input columns are
+scattered to match at binning time, so neither side's bins collapse.
+One documented collapse remains: a shared column's missing type is the
+worst across ALL resident tenants (the same rule ``ServeBinSpace``
+applies across trees within one model), so tenants that disagree on a
+feature's missing-value convention can route NaN/zero rows differently
+than a solo session would.
+
+Rebinning happens at EXECUTE time against an immutable state snapshot
+(space, forest, fn, generation), so a repack mid-flight can never mix a
+request binned in the old space with the new forest.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .. import obs
+from ..robust import faults
+from ..utils import log
+from .batcher import (DeadlineExceeded, MicroBatcher, Request,
+                      ServeOverloadError, normalize_priority)
+from .packing import ServeBinSpace, collect_split_state
+from .session import Ticket, _env_num, _safe_resolve
+
+_LAT_RESERVOIR = 8192
+_CANARY_ROWS = 64      # pinned parity probe per admitted/swapped tenant
+_CANARY_ATOL = 1e-5    # registry canary_gate's own tolerance
+_CANARY_SEED = 17
+
+
+class ArenaTicket(Ticket):
+    """A session ticket plus the tenant that owns the answer — result
+    conversion (objective transform, K-column slice) is per-model."""
+
+    __slots__ = ("model",)
+
+    def __init__(self, parts, rows, raw_score, model,
+                 priority="normal"):
+        super().__init__(parts, rows, raw_score, priority=priority)
+        self.model = model
+
+
+class _Tenant:
+    """Host-side truth for one arena model: the value-space trees (kept
+    across evictions — re-admission repacks from here, no disk round
+    trip), conversion state, and residency bookkeeping."""
+
+    __slots__ = ("name", "trees", "num_tpi", "num_features", "objective",
+                 "average_factor", "resident", "last_used", "version",
+                 "mid")
+
+    def __init__(self, name, trees, num_tpi, num_features, objective,
+                 average_factor, version=1):
+        self.name = name
+        self.trees = trees
+        self.num_tpi = int(num_tpi)
+        self.num_features = int(num_features)
+        self.objective = objective
+        self.average_factor = float(average_factor)
+        self.resident = False
+        self.last_used = time.monotonic()
+        self.version = int(version)
+        self.mid = -1           # model-id lane value while resident
+
+    def host_predict(self, X: np.ndarray) -> np.ndarray:
+        """Value-space host traversal — the parity oracle and the
+        degraded path (mirrors ``PredictorSession._run_host``)."""
+        K = self.num_tpi
+        out = np.zeros((X.shape[0], K))
+        for i, tree in enumerate(self.trees):
+            out[:, i % K] += tree.predict(X[:, :self.num_features])
+        if self.average_factor:
+            out /= self.average_factor
+        return out
+
+
+def _load_tenant(name: str, model, version: int = 1) -> _Tenant:
+    """Normalize a model surface (file path / Booster / GBDT) into a
+    ``_Tenant`` — the same unpacking ``PredictorSession`` does."""
+    gbdt = model
+    if isinstance(model, str):
+        from ..io.model_io import load_model_file
+        gbdt, _ = load_model_file(model)
+    elif hasattr(model, "_gbdt"):   # a basic.Booster
+        gbdt = model._gbdt
+    trees = list(gbdt.models)
+    if not trees:
+        raise ValueError(f"cannot admit empty model {name!r}")
+    K = int(gbdt.num_tpi)
+    if gbdt.train_ds is not None:
+        F = int(gbdt.train_ds.num_total_features)
+    else:
+        F = int(getattr(gbdt, "num_features", 0)
+                or len(getattr(gbdt, "feature_names", []) or []))
+    if F <= 0:
+        raise ValueError(f"model {name!r} declares no feature space")
+    avg = (float(len(trees) // K) if getattr(gbdt, "average_output", False)
+           else 0.0)
+    return _Tenant(name, trees, K, F, getattr(gbdt, "objective", None),
+                   avg, version=version)
+
+
+class _RemapTree:
+    """Packing-only view of a host tree whose split features are moved
+    to arena union columns.  Only ``split_feature`` differs; everything
+    else (thresholds, bitsets, leaf values) delegates to the real tree,
+    which stays untouched for the host parity oracle."""
+
+    __slots__ = ("_t", "split_feature")
+
+    def __init__(self, tree, colmap):
+        self._t = tree
+        nn = max(tree.num_leaves - 1, 0)
+        self.split_feature = [int(colmap[int(f)])
+                              for f in tree.split_feature[:nn]]
+
+    def __getattr__(self, name):
+        return getattr(self._t, name)
+
+
+class _ArenaState:
+    """One immutable pack generation: swap the whole object atomically
+    on repack so in-flight executes stay self-consistent."""
+
+    __slots__ = ("generation", "space", "forest", "fn", "K", "F",
+                 "order", "bytes", "aot_fns", "colmaps")
+
+    def __init__(self, generation, space, forest, fn, K, F, order,
+                 nbytes, colmaps=None):
+        self.generation = generation
+        self.space = space
+        self.forest = forest
+        self.fn = fn
+        self.K = K              # max trees-per-iteration across tenants
+        self.F = F              # union feature width (+ conflict cols)
+        self.order = order      # resident tenant names, pack order
+        self.bytes = nbytes
+        self.aot_fns: dict = {}
+        # tenant name -> union column index per model feature (only for
+        # tenants with a cat/numeric column conflict; identity otherwise)
+        self.colmaps: dict = colmaps or {}
+
+
+class ForestArena:
+    """Pack-many, serve-as-one multi-tenant engine.
+
+    Duck-types the slice of the session surface the HTTP edge and the
+    benches consume (``submit``/``result``/``predict``/``stats``/
+    ``warmup``/``close``/``has``), with every submit carrying a
+    ``model=`` tenant name."""
+
+    def __init__(self, config=None, budget_bytes: Optional[int] = None,
+                 max_batch: Optional[int] = None,
+                 max_wait_ms: Optional[float] = None,
+                 queue_depth: Optional[int] = None):
+        self.config = config
+        self.budget_bytes = int(
+            budget_bytes if budget_bytes is not None else _env_num(
+                "LGBM_TPU_SERVE_ARENA_BYTES", int,
+                getattr(config, "tpu_serve_arena_bytes", 0)))
+        self.max_batch = int(max_batch if max_batch is not None else _env_num(
+            "LGBM_TPU_SERVE_MAX_BATCH", int,
+            getattr(config, "tpu_serve_max_batch", 1024)))
+        self.max_wait_ms = float(
+            max_wait_ms if max_wait_ms is not None else _env_num(
+                "LGBM_TPU_SERVE_MAX_WAIT_MS", float,
+                getattr(config, "tpu_serve_max_wait_ms", 2.0)))
+        self.queue_depth = int(
+            queue_depth if queue_depth is not None else _env_num(
+                "LGBM_TPU_SERVE_QUEUE_DEPTH", int,
+                getattr(config, "tpu_serve_queue_depth", 8192)))
+        self._tenants: Dict[str, _Tenant] = {}
+        self._state: Optional[_ArenaState] = None
+        self._lock = threading.RLock()
+        self._closed = False
+        self._t_start = time.time()
+        # residency + traffic counters
+        self._generation = 0
+        self._evictions = 0
+        self._readmissions = 0
+        self._repacks = 0
+        self._swaps = 0
+        self._swap_rejects = 0
+        self._batches = 0
+        self._cross_model_batches = 0
+        self._real_rows = 0
+        self._padded_rows = 0
+        self._n_req = 0
+        self._n_ok = 0
+        self._n_deadline = 0
+        self._n_overload = 0
+        self._buckets: set = set()
+        self._lat_ms: List[float] = []
+        obs.install_recompile_hook()
+        self._compiles0 = obs.compile_count()
+        # AOT executable store (serve/aot.py): arena packs change with
+        # residency, so each generation loads/persists its own entries
+        from .aot import AOTStore, resolve_aot_dir
+        aot_dir = resolve_aot_dir(config)
+        self._aot = AOTStore(aot_dir) if aot_dir else None
+        self._batcher = MicroBatcher(
+            self._execute_batch, max_batch=self.max_batch,
+            max_wait_s=self.max_wait_ms / 1e3,
+            max_queue_rows=self.queue_depth,
+            name="lgbm-serve-arena")
+
+    # ---- residency ----------------------------------------------------
+    def has(self, name) -> bool:
+        return name in self._tenants
+
+    def tenant_names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._tenants)
+
+    def admit(self, name: str, model, version: Optional[int] = None
+              ) -> dict:
+        """Load + make resident (repacking the arena).  Admitting past
+        the byte budget LRU-evicts cold tenants; admitting an existing
+        name is a hot swap — see ``swap``."""
+        with self._lock:
+            if name in self._tenants:
+                return self.swap(name, model)
+            ten = _load_tenant(name, model,
+                               version=version if version is not None
+                               else 1)
+            ten.resident = True
+            ten.last_used = time.monotonic()
+            self._tenants[name] = ten
+            try:
+                self._repack(protect=name)
+            except Exception:
+                # a pack that cannot be built must not strand a broken
+                # tenant in the table
+                del self._tenants[name]
+                self._repack_existing()
+                raise
+            st = self._state
+            obs.event("arena_admit", model=name,
+                      tenants=len(self._tenants),
+                      resident=len(st.order), bytes=int(st.bytes),
+                      readmit=False)
+            return {"ok": True, "model": name, "resident": True,
+                    "generation": st.generation, "bytes": int(st.bytes)}
+
+    def evict(self, name: str, reason: str = "manual") -> bool:
+        """Drop a tenant from the device pack (host trees are kept, so
+        its next request re-admits it)."""
+        with self._lock:
+            ten = self._tenants.get(name)
+            if ten is None or not ten.resident:
+                return False
+            ten.resident = False
+            self._evictions += 1
+            obs.event("arena_evict", model=name, reason=reason)
+            obs.count("serve/arena_evictions")
+            self._repack_existing()
+            return True
+
+    def remove(self, name: str) -> bool:
+        """Forget a tenant entirely (trees included)."""
+        with self._lock:
+            if name not in self._tenants:
+                return False
+            del self._tenants[name]
+            self._repack_existing()
+            return True
+
+    def swap(self, name: str, model) -> dict:
+        """Hot-swap one tenant behind a parity canary: the candidate is
+        packed into a CANDIDATE generation and its arena predictions are
+        checked against its own host oracle on a pinned probe set before
+        the flip — a bad artifact never reaches traffic (the old trees
+        keep serving)."""
+        with self._lock:
+            faults.check("serve_arena_swap")
+            old = self._tenants.get(name)
+            if old is None:
+                return self.admit(name, model)
+            cand = _load_tenant(name, model, version=old.version + 1)
+            cand.resident = True
+            cand.last_used = time.monotonic()
+            self._tenants[name] = cand
+            try:
+                self._repack(protect=name)
+                self._canary(cand)
+            except Exception as exc:
+                # roll back: restore the old tenant and its pack
+                self._tenants[name] = old
+                self._repack_existing()
+                self._swap_rejects += 1
+                obs.event("arena_swap", model=name, ok=False,
+                          error=f"{type(exc).__name__}: {exc}")
+                raise
+            self._swaps += 1
+            st = self._state
+            obs.event("arena_swap", model=name, ok=True,
+                      version=cand.version, generation=st.generation)
+            return {"ok": True, "model": name,
+                    "to_version": cand.version,
+                    "generation": st.generation}
+
+    def _canary(self, ten: _Tenant) -> None:
+        """Pinned-probe parity gate for one tenant against its own host
+        oracle (the registry canary's arbiter, same tolerance)."""
+        rng = np.random.default_rng(_CANARY_SEED)
+        X = rng.standard_normal((_CANARY_ROWS, ten.num_features))
+        got = self._device_predict_sync(X, ten)
+        want = ten.host_predict(X)
+        if not np.all(np.isfinite(got)):
+            raise RuntimeError("arena canary: non-finite predictions")
+        err = float(np.max(np.abs(got - want))) if got.size else 0.0
+        if err > _CANARY_ATOL:
+            raise RuntimeError(
+                f"arena canary: parity {err:.3g} > {_CANARY_ATOL}")
+
+    def _lru_candidates(self, protect: Optional[str]) -> List[_Tenant]:
+        """Resident tenants, coldest first, excluding ``protect``."""
+        cands = [t for t in self._tenants.values()
+                 if t.resident and t.name != protect]
+        cands.sort(key=lambda t: t.last_used)
+        return cands
+
+    def _repack_existing(self) -> None:
+        self._repack(protect=None)
+
+    def _repack(self, protect: Optional[str]) -> None:
+        """Rebuild the device pack from the resident set, LRU-evicting
+        under the byte budget (``protect`` is the tenant being admitted
+        — it never evicts itself).  Called with the lock held."""
+        t0 = time.perf_counter()
+        while True:
+            resident = [t for t in self._tenants.values() if t.resident]
+            if not resident:
+                self._generation += 1
+                self._state = None
+                for t in self._tenants.values():
+                    t.mid = -1
+                return
+            state = self._build_state(resident)
+            if (self.budget_bytes <= 0 or state.bytes <= self.budget_bytes
+                    or len(resident) <= 1):
+                break
+            victims = self._lru_candidates(protect)
+            if not victims:
+                break
+            v = victims[0]
+            v.resident = False
+            v.mid = -1
+            self._evictions += 1
+            log.info("arena: evicting %r (LRU, %d bytes over budget %d)",
+                     v.name, state.bytes, self.budget_bytes)
+            obs.event("arena_evict", model=v.name, reason="budget",
+                      bytes=int(state.bytes))
+            obs.count("serve/arena_evictions")
+        self._state = state
+        for t in self._tenants.values():
+            if not t.resident:
+                t.mid = -1      # stale lanes must never match a row
+        self._repacks += 1
+        ms = (time.perf_counter() - t0) * 1e3
+        obs.event("arena_repack", generation=state.generation,
+                  tenants=len(state.order),
+                  trees=int(np.asarray(state.forest.num_leaves).shape[0]),
+                  bytes=int(state.bytes), ms=round(ms, 3))
+
+    def _build_state(self, resident: List[_Tenant]) -> _ArenaState:
+        """Pack a resident set: union bin space over every tenant's
+        trees, one stacked forest with the model-id lane, one jitted (or
+        AOT-loaded) arena scan."""
+        import jax
+        from ..core.forest import arena_predict_fn
+        resident = sorted(resident, key=lambda t: t.name)
+        F0 = max(t.num_features for t in resident)
+        K = max(t.num_tpi for t in resident)
+        # per-tenant column typing: a column categorical in one model
+        # and numerical in another cannot share a union column (cat bins
+        # are raw category values, numeric bins are threshold ranks) —
+        # the numerical side gets an appended physical column instead
+        num_used, cat_used = {}, {}
+        u_num = np.zeros(F0, bool)
+        u_cat = np.zeros(F0, bool)
+        for ten in resident:
+            thr, _, ic, _, _ = collect_split_state(ten.trees,
+                                                   ten.num_features)
+            nu = np.array([bool(v) for v in thr], bool)
+            num_used[ten.name], cat_used[ten.name] = nu, ic
+            u_num[:nu.size] |= nu
+            u_cat[:ic.size] |= ic
+        conflict = {int(f): F0 + j
+                    for j, f in enumerate(np.nonzero(u_num & u_cat)[0])}
+        F = F0 + len(conflict)
+        colmaps: Dict[str, np.ndarray] = {}
+        all_trees, class_ids, model_ids = [], [], []
+        for mid, ten in enumerate(resident):
+            ten.mid = mid
+            trees = ten.trees
+            cm = np.arange(ten.num_features, dtype=np.int32)
+            moved = False
+            for f, dest in conflict.items():
+                if (f < ten.num_features and num_used[ten.name][f]
+                        and not cat_used[ten.name][f]):
+                    cm[f] = dest
+                    moved = True
+            if moved:
+                colmaps[ten.name] = cm
+                trees = [_RemapTree(t, cm) for t in trees]
+            for i, tree in enumerate(trees):
+                all_trees.append(tree)
+                class_ids.append(i % ten.num_tpi)
+                model_ids.append(mid)
+        space = ServeBinSpace(all_trees, F)
+        forest = space.pack(all_trees,
+                            np.asarray(class_ids, np.int32),
+                            model_ids=np.asarray(model_ids, np.int32))
+        fn = arena_predict_fn(space.meta, K)
+        nbytes = sum(int(leaf.nbytes)
+                     for leaf in jax.tree_util.tree_leaves(forest)
+                     if hasattr(leaf, "nbytes"))
+        self._generation += 1
+        state = _ArenaState(self._generation, space, forest, fn, K, F,
+                            [t.name for t in resident], nbytes,
+                            colmaps=colmaps)
+        if self._aot is not None:
+            digest = type(self._aot)._digest_tree((forest, space.meta))
+            extra = f"K={K}|F={F}|arena"
+            for b in self._bucket_sweep():
+                status, afn = self._aot.load("arena", self._aot.key(
+                    "arena", b, digest, extra))
+                if status == "hit":
+                    state.aot_fns[b] = afn
+            # keep key inputs for warmup-time export
+            self._aot_key_parts = (digest, extra)
+        return state
+
+    # ---- serving ------------------------------------------------------
+    def _bucket_sweep(self):
+        from .session import PredictorSession
+        return PredictorSession._bucket_sweep(self.max_batch)
+
+    def _bucket(self, n: int) -> int:
+        b = 1
+        while b < n:
+            b *= 2
+        return min(b, self.max_batch)
+
+    def warmup(self) -> int:
+        """Pre-compile (or AOT-load) every bucket of the CURRENT
+        generation; with the store armed, missing buckets are exported
+        so the next process boots compile-free."""
+        with self._lock:
+            state = self._state
+        if state is None:
+            return 0
+        n = 0
+        for size in self._bucket_sweep():
+            self._dispatch(state, np.zeros((size, state.F), np.int32),
+                           np.full(size, -1, np.int32), export=True)
+            n += 1
+        return n
+
+    def _dispatch(self, state: _ArenaState, bins: np.ndarray,
+                  row_model: np.ndarray, export: bool = False):
+        """Pad to the pow2 bucket and run one arena launch.  Pad rows
+        carry model id -1, which matches no tree and scores zero."""
+        import jax.numpy as jnp
+        n = bins.shape[0]
+        b = self._bucket(n)
+        if b > n:
+            bins = np.concatenate(
+                [bins, np.zeros((b - n, bins.shape[1]), bins.dtype)])
+            row_model = np.concatenate(
+                [row_model, np.full(b - n, -1, np.int32)])
+        with self._lock:
+            self._buckets.add(b)
+        faults.check("serve_arena_device")
+        fn = state.aot_fns.get(b)
+        if fn is None and export and self._aot is not None:
+            fn = self._aot_export(state, b)
+        if fn is not None:
+            out = fn(state.forest, jnp.asarray(bins),
+                     jnp.asarray(row_model))
+        else:
+            out = state.fn(state.forest, jnp.asarray(bins),
+                           jnp.asarray(row_model))
+        return np.asarray(out, dtype=np.float64)[:n], b
+
+    def _aot_export(self, state: _ArenaState, size: int):
+        """Lower + compile one arena bucket, register it for dispatch,
+        persist it (best-effort, like the session's ``_aot_export``)."""
+        import jax.numpy as jnp
+        try:
+            digest, extra = self._aot_key_parts
+            bins = jnp.asarray(np.zeros((size, state.F), np.int32))
+            rm = jnp.asarray(np.zeros(size, np.int32))
+            comp = state.fn.lower(state.forest, bins, rm).compile()
+            state.aot_fns[size] = comp
+            self._aot.save("arena", self._aot.key("arena", size, digest,
+                                                  extra), comp,
+                           note={"bucket": size,
+                                 "generation": state.generation})
+            return comp
+        except Exception as exc:  # noqa: BLE001 — store is best-effort
+            log.warning("arena AOT export failed for bucket %d (%s: %s)",
+                        size, type(exc).__name__, exc)
+            return None
+
+    def _resolve(self, model: Optional[str]) -> _Tenant:
+        """Tenant lookup + transparent re-admission: a known-but-evicted
+        tenant repacks back in on its next request (LRU may push out a
+        colder sibling)."""
+        with self._lock:
+            if model is None:
+                if len(self._tenants) == 1:
+                    model = next(iter(self._tenants))
+                else:
+                    raise KeyError(
+                        "arena holds multiple tenants — requests must "
+                        "name one (model=...)")
+            ten = self._tenants.get(model)
+            if ten is None:
+                raise KeyError(f"unknown arena tenant {model!r}")
+            ten.last_used = time.monotonic()
+            if not ten.resident:
+                ten.resident = True
+                self._readmissions += 1
+                self._repack(protect=ten.name)
+                st = self._state
+                obs.event("arena_admit", model=ten.name,
+                          tenants=len(self._tenants),
+                          resident=len(st.order) if st else 0,
+                          bytes=int(st.bytes) if st else 0,
+                          readmit=True)
+            return ten
+
+    def submit(self, X, model: Optional[str] = None,
+               deadline_ms: Optional[float] = None,
+               raw_score: bool = False,
+               trace_id: Optional[str] = None,
+               parent_id: Optional[str] = None,
+               priority: str = "normal") -> ArenaTicket:
+        """Queue rows for the next coalesced (possibly cross-model)
+        batch.  The raw float rows ride the request; binning happens at
+        execute time against the live pack generation, so a repack
+        between submit and execute stays consistent."""
+        if self._closed:
+            raise RuntimeError("arena is closed")
+        ten = self._resolve(model)
+        X = np.ascontiguousarray(np.asarray(X), dtype=np.float64)
+        if X.ndim == 1:
+            X = X[None, :]
+        if X.ndim != 2 or X.shape[1] != ten.num_features:
+            raise ValueError(
+                f"The number of features in data "
+                f"({X.shape[1] if X.ndim == 2 else '?'}) is not the same "
+                f"as it was in training data ({ten.num_features})")
+        priority = normalize_priority(priority)
+        deadline = (time.monotonic() + float(deadline_ms) / 1e3
+                    if deadline_ms is not None else None)
+        parts = []
+        try:
+            for lo in range(0, max(X.shape[0], 1), self.max_batch):
+                chunk = X[lo:lo + self.max_batch]
+                req = Request(chunk, chunk, deadline=deadline,
+                              trace_id=trace_id, parent_id=parent_id,
+                              priority=priority, model=ten.name)
+                parts.append((self._batcher.submit(req), chunk.shape[0]))
+        except ServeOverloadError:
+            with self._lock:
+                self._n_overload += 1
+            for fut, _ in parts:
+                fut.cancel()
+            raise
+        return ArenaTicket(parts, int(X.shape[0]), raw_score, ten.name,
+                           priority=priority)
+
+    def result(self, ticket: ArenaTicket,
+               timeout: Optional[float] = None) -> np.ndarray:
+        end = None if timeout is None else time.monotonic() + timeout
+        chunks = []
+        try:
+            for fut, _ in ticket.parts:
+                left = (None if end is None
+                        else max(end - time.monotonic(), 0.0))
+                chunks.append(fut.result(left))
+        except BaseException as exc:
+            if not ticket.counted:
+                ticket.counted = True
+                with self._lock:
+                    self._n_req += 1
+                    if isinstance(exc, DeadlineExceeded):
+                        self._n_deadline += 1
+            raise
+        raw = chunks[0] if len(chunks) == 1 else np.concatenate(chunks)
+        if not ticket.counted:
+            ticket.counted = True
+            total_ms = (time.perf_counter() - ticket.t0) * 1e3
+            with self._lock:
+                self._n_req += 1
+                self._n_ok += 1
+                self._lat_ms.append(total_ms)
+                if len(self._lat_ms) > _LAT_RESERVOIR:
+                    del self._lat_ms[:_LAT_RESERVOIR // 2]
+            obs.event("serve_request", rows=int(ticket.rows),
+                      total_ms=round(total_ms, 3), ok=True)
+        ten = self._tenants[ticket.model]
+        out = raw[:, :ten.num_tpi]
+        squeezed = out if ten.num_tpi > 1 else out[:, 0]
+        if ticket.raw_score or ten.objective is None:
+            return squeezed
+        return np.asarray(ten.objective.convert_output(squeezed))
+
+    def predict(self, X, model: Optional[str] = None,
+                raw_score: bool = False) -> np.ndarray:
+        """Synchronous path (bypasses the queue, shares the buckets)."""
+        ten = self._resolve(model)
+        X = np.ascontiguousarray(np.asarray(X), dtype=np.float64)
+        if X.ndim == 1:
+            X = X[None, :]
+        raw = self._device_predict_sync(X, ten)
+        squeezed = raw if ten.num_tpi > 1 else raw[:, 0]
+        if raw_score or ten.objective is None:
+            return squeezed
+        return np.asarray(ten.objective.convert_output(squeezed))
+
+    def _device_predict_sync(self, X: np.ndarray, ten: _Tenant
+                             ) -> np.ndarray:
+        with self._lock:
+            state = self._state
+        if state is None or not ten.resident:
+            return ten.host_predict(X)
+        out = np.zeros((X.shape[0], ten.num_tpi))
+        for lo in range(0, X.shape[0], self.max_batch):
+            chunk = X[lo:lo + self.max_batch]
+            bins = state.space.bin_matrix(
+                self._project(chunk, state, ten.name))
+            rm = np.full(chunk.shape[0], ten.mid, np.int32)
+            raw, _ = self._dispatch(state, bins, rm)
+            out[lo:lo + chunk.shape[0]] = raw[:, :ten.num_tpi]
+        if ten.average_factor:
+            out /= ten.average_factor
+        return out
+
+    @staticmethod
+    def _project(X: np.ndarray, state: "_ArenaState", name: str
+                 ) -> np.ndarray:
+        """Place a tenant's raw columns at its union positions.  For
+        most tenants that is plain zero-padding to the union width: the
+        extra columns belong to other tenants' spaces — this tenant's
+        trees never split on them, and cross-tenant tree hits are masked
+        anyway.  Tenants holding the numerical side of a cat/numeric
+        column conflict scatter through their colmap so each value lands
+        in the column their remapped trees split on."""
+        cm = state.colmaps.get(name)
+        if cm is None:
+            if X.shape[1] >= state.F:
+                return X
+            return np.concatenate(
+                [X, np.zeros((X.shape[0], state.F - X.shape[1]),
+                             X.dtype)], axis=1)
+        out = np.zeros((X.shape[0], state.F), np.float64)
+        out[:, cm] = X[:, :cm.size]
+        return out
+
+    # ---- batcher callback ---------------------------------------------
+    def _execute_batch(self, reqs) -> None:
+        """Coalesce a (possibly multi-tenant) wave into one launch."""
+        now = time.monotonic()
+        live = []
+        for r in reqs:
+            if r.future.cancelled():
+                continue
+            if r.deadline is not None and now > r.deadline:
+                waited = (now - r.t_submit) * 1e3
+                _safe_resolve(r.future, error=DeadlineExceeded(
+                    f"request expired after {waited:.1f}ms in queue"))
+            else:
+                live.append(r)
+        if not live:
+            return
+        with self._lock:
+            state = self._state
+            mids = {r.model: self._tenants[r.model].mid for r in live}
+        rows = sum(r.n for r in live)
+        models = {r.model for r in live}
+        t0 = time.perf_counter()
+        raw, bucket = None, rows
+        if state is not None and all(m >= 0 for m in mids.values()):
+            try:
+                bins = np.concatenate(
+                    [state.space.bin_matrix(
+                        self._project(r.raw, state, r.model))
+                     for r in live])
+                row_model = np.concatenate(
+                    [np.full(r.n, mids[r.model], np.int32) for r in live])
+                raw, bucket = self._dispatch(state, bins, row_model)
+            except Exception as exc:  # noqa: BLE001 — degrade, don't fail
+                log.warning("arena device launch failed (%s: %s); host "
+                            "fallback for this batch",
+                            type(exc).__name__, exc)
+                obs.event("serve_degraded", plane="arena",
+                          error=f"{type(exc).__name__}: {exc}")
+                raw = None
+        off = 0
+        for r in live:
+            if raw is None:
+                ten = self._tenants[r.model]
+                host = ten.host_predict(r.raw)
+                full = np.zeros((r.n, state.K if state else ten.num_tpi))
+                full[:, :ten.num_tpi] = host
+                _safe_resolve(r.future, result=full)
+            else:
+                ten = self._tenants[r.model]
+                part = np.array(raw[off:off + r.n])
+                if ten.average_factor:
+                    part /= ten.average_factor
+                _safe_resolve(r.future, result=part)
+            off += r.n
+        exec_ms = (time.perf_counter() - t0) * 1e3
+        with self._lock:
+            self._batches += 1
+            if len(models) > 1:
+                self._cross_model_batches += 1
+            self._real_rows += rows
+            self._padded_rows += bucket
+        obs.event("serve_batch", rows=rows, padded=int(bucket),
+                  requests=len(live), queue_rows=self._batcher.queue_rows,
+                  exec_ms=round(exec_ms, 3),
+                  degraded=raw is None, models=len(models))
+
+    # ---- introspection ------------------------------------------------
+    def tenants(self) -> List[dict]:
+        """Per-tenant residency rows for /models."""
+        with self._lock:
+            now = time.monotonic()
+            return [{"name": t.name, "resident": t.resident,
+                     "version": t.version, "num_class": t.num_tpi,
+                     "num_features": t.num_features,
+                     "trees": len(t.trees),
+                     "idle_s": round(now - t.last_used, 1)}
+                    for t in sorted(self._tenants.values(),
+                                    key=lambda t: t.name)]
+
+    def stats(self) -> dict:
+        from ..obs.report import percentile
+        with self._lock:
+            state = self._state
+            lat = sorted(self._lat_ms)
+            resident = sum(1 for t in self._tenants.values() if t.resident)
+            return {
+                "tenants": len(self._tenants),
+                "resident": resident,
+                "generation": self._generation,
+                "packed_bytes": int(state.bytes) if state else 0,
+                "budget_bytes": self.budget_bytes,
+                "evictions": self._evictions,
+                "readmissions": self._readmissions,
+                "repacks": self._repacks,
+                "swaps": self._swaps,
+                "swap_rejects": self._swap_rejects,
+                "requests": self._n_req,
+                "ok": self._n_ok,
+                "deadline_missed": self._n_deadline,
+                "overloads": self._n_overload,
+                "batches": self._batches,
+                "cross_model_batches": self._cross_model_batches,
+                "rows": self._real_rows,
+                "padded_rows": self._padded_rows,
+                "occupancy": (round(self._real_rows / self._padded_rows, 4)
+                              if self._padded_rows else None),
+                "p50_ms": percentile(lat, 0.50),
+                "p99_ms": percentile(lat, 0.99),
+                "buckets": sorted(self._buckets),
+                "max_batch": self.max_batch,
+                "queue_rows": (0 if self._closed
+                               else self._batcher.queue_rows),
+                "uptime_s": round(time.time() - self._t_start, 1),
+                "compile_count": int(obs.compile_count()
+                                     - self._compiles0),
+                "aot": self._aot.stats() if self._aot else None,
+            }
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            self._batcher.close()
+            if obs.enabled():
+                obs.event("arena_stop", tenants=len(self._tenants),
+                          evictions=self._evictions,
+                          repacks=self._repacks)
+
+    def __enter__(self) -> "ForestArena":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

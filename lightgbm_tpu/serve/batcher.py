@@ -62,15 +62,23 @@ class Request:
     Request-level accounting lives in the session's ``result()`` (one
     count per ticket); this carries only the batching state plus the
     trace context (trace_id minted at the HTTP edge, parent_id = the
-    request's root span) the session's span emission attributes to."""
+    request's root span) the session's span emission attributes to.
+
+    ``model`` is the cross-model coalescing lane (serve/arena.py): an
+    arena batcher mixes requests for DIFFERENT resident tenants in one
+    device launch, so each request carries its tenant and the execute
+    callback builds the per-row model-id vector from it.  None outside
+    an arena — single-model sessions never read it."""
 
     __slots__ = ("bins", "raw", "n", "future", "deadline", "t_submit",
-                 "t_submit_wall", "trace_id", "parent_id", "priority")
+                 "t_submit_wall", "trace_id", "parent_id", "priority",
+                 "model")
 
     def __init__(self, bins, raw, deadline: Optional[float] = None,
                  trace_id: Optional[str] = None,
                  parent_id: Optional[str] = None,
-                 priority: str = "normal"):
+                 priority: str = "normal",
+                 model: Optional[str] = None):
         self.bins = bins
         self.raw = raw
         self.n = int(bins.shape[0])
@@ -81,6 +89,7 @@ class Request:
         self.trace_id = trace_id
         self.parent_id = parent_id
         self.priority = normalize_priority(priority)
+        self.model = model
 
 
 class MicroBatcher:

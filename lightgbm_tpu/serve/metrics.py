@@ -203,6 +203,91 @@ def _fmt(v) -> str:
 _DRIFT_KINDS = ("psi_max", "psi_mean", "ks_max", "pred_psi", "pred_ks")
 
 
+def _aot_series(out, head, ao) -> None:
+    """Render one AOT-store stats dict (serve/aot.py
+    ``AOTStore.stats()``) as the ``tpu_serve_aot_*`` series.  The
+    fallbacks counter is the alert surface: a fleet silently re-paying
+    JIT compiles at boot shows up here, not in a crash log."""
+    head("tpu_serve_aot_entries", "gauge",
+         "Serialized executables resident in the AOT store directory.")
+    out.append("tpu_serve_aot_entries %d" % int(ao.get("entries") or 0))
+    head("tpu_serve_aot_loaded_total", "counter",
+         "Executables deserialized from the AOT store (each one is a "
+         "JIT compile the boot path did not pay).")
+    out.append("tpu_serve_aot_loaded_total %d" % int(ao.get("loaded")
+                                                     or 0))
+    head("tpu_serve_aot_saved_total", "counter",
+         "Executables serialized into the AOT store by this process.")
+    out.append("tpu_serve_aot_saved_total %d" % int(ao.get("saved") or 0))
+    head("tpu_serve_aot_fallbacks_total", "counter",
+         "AOT entries present but unusable (corrupt/stale/cross-"
+         "backend) — each one fell back to a JIT compile, loudly.")
+    out.append("tpu_serve_aot_fallbacks_total %d"
+               % int(ao.get("fallbacks") or 0))
+    head("tpu_serve_aot_save_errors_total", "counter",
+         "Failed attempts to persist an executable (costs the next "
+         "boot a compile, never this process a request).")
+    out.append("tpu_serve_aot_save_errors_total %d"
+               % int(ao.get("save_errors") or 0))
+
+
+def _arena_series(out, head, ast) -> None:
+    """Render one forest-arena stats dict (serve/arena.py
+    ``ForestArena.stats()``) as the ``tpu_serve_arena_*`` series."""
+    head("tpu_serve_arena_tenants", "gauge",
+         "Tenant models known to the arena (resident + evicted).")
+    out.append("tpu_serve_arena_tenants %d" % int(ast.get("tenants")
+                                                  or 0))
+    head("tpu_serve_arena_resident", "gauge",
+         "Tenant models currently packed into the device arena.")
+    out.append("tpu_serve_arena_resident %d" % int(ast.get("resident")
+                                                   or 0))
+    head("tpu_serve_arena_bytes", "gauge",
+         "Device bytes of the packed multi-tenant forest.")
+    out.append("tpu_serve_arena_bytes %d" % int(ast.get("packed_bytes")
+                                                or 0))
+    head("tpu_serve_arena_budget_bytes", "gauge",
+         "Configured arena residency budget (tpu_serve_arena_bytes; "
+         "0 = unbounded).")
+    out.append("tpu_serve_arena_budget_bytes %d"
+               % int(ast.get("budget_bytes") or 0))
+    head("tpu_serve_arena_evictions_total", "counter",
+         "Tenants LRU-evicted from the arena (budget pressure or "
+         "manual).")
+    out.append("tpu_serve_arena_evictions_total %d"
+               % int(ast.get("evictions") or 0))
+    head("tpu_serve_arena_readmissions_total", "counter",
+         "Evicted tenants transparently repacked on their next "
+         "request.")
+    out.append("tpu_serve_arena_readmissions_total %d"
+               % int(ast.get("readmissions") or 0))
+    head("tpu_serve_arena_repacks_total", "counter",
+         "Arena pack generations built (admissions, evictions, swaps).")
+    out.append("tpu_serve_arena_repacks_total %d"
+               % int(ast.get("repacks") or 0))
+    head("tpu_serve_arena_batches_total", "counter",
+         "Coalesced arena batches executed.")
+    out.append("tpu_serve_arena_batches_total %d"
+               % int(ast.get("batches") or 0))
+    head("tpu_serve_arena_cross_model_batches_total", "counter",
+         "Arena batches that coalesced requests for more than one "
+         "tenant into a single device launch.")
+    out.append("tpu_serve_arena_cross_model_batches_total %d"
+               % int(ast.get("cross_model_batches") or 0))
+    head("tpu_serve_arena_occupancy", "gauge",
+         "Real rows / padded rows across arena launches.")
+    out.append("tpu_serve_arena_occupancy %s"
+               % _fmt(ast.get("occupancy")))
+    head("tpu_serve_arena_requests_total", "counter",
+         "Requests answered by the arena, by outcome.")
+    out.append('tpu_serve_arena_requests_total{outcome="ok"} %d'
+               % int(ast.get("ok") or 0))
+    out.append('tpu_serve_arena_requests_total{outcome="deadline"} %d'
+               % int(ast.get("deadline_missed") or 0))
+    out.append('tpu_serve_arena_requests_total{outcome="overload"} %d'
+               % int(ast.get("overloads") or 0))
+
+
 def _drift_series(out, head, dr) -> None:
     """Render one drift-monitor status dict (obs/drift.py
     ``DriftMonitor.status()``) as the ``tpu_serve_drift_*`` series.
@@ -376,6 +461,11 @@ def render_prometheus(session) -> str:
     dr = st.get("drift")
     if isinstance(dr, dict) and dr.get("armed"):
         _drift_series(out, head, dr)
+    # AOT executable store (serve/aot.py): rendered only when armed so
+    # a storeless session's exposition is unchanged
+    ao = st.get("aot")
+    if isinstance(ao, dict):
+        _aot_series(out, head, ao)
     if st.get("resident_bytes") is not None:
         head("tpu_serve_resident_bytes", "gauge",
              "Device bytes held resident by this serving target "
@@ -444,6 +534,24 @@ def render_prometheus_fleet(registry) -> str:
         if not m.get("default") and isinstance(dr, dict) \
                 and dr.get("armed"):
             _drift_series(out, head_once, dr)
+    # multi-tenant forest arena (serve/arena.py): occupancy, residency
+    # and eviction pressure for the packed-tenant plane, plus its own
+    # AOT store counters when armed
+    arena = getattr(registry, "arena", None)
+    if arena is not None:
+        try:
+            ast = arena.stats()
+        except Exception:  # noqa: BLE001 — a scrape never fails for a
+            # closing arena
+            ast = None
+        if ast:
+            _arena_series(out, head, ast)
+            # the default router's section may already carry the
+            # tpu_serve_aot_* series (same store directory) — render
+            # the arena's copy only when it did not
+            if (isinstance(ast.get("aot"), dict)
+                    and "tpu_serve_aot_entries" not in out[0]):
+                _aot_series(out, head_once, ast["aot"])
     # online learning loop (online/loop.py): the run_online driver
     # parks its stats provider on the registry so one fleet scrape
     # covers serving AND the refresh loop feeding it

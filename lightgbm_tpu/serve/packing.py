@@ -195,11 +195,14 @@ class ServeBinSpace:
         return out
 
     def pack(self, trees, class_ids: np.ndarray,
-             with_counts: bool = False):
-        """Stack a tree window into one device-ready ``ForestArrays``."""
+             with_counts: bool = False, model_ids=None):
+        """Stack a tree window into one device-ready ``ForestArrays``.
+        ``model_ids`` ([T] i32) stamps the per-tree tenant lane when this
+        space packs a multi-tenant arena (serve/arena.py)."""
         from ..core.forest import stack_forest
         return stack_forest([self.tree_arrays_np(t, with_counts=with_counts)
                              for t in trees],
                             np.asarray(class_ids, np.int32),
                             min_words=self.min_words,
-                            with_counts=with_counts)
+                            with_counts=with_counts,
+                            model_ids=model_ids)

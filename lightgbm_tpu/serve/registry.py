@@ -158,6 +158,16 @@ class ModelRegistry:
         # online-loop stats provider (online/loop.py run_online wires
         # loop.stats here) — rendered into the fleet /metrics
         self.online_provider = None
+        # multi-tenant forest arena (serve/arena.py): registered model
+        # names always win; names known only to the arena route there
+        self.arena = None
+
+    def attach_arena(self, arena) -> "ModelRegistry":
+        """Attach a ``ForestArena`` so arena tenants serve through the
+        fleet surface (HTTP routing, /models, /metrics).  Returns self
+        for chaining."""
+        self.arena = arena
+        return self
 
     # ------------------------------------------------------------------
     def _build_version(self, entry: _Entry, model) -> _Version:
@@ -573,6 +583,12 @@ class ModelRegistry:
         return out
 
     def submit(self, X, model: Optional[str] = None, **kw):
+        # registered versions shadow arena tenants of the same name —
+        # the governed (canary/rollback) plane wins a collision
+        if (self.arena is not None and model is not None
+                and model not in self._models
+                and self.arena.has(model)):
+            return self.arena.submit(X, model=model, **kw)
         ver = self.resolve(model)
         return ver.router.submit(X, **kw)
 
@@ -584,8 +600,12 @@ class ModelRegistry:
         # a RoutedTicket carries its issuing router — redemption never
         # touches the (possibly since-swapped) live pointer, so a ticket
         # submitted before a flip completes against the version that
-        # issued it (and keeps the router's breaker accounting)
-        return ticket.router.result(ticket, timeout)
+        # issued it (and keeps the router's breaker accounting).  Arena
+        # tickets have no router; they redeem against the arena.
+        router = getattr(ticket, "router", None)
+        if router is None and self.arena is not None:
+            return self.arena.result(ticket, timeout)
+        return router.result(ticket, timeout)
 
     def stats(self) -> dict:
         with self._lock:
@@ -604,10 +624,13 @@ class ModelRegistry:
             entries = list(self._models.values())
             self._models.clear()
             self._default = None
+            arena, self.arena = self.arena, None
         for e in entries:
             for v in (e.live, e.previous):
                 if v is not None:
                     v.router.close()
+        if arena is not None:
+            arena.close()
 
     def __enter__(self) -> "ModelRegistry":
         return self

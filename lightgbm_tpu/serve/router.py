@@ -136,6 +136,13 @@ class ReplicaRouter:
                  **session_kw):
         self.name = name
         self.version = version
+        # the model source + build inputs are kept so restart_replica
+        # can cold-boot a replacement replica (chaos: replica restart
+        # under load); caller-provided session lists have no source to
+        # rebuild from, so restart is unsupported there
+        self._model_src = model if sessions is None else None
+        self._config = config
+        self._session_kw = dict(session_kw)
         if sessions is None:
             n = max(int(n_replicas), 1)
             devices = self._replica_devices(n)
@@ -326,6 +333,54 @@ class ReplicaRouter:
         self.replicas[idx].draining = False
         obs.event("serve_drain", replica=idx, draining=False)
 
+    def restart_replica(self, idx: int) -> dict:
+        """Kill one replica and cold-boot a replacement in place: a new
+        ``PredictorSession`` packed from the router's model source, a
+        fresh breaker, the shared metrics/drift.  With an AOT store
+        armed the reboot loads its bucket executables instead of
+        compiling — the "replica restart under load" chaos scenario
+        asserts the rebooted replica's first request pays zero JIT
+        compiles.  In-flight work on the old replica fails over like any
+        dispatch failure (its batcher drains with errors on close)."""
+        if self._model_src is None:
+            raise RuntimeError(
+                "router built from caller-provided sessions has no "
+                "model source to restart a replica from")
+        rep = self.replicas[idx]
+        rep.draining = True          # drop out of the routing set now
+        device = getattr(rep.session, "_device", None)
+        t0 = time.perf_counter()
+        c0 = obs.compile_count()
+        sess = PredictorSession(self._model_src, config=self._config,
+                                metrics=self.metrics, device=device,
+                                drift=self.drift, **self._session_kw)
+        sess.model_name = self.name
+        sess.model_version = self.version
+        sess.replica_id = f"r{idx}"
+        cfg = self._config if not isinstance(self._config, dict) else None
+        trip = int(getattr(cfg, "tpu_serve_breaker_trip", 3) or 3)
+        base = float(getattr(cfg, "tpu_serve_breaker_backoff_s", 0.5)
+                     or 0.5)
+        fresh = Replica(idx, sess,
+                        CircuitBreaker(trip_after=trip,
+                                       backoff_base_s=base, seed=idx))
+        old = rep.session
+        self.replicas[idx] = fresh   # atomic: list item assignment
+        try:
+            old.close()
+        except Exception as exc:  # noqa: BLE001 — replacement already live
+            log.warning("restart_replica(%d): old session close failed "
+                        "(%s: %s)", idx, type(exc).__name__, exc)
+        boot = {"replica": idx,
+                "boot_ms": round((time.perf_counter() - t0) * 1e3, 3),
+                "boot_compiles": int(obs.compile_count() - c0),
+                "aot": (sess.stats() or {}).get("aot") is not None}
+        obs.event("serve_replica_restart", **boot)
+        log.info("router: replica r%d restarted in %.1fms "
+                 "(%d compile(s) at boot)", idx, boot["boot_ms"],
+                 boot["boot_compiles"])
+        return boot
+
     def routable_count(self) -> int:
         return sum(1 for r in self.replicas
                    if not r.draining and r.breaker.state != "open")
@@ -390,6 +445,21 @@ class ReplicaRouter:
         agg["routable_replicas"] = self.routable_count()
         agg["failovers"] = self.failovers
         agg["resident_bytes"] = self.resident_bytes()
+        # AOT executable store (serve/aot.py): per-replica stores share
+        # one directory, so entries come from any row while the traffic
+        # counters (loads/fallbacks) sum across replicas
+        aots = [s.get("aot") for s in per if s.get("aot")]
+        agg["aot"] = ({"dir": aots[0].get("dir"),
+                       "entries": aots[0].get("entries"),
+                       "loaded": sum(int(a.get("loaded") or 0)
+                                     for a in aots),
+                       "saved": sum(int(a.get("saved") or 0)
+                                    for a in aots),
+                       "fallbacks": sum(int(a.get("fallbacks") or 0)
+                                        for a in aots),
+                       "save_errors": sum(int(a.get("save_errors") or 0)
+                                          for a in aots)}
+                      if aots else None)
         agg["drift"] = (self.drift.status()
                         if self.drift is not None else None)
         agg["replicas"] = rows

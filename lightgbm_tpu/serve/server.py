@@ -195,6 +195,9 @@ class _Handler(BaseHTTPRequestHandler):
                 st["health_mode"] = obs.health_mode() or "off"
                 if reg is not None:
                     st["models"] = {m["name"]: m for m in reg.models()}
+                    arena = getattr(reg, "arena", None)
+                    if arena is not None:
+                        st["arena"] = arena.stats()
                 self._reply(200, st)
             elif path == "/metrics":
                 text = (render_prometheus_fleet(reg) if reg is not None
@@ -206,6 +209,10 @@ class _Handler(BaseHTTPRequestHandler):
                         "metrics": sess.metrics.snapshot()}
                 if reg is not None:
                     body["models"] = reg.stats()
+                    arena = getattr(reg, "arena", None)
+                    if arena is not None:
+                        body["arena"] = {"stats": arena.stats(),
+                                         "tenants": arena.tenants()}
                 self._reply(200, body)
             elif path == "/models":
                 if reg is None:
@@ -213,8 +220,15 @@ class _Handler(BaseHTTPRequestHandler):
                                       "detail": "server wraps a bare "
                                       "session, not a model registry"})
                 else:
-                    self._reply(200, {"default": reg.default,
-                                      "models": reg.models()})
+                    body = {"default": reg.default,
+                            "models": reg.models()}
+                    arena = getattr(reg, "arena", None)
+                    if arena is not None:
+                        # residency view: which tenants are device-
+                        # resident, eviction/occupancy counters
+                        body["arena"] = {"stats": arena.stats(),
+                                         "tenants": arena.tenants()}
+                    self._reply(200, body)
             elif path == "/drift":
                 # drift/quality plane (obs/drift.py): per-model monitor
                 # status — thresholds, live sketch rows, last scores,
@@ -271,18 +285,32 @@ class _Handler(BaseHTTPRequestHandler):
             # serves this whole request even if a swap lands mid-flight
             model = payload.get("model")
             version = None
+            arena_hit = False
             if reg is not None:
                 from .registry import UnknownModelError
+                arena = getattr(reg, "arena", None)
                 try:
                     ver = reg.resolve(model)
                 except UnknownModelError:
-                    self._reply(404, {"error": "unknown_model",
-                                      "model": model})
-                    return
-                sess, model, version = ver.router, ver.router.name, \
-                    ver.version
+                    # arena tenants serve names the version registry
+                    # does not know (registered names always win)
+                    if arena is not None and (
+                            model is None or arena.has(model)):
+                        sess, arena_hit = arena, True
+                    else:
+                        self._reply(404, {"error": "unknown_model",
+                                          "model": model})
+                        return
+                else:
+                    sess, model, version = ver.router, ver.router.name, \
+                        ver.version
             else:
                 sess = self.server.session
+            if explain and arena_hit:
+                self._reply(404, {"error": "explain_disabled",
+                                  "detail": "arena tenants serve "
+                                  "predictions only"})
+                return
             if explain and not getattr(sess, "explain_enabled", False):
                 self._reply(404, {"error": "explain_disabled",
                                   "detail": "explanation serving is off "
@@ -303,6 +331,12 @@ class _Handler(BaseHTTPRequestHandler):
                                              trace_id=self._trace_id,
                                              parent_id=root_id,
                                              priority=priority)
+            elif arena_hit:
+                ticket = sess.submit(
+                    X, model=model, deadline_ms=deadline_ms,
+                    raw_score=bool(payload.get("raw_score")),
+                    trace_id=self._trace_id, parent_id=root_id,
+                    priority=priority)
             else:
                 ticket = sess.submit(
                     X, deadline_ms=deadline_ms,
@@ -326,6 +360,9 @@ class _Handler(BaseHTTPRequestHandler):
                 body["version"] = int(getattr(ticket, "version", version))
                 if getattr(ticket, "replica", None) is not None:
                     body["replica"] = f"r{ticket.replica.idx}"
+            elif arena_hit:
+                body["model"] = ticket.model
+                body["arena"] = True
             if explain:
                 # [n, F+1] (or [n, K*(F+1)] multiclass); the last column
                 # per class block is the expected value, like
@@ -383,8 +420,23 @@ class _Handler(BaseHTTPRequestHandler):
                          or payload.get("model"))
                 if not model:
                     raise ValueError("swap body needs 'model_file'")
-                report = (reg.swap(name, model)
-                          if name in [m["name"] for m in reg.models()]
+                arena = getattr(reg, "arena", None)
+                registered = name in [m["name"] for m in reg.models()]
+                if (arena is not None and not registered
+                        and (arena.has(name) or payload.get("arena"))):
+                    # arena tenant hot-swap (or first admit with
+                    # {"arena": true}) — canary-gated inside the arena;
+                    # a parity failure rolls back and maps to 409 below
+                    try:
+                        report = arena.swap(name, model)
+                    except (RuntimeError, ValueError) as exc:
+                        self._reply(409, {"error": "swap_rejected",
+                                          "detail": str(exc),
+                                          "arena": True})
+                        return
+                    self._reply(200, report)
+                    return
+                report = (reg.swap(name, model) if registered
                           else reg.add_model(name, model))
                 self._reply(200, report)
             else:  # rollback

@@ -216,9 +216,34 @@ class PredictorSession:
         early_stop = (gbdt._early_stop_spec()
                       if hasattr(gbdt, "_early_stop_spec") else None)
         fn = forest_predict_fn(self.space.meta, K, early_stop)
+        self._raw_fn = fn  # unwrapped jit fn — the AOT lower/compile unit
         if obs.profile_enabled():
             fn = obs.profile_wrap("lgbm/forest_predict", fn)
         self._device_fn = fn
+
+        # ---- AOT executable store (serve/aot.py): zero-compile boot --
+        # entries present for this exact forest/bin-space/backend load as
+        # ready-to-call executables keyed per bucket; a warmed store
+        # makes request #1 pay zero JIT compiles.  Load failures fall
+        # back to the jit path above, loudly (aot_fallback event).
+        from .aot import AOTStore, resolve_aot_dir
+        self._aot_fns: dict = {}
+        self._aot_x_fns: dict = {}
+        self._aot = None
+        aot_dir = resolve_aot_dir(config)
+        if aot_dir:
+            self._aot = AOTStore(aot_dir)
+            # the executable bakes forest + meta in as constants: hash
+            # CONTENT, not shapes (see serve/aot.py key schema)
+            self._aot_digest = AOTStore._digest_tree(
+                (self.forest, self.space.meta))
+            self._aot_extra = (f"K={K}|F={F}|es={early_stop!r}"
+                               f"|dev={self._device}")
+            for b in self._bucket_sweep(self.max_batch):
+                st, afn = self._aot.load("predict", self._aot.key(
+                    "predict", b, self._aot_digest, self._aot_extra))
+                if st == "hit":
+                    self._aot_fns[b] = afn
 
         # ---- serving state -------------------------------------------
         self._degraded = False
@@ -293,20 +318,58 @@ class PredictorSession:
                       queue_depth=self.queue_depth)
 
     # ------------------------------------------------------------------
+    @staticmethod
+    def _bucket_sweep(cap: int):
+        """Every bucket size ``_bucket`` can produce, ascending: the
+        pow2 ladder clamped at the batch cap (a non-power-of-two cap IS
+        the top bucket)."""
+        b = 1
+        while True:
+            size = min(b, cap)
+            yield size
+            if size >= cap:
+                return
+            b *= 2
+
     def warmup(self) -> int:
         """Pre-compile every bucket shape up to the batch cap so the
         first real request never pays a compile.  The probe is clamped
         to the cap — a non-power-of-two ``max_batch`` IS the top bucket
         (``_bucket`` clamps the same way), so warmup compiles exactly
-        the shapes real traffic can produce.  Returns the bucket count."""
-        b, n = 1, 0
-        while True:
-            size = min(b, self.max_batch)
+        the shapes real traffic can produce.  With the AOT store armed,
+        buckets it did not already hold are lowered/compiled ONCE here,
+        registered for dispatch, and persisted — the next process boots
+        with zero compiles.  Returns the bucket count."""
+        n = 0
+        for size in self._bucket_sweep(self.max_batch):
+            if self._aot is not None and size not in self._aot_fns:
+                self._aot_export(size)
             self._run_device(np.zeros((size, self.num_features), np.int32))
             n += 1
-            if size >= self.max_batch:
-                return n
-            b *= 2
+        return n
+
+    def _aot_export(self, size: int) -> None:
+        """Lower + compile the predict fn for one bucket and persist it
+        (serve/aot.py).  The compiled executable also joins the dispatch
+        table so this process pays the compile exactly once.  Failure is
+        logged and costs the next boot a compile, never a request."""
+        import jax
+        import jax.numpy as jnp
+        try:
+            bins = jnp.asarray(
+                np.zeros((size, self.num_features), np.int32))
+            if self._device is not None:
+                with jax.default_device(self._device):
+                    comp = self._raw_fn.lower(self.forest, bins).compile()
+            else:
+                comp = self._raw_fn.lower(self.forest, bins).compile()
+            self._aot_fns[size] = comp
+            self._aot.save("predict", self._aot.key(
+                "predict", size, self._aot_digest, self._aot_extra),
+                comp, note={"bucket": size, "model": self.model_name})
+        except Exception as exc:  # noqa: BLE001 — store is best-effort
+            log.warning("AOT export failed for bucket %d (%s: %s)",
+                        size, type(exc).__name__, exc)
 
     def _bucket(self, n: int) -> int:
         b = 1
@@ -331,7 +394,12 @@ class PredictorSession:
             self._buckets.add(b)
         t_exec0 = time.time()
         faults.check("serve_device")
-        if self._device is not None:
+        aot_fn = self._aot_fns.get(b)
+        if aot_fn is not None:
+            # AOT-loaded executable: already compiled for this device,
+            # no jit dispatch, no compile — the zero-cold-start path
+            out = aot_fn(self.forest, jnp.asarray(bins))
+        elif self._device is not None:
             import jax
             with jax.default_device(self._device):
                 out = self._device_fn(self.forest, jnp.asarray(bins))
@@ -555,8 +623,19 @@ class PredictorSession:
                 # the predict forest; it IS the predict forest)
                 forest = self.forest
                 fn = forest_shap_fn(self.space.meta, K, F)
+                self._raw_explain_fn = fn
                 if obs.profile_enabled():
                     fn = obs.profile_wrap("lgbm/forest_shap", fn)
+                if self._aot is not None:
+                    from .aot import AOTStore
+                    self._aot_x_digest = AOTStore._digest_tree(
+                        (forest, arrays, self.space.meta))
+                    for b in self._bucket_sweep(self.explain_max_batch):
+                        st, afn = self._aot.load("explain", self._aot.key(
+                            "explain", b, self._aot_x_digest,
+                            self._aot_extra))
+                        if st == "hit":
+                            self._aot_x_fns[b] = afn
                 batcher = MicroBatcher(
                     self._execute_explain_batch,
                     max_batch=self.explain_max_batch,
@@ -569,18 +648,42 @@ class PredictorSession:
 
     def warmup_explain(self) -> int:
         """Pre-compile every explain bucket shape (the analog of
-        ``warmup`` for the TreeSHAP kernel's own bucket family).
-        Returns the bucket count."""
+        ``warmup`` for the TreeSHAP kernel's own bucket family), AOT-
+        persisting missing buckets when the store is armed.  Returns the
+        bucket count."""
         self._ensure_explain()
-        b, n = 1, 0
-        while True:
-            size = min(b, self.explain_max_batch)
+        n = 0
+        for size in self._bucket_sweep(self.explain_max_batch):
+            if self._aot is not None and size not in self._aot_x_fns:
+                self._aot_export_explain(size)
             self._run_device_explain(
                 np.zeros((size, self.num_features), np.int32))
             n += 1
-            if size >= self.explain_max_batch:
-                return n
-            b *= 2
+        return n
+
+    def _aot_export_explain(self, size: int) -> None:
+        """Explain twin of ``_aot_export``: one TreeSHAP bucket lowered,
+        compiled, registered, persisted."""
+        import jax
+        import jax.numpy as jnp
+        forest, arrays, _, _ = self._ensure_explain()
+        try:
+            bins = jnp.asarray(
+                np.zeros((size, self.num_features), np.int32))
+            if self._device is not None:
+                with jax.default_device(self._device):
+                    comp = self._raw_explain_fn.lower(
+                        forest, arrays, bins).compile()
+            else:
+                comp = self._raw_explain_fn.lower(
+                    forest, arrays, bins).compile()
+            self._aot_x_fns[size] = comp
+            self._aot.save("explain", self._aot.key(
+                "explain", size, self._aot_x_digest, self._aot_extra),
+                comp, note={"bucket": size, "model": self.model_name})
+        except Exception as exc:  # noqa: BLE001 — store is best-effort
+            log.warning("AOT explain export failed for bucket %d (%s: %s)",
+                        size, type(exc).__name__, exc)
 
     def _bucket_explain(self, n: int) -> int:
         b = 1
@@ -607,7 +710,10 @@ class PredictorSession:
         # the TreeSHAP kernel must be injectable without touching the
         # predict plane, or the degrade-isolation contract is untestable
         faults.check("serve_explain_device")
-        if self._device is not None:
+        aot_fn = self._aot_x_fns.get(b)
+        if aot_fn is not None:
+            out = aot_fn(forest, arrays, jnp.asarray(bins))
+        elif self._device is not None:
             import jax
             with jax.default_device(self._device):
                 out = fn(forest, arrays, jnp.asarray(bins))
@@ -1125,6 +1231,15 @@ class PredictorSession:
                 # drift plane (obs/drift.py): None when unarmed
                 "drift": (self._drift.status()
                           if self._drift is not None else None),
+                # AOT executable store (serve/aot.py): None when unarmed.
+                # aot_buckets says which bucket shapes dispatch without
+                # the jit path at all — a fully-AOT session shows
+                # compile_count 0 after a cold boot
+                "aot": (None if self._aot is None else {
+                    **self._aot.stats(),
+                    "buckets": sorted(self._aot_fns),
+                    "explain_buckets": sorted(self._aot_x_fns),
+                }),
             }
 
     def close(self) -> None:

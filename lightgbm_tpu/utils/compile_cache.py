@@ -85,3 +85,47 @@ def enable_compile_cache(path: Optional[str] = None) -> Optional[str]:
 def compile_cache_info() -> dict:
     """{"dir": path-or-None, "warm": bool-or-None} as of enable time."""
     return dict(_state)
+
+
+# ---------------------------------------------------------------------
+# executable-store plumbing (serve/aot.py)
+#
+# The XLA cache above still pays trace + lowering + a cache probe per
+# bucket shape on every boot.  The serving AOT store (serve/aot.py)
+# goes one step further — whole serialized EXECUTABLES, loaded without
+# touching the compiler at all — and shares this module's on-disk
+# hygiene: durable atomic writes and warm/cold introspection.
+# ---------------------------------------------------------------------
+
+def atomic_write_bytes(path: str, data: bytes) -> None:
+    """Write ``data`` to ``path`` via a same-directory temp file +
+    ``os.replace`` so a concurrent reader (another serving process
+    loading the store) sees either the old entry or the complete new
+    one, never a torn write.  Raises on failure — callers decide how
+    loud a store write failure is."""
+    d = os.path.dirname(path) or "."
+    os.makedirs(d, exist_ok=True)
+    tmp = os.path.join(d, f".{os.path.basename(path)}.tmp.{os.getpid()}")
+    try:
+        with open(tmp, "wb") as fh:
+            fh.write(data)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+    finally:
+        try:
+            if os.path.exists(tmp):
+                os.remove(tmp)
+        except OSError:
+            pass
+
+
+def store_entries(path: Optional[str], suffix: str = ".aot") -> list:
+    """Entry filenames under an executable-store directory (sorted;
+    empty for a missing/unreadable dir — a cold store, not an error)."""
+    if not path:
+        return []
+    try:
+        return sorted(f for f in os.listdir(path) if f.endswith(suffix))
+    except OSError:
+        return []

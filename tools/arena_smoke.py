@@ -1,0 +1,195 @@
+"""CPU-smokeable arena + AOT tier: the zero-cold-start contracts in CI.
+
+``tools/run_suite.py`` runs this as the ``arena`` tier every round, so
+regressions in the ISSUE 19 plane (serve/aot.py + serve/arena.py) are
+caught on CPU without a TPU window:
+
+- **AOT round-trip**: a warmed session exports every pow2 bucket
+  executable; a second session in the same process deserializes them
+  and serves the FULL sweep with a compile-count delta of exactly zero
+  (the second session's jit function is fresh, so any non-AOT dispatch
+  would compile) and bit-identical output.
+- **arena parity**: binary-with-NaN, multiclass, and categorical tenant
+  models packed into one ``ForestArena`` predict bit-identically to
+  dedicated per-model ``PredictorSession``s — converted AND raw score.
+- **cross-model coalescing**: interleaved small submits for different
+  tenants land in shared device batches (``cross_model_batches`` > 0).
+- **eviction / re-admission**: an impossible byte budget forces LRU
+  eviction; the evicted tenant's next request transparently re-admits
+  it with bit-identical output.
+
+    python tools/arena_smoke.py --json      # one JSON verdict line
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+CHECKS = {}
+
+
+def check(name, ok, detail=""):
+    CHECKS[name] = bool(ok)
+    print(f"# {'ok ' if ok else 'FAIL'} {name}"
+          + (f" — {detail}" if detail and not ok else ""), flush=True)
+
+
+def _train(params, X, y, rounds=6, cat=None):
+    import lightgbm_tpu as lgb
+    p = dict({"verbose": -1, "num_leaves": 7, "min_data_in_leaf": 5},
+             **params)
+    ds = lgb.Dataset(X, label=y, params=p,
+                     **({"categorical_feature": cat} if cat else {}))
+    return lgb.train(p, ds, num_boost_round=rounds)
+
+
+def build_fixtures():
+    """Three small tenants covering the binning surface: NaN-heavy
+    binary, multiclass, and categorical."""
+    rng = np.random.default_rng(7)
+    Xb = rng.normal(size=(400, 5))
+    Xb[rng.random(Xb.shape) < 0.08] = np.nan
+    yb = (np.nan_to_num(Xb[:, 0]) > 0).astype(np.float64)
+    b_bin = _train({"objective": "binary"}, Xb, yb)
+
+    Xm = rng.normal(size=(400, 4))
+    ym = (np.digitize(Xm[:, 0], [-0.5, 0.5])).astype(np.float64)
+    b_mc = _train({"objective": "multiclass", "num_class": 3}, Xm, ym)
+
+    Xc = np.hstack([rng.normal(size=(400, 3)),
+                    rng.integers(0, 12, size=(400, 1)).astype(np.float64)])
+    yc = ((Xc[:, 0] + 0.3 * (Xc[:, 3] % 4)) > 0).astype(np.float64)
+    b_cat = _train({"objective": "binary"}, Xc, yc, cat=[3])
+    return (b_bin, Xb), (b_mc, Xm), (b_cat, Xc)
+
+
+def aot_roundtrip(fixtures):
+    """Export -> deserialize -> serve with compile count pinned at 0."""
+    from lightgbm_tpu import obs
+    from lightgbm_tpu.serve import PredictorSession
+    (b_bin, Xb) = fixtures[0]
+    max_batch = 64
+    with tempfile.TemporaryDirectory(prefix="arena_smoke_aot_") as d:
+        cfg = {"verbose": -1, "tpu_serve_aot_dir": d}
+        warm = PredictorSession(b_bin, max_batch=max_batch,
+                                max_wait_ms=1.0, config=cfg)
+        warm.warmup()
+        want = {n: warm.predict(Xb[:n]) for n in (1, 2, 4, 8, 16, 32, 64)}
+        saved = (warm.stats().get("aot") or {}).get("saved", 0)
+        warm.close()
+        check("aot.exported", saved >= 1, f"saved={saved}")
+
+        obs.install_recompile_hook()
+        c0 = obs.compile_count()
+        cold = PredictorSession(b_bin, max_batch=max_batch,
+                                max_wait_ms=1.0, config=cfg)
+        got = {n: cold.predict(Xb[:n]) for n in (1, 2, 4, 8, 16, 32, 64)}
+        delta = obs.compile_count() - c0
+        st = cold.stats().get("aot") or {}
+        cold.close()
+        # a fresh session means a fresh jit callable — a single non-AOT
+        # dispatch anywhere in the sweep would show up as a compile
+        check("aot.roundtrip_zero_compiles",
+              delta == 0 and len(st.get("buckets") or []) >= 7,
+              f"{delta} compiles, buckets={st.get('buckets')}")
+        check("aot.roundtrip_bit_identical",
+              all(np.array_equal(want[n], got[n]) for n in want))
+
+
+def arena_parity(fixtures):
+    from lightgbm_tpu.serve import ForestArena, PredictorSession
+    arena = ForestArena(max_batch=64, max_wait_ms=1.0)
+    names = ("bin", "mc", "cat")
+    try:
+        for name, (bst, _) in zip(names, fixtures):
+            arena.admit(name, bst)
+        for name, (bst, X) in zip(names, fixtures):
+            probe = X[:48]
+            with PredictorSession(bst, max_batch=64,
+                                  max_wait_ms=1.0) as solo:
+                check(f"arena.parity_{name}",
+                      np.array_equal(arena.predict(probe, model=name),
+                                     solo.predict(probe))
+                      and np.array_equal(
+                          arena.predict(probe, model=name,
+                                        raw_score=True),
+                          solo.predict(probe, raw_score=True)))
+        # cross-model coalescing: interleaved async submits for all
+        # three tenants inside one batching window share dispatches
+        tickets = []
+        for r in range(8):
+            for name, (_, X) in zip(names, fixtures):
+                tickets.append(
+                    (name, arena.submit(X[r * 2:r * 2 + 2], model=name)))
+        for _, t in tickets:
+            arena.result(t, timeout=60.0)
+        st = arena.stats()
+        check("arena.cross_model_coalesced",
+              st["cross_model_batches"] >= 1
+              and st["batches"] < len(tickets), st)
+    finally:
+        arena.close()
+
+
+def eviction_readmission(fixtures):
+    from lightgbm_tpu.serve import ForestArena, PredictorSession
+    (b_bin, Xb), (b_mc, _), _ = fixtures
+    arena = ForestArena(budget_bytes=1, max_batch=64, max_wait_ms=1.0)
+    try:
+        arena.admit("a", b_bin)
+        arena.admit("b", b_mc)      # 1-byte budget: LRU 'a' must go
+        st = arena.stats()
+        check("arena.budget_evicts",
+              st["evictions"] >= 1 and st["resident"] == 1, st)
+        out = arena.predict(Xb[:32], model="a")   # re-admits 'a'
+        st2 = arena.stats()
+        with PredictorSession(b_bin, max_batch=64,
+                              max_wait_ms=1.0) as solo:
+            check("arena.readmit_bit_identical",
+                  st2["readmissions"] >= 1
+                  and np.array_equal(out, solo.predict(Xb[:32])), st2)
+    finally:
+        arena.close()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Arena + AOT smoke (serve/aot.py, serve/arena.py)")
+    ap.add_argument("--json", action="store_true",
+                    help="print a machine-readable verdict line")
+    args = ap.parse_args(argv)
+
+    t0 = time.time()
+    fixtures = build_fixtures()
+    aot_roundtrip(fixtures)
+    arena_parity(fixtures)
+    eviction_readmission(fixtures)
+
+    record = {
+        "kind": "arena_smoke",
+        "t": round(time.time(), 1),
+        "wall_s": round(time.time() - t0, 1),
+        "checks": CHECKS,
+        "ok": all(CHECKS.values()),
+    }
+    if args.json:
+        print(json.dumps(record))
+    else:
+        print(f"# {sum(CHECKS.values())}/{len(CHECKS)} checks passed "
+              f"({record['wall_s']}s)")
+    return 0 if record["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -104,6 +104,16 @@ _DIRECTIONS = [
     ("serve_swap_blip_p99_ms", False),
     ("serve_steady_p99_ms", False),
     ("serve_rollbacks", False),
+    # zero-cold-start + arena legs (ISSUE 19, bench_serve.py): fresh
+    # subprocess exec -> request-#1 response with the AOT store armed,
+    # the request-#1 latency itself, the cold compile count (0 IS the
+    # contract — any growth means the store stopped covering a bucket),
+    # and the arena-vs-per-model-sessions throughput ratio under the
+    # Zipf tenant mix
+    ("serve_coldstart_ms", False),
+    ("serve_request1_ms", False),
+    ("serve_cold_compiles", False),
+    ("serve_arena_speedup", True),
     # online-loop rounds (ONLINE_r*.json, tools/online_smoke.py): how
     # long a refresh takes end to end (refit + save + canary-gated
     # swap) and how many refreshed versions made it through the gate
@@ -308,6 +318,37 @@ def load_round(path: str) -> dict:
                                    row.get("note") else "") + \
                         f"client p99 {skew:g}x server p99 — " \
                         "network/queue pathology"
+        # zero-cold-start leg (ISSUE 19, bench_serve.py coldstart_leg):
+        # the AOT-on boot + request-#1 numbers, and the cold compile
+        # count — nonzero on a warmed store is called out the way a
+        # rollback is, even before the regression pass runs
+        cs = parsed.get("coldstart") or {}
+        for name, v in (("serve_coldstart_ms",
+                         cs.get("serve_coldstart_ms")),
+                        ("serve_request1_ms", cs.get("request1_ms")),
+                        ("serve_cold_compiles",
+                         cs.get("cold_compiles"))):
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                row["metrics"][name] = float(v)
+        if isinstance(cs.get("cold_compiles"), int) \
+                and cs["cold_compiles"] > 0:
+            row["note"] = ((row.get("note", "") + "; ")
+                           if row.get("note") else "") + \
+                f"{cs['cold_compiles']} JIT compile(s) on a warmed-" \
+                "store cold boot"
+        # arena leg (ISSUE 19, bench_serve.py arena_leg): cross-model
+        # coalescing throughput vs dedicated per-model sessions
+        ar = parsed.get("arena") or {}
+        if isinstance(ar.get("speedup"), (int, float)) \
+                and not isinstance(ar.get("speedup"), bool):
+            row["metrics"]["serve_arena_speedup"] = float(ar["speedup"])
+        # serving mode stamp: did the cold boot actually ride persisted
+        # executables?  find_mode_regressions flags an on -> off flip
+        # exactly like fused_sibling — a disarmed store posts the same
+        # green checks while silently re-paying JIT on every boot
+        if cs:
+            row["mode"] = {"serve_aot": bool(
+                (cs.get("aot_on") or {}).get("aot_buckets"))}
         if parsed.get("degraded"):
             row["canary"] = "serve-degraded"
             row["note"] = "degraded to host predictor — excluded from " \
@@ -506,11 +547,13 @@ def find_mode_regressions(rows: List[dict]) -> List[dict]:
         return []
     out = []
     lm, pm = latest["mode"], prior["mode"]
-    for knob in ("fused_sibling", "fused_grad"):
+    for knob in ("fused_sibling", "fused_grad", "serve_aot"):
         # a fused pass silently flipping off is a pipeline downgrade
         # even when throughput noise hides it (fused_grad joins
         # fused_sibling in ISSUE 11 — the unfused twin re-pays the [N]
-        # g/h round-trip every iteration)
+        # g/h round-trip every iteration; serve_aot joins in ISSUE 19 —
+        # a disarmed executable store re-pays the full pow2 compile
+        # family on every replica boot)
         if pm.get(knob) is True and lm.get(knob) is False:
             out.append({"metric": knob, "round": latest["round"],
                         "value": "off", "prior": "on",

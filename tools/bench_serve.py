@@ -20,7 +20,20 @@ answers "how does it serve" — the serve/ subsystem's round artifact:
    completing inside the swap window) vs ``steady_p99_ms`` and the
    rollback count; ``bench_history.py`` trends both and flags a blip
    worse than 2x steady.
-4. **HTTP smoke** (``--smoke``): starts ``PredictServer`` in-process,
+4. **cold-start leg** (default on; ``SERVE_COLDSTART=0`` disables): a
+   FRESH SUBPROCESS boots against the serialized-executable store
+   (serve/aot.py) and answers request #1 — time-to-first-response and
+   request-#1 latency, A/B'd AOT-on vs AOT-off.  Records
+   ``serve_coldstart_ms`` (the on number) which ``bench_history.py``
+   trends, plus the cold compile count: zero with the store armed, the
+   full pow2 family without it.
+5. **arena leg** (default on; ``SERVE_ARENA=0`` disables): SERVE_TENANTS
+   tenant models under a heavy-tail (Zipf) request mix at batch-starved
+   sizes (1-4 rows), served closed-loop twice — once by dedicated
+   per-model ``PredictorSession``s, once by one ``ForestArena`` with
+   cross-model microbatching — and records the throughput ratio as
+   ``speedup`` (bench_history trends it) plus per-tenant parity.
+6. **HTTP smoke** (``--smoke``): starts ``PredictServer`` in-process,
    fires concurrent mixed-size POST /predict + GET /health, then
    asserts p99 recorded, the compile count bounded by the pow2 bucket
    set (<= ceil(log2(max_batch)) + 1), zero request loss across the
@@ -38,8 +51,9 @@ SERVE_TREES boosting rounds (20), SERVE_FEATURES (8), SERVE_MAX_BATCH
 (256), SERVE_CLIENTS closed-loop threads (4), SERVE_DURATION_S per-loop
 seconds (2), SERVE_RATE open-loop req/s (50), SERVE_EXPLAIN_FRAC
 fraction of open-loop arrivals that are /explain requests (0.2 smoke,
-0.1 full), SERVE_MODEL serve an existing model file instead of training
-one.
+0.1 full), SERVE_TENANTS arena-leg tenant models (4 smoke, 8 full),
+SERVE_ARENA_REQS arena-leg request count (240 smoke, 1600 full),
+SERVE_MODEL serve an existing model file instead of training one.
 """
 from __future__ import annotations
 
@@ -60,9 +74,10 @@ sys.path.insert(0, REPO)
 
 _DEFAULTS = dict(rows=20000, trees=60, features=12, max_batch=1024,
                  clients=8, duration_s=5.0, rate=200.0,
-                 explain_frac=0.1)
+                 explain_frac=0.1, tenants=8, arena_reqs=1600)
 _SMOKE = dict(rows=2000, trees=20, features=8, max_batch=256,
-              clients=4, duration_s=2.0, rate=50.0, explain_frac=0.2)
+              clients=4, duration_s=2.0, rate=50.0, explain_frac=0.2,
+              tenants=4, arena_reqs=240)
 
 
 def _env(name, cast, fallback):
@@ -87,6 +102,8 @@ def knobs(smoke: bool) -> dict:
         rate=_env("SERVE_RATE", float, base["rate"]),
         explain_frac=_env("SERVE_EXPLAIN_FRAC", float,
                           base["explain_frac"]),
+        tenants=_env("SERVE_TENANTS", int, base["tenants"]),
+        arena_reqs=_env("SERVE_ARENA_REQS", int, base["arena_reqs"]),
         model=os.environ.get("SERVE_MODEL", ""),
     )
 
@@ -446,6 +463,210 @@ def swap_leg(k: dict, workdir: str, model_a: str) -> dict:
     }
 
 
+# the cold-boot measurement runs in a FRESH interpreter: imports, model
+# load, session construction (which loads the persisted executables when
+# $LGBM_TPU_SERVE_AOT_DIR points at a warmed store), request #1, then a
+# full pow2 sweep — printing one JSON line the parent A/B-compares
+_COLD_CHILD = r"""
+import json, sys, time
+t0 = time.perf_counter()
+import numpy as np
+sys.path.insert(0, sys.argv[1])
+from lightgbm_tpu import obs
+from lightgbm_tpu.serve import PredictorSession
+model_path, xpath, max_batch = sys.argv[2], sys.argv[3], int(sys.argv[4])
+obs.install_recompile_hook()
+c0 = obs.compile_count()
+sess = PredictorSession(model_path, max_batch=max_batch, max_wait_ms=1.0)
+X = np.load(xpath)
+t1 = time.perf_counter()
+out1 = sess.predict(X[:16])
+t2 = time.perf_counter()
+n = 1
+while n <= max_batch:
+    sess.predict(X[:n])
+    n *= 2
+aot = sess.stats().get("aot") or {}
+print(json.dumps({
+    "boot_to_first_ms": round((t2 - t0) * 1e3, 1),
+    "request1_ms": round((t2 - t1) * 1e3, 2),
+    "compiles": int(obs.compile_count() - c0),
+    "aot_buckets": len(aot.get("buckets") or []),
+    "probe": np.asarray(out1, dtype=np.float64).tolist(),
+}))
+sess.close()
+"""
+
+
+def coldstart_leg(k: dict, workdir: str, model_path: str, Xpool) -> dict:
+    """Fresh-subprocess cold start, AOT-on vs AOT-off (ISSUE 19): the
+    parent warms the executable store once, then boots two children —
+    one pointed at the store, one without it.  ``serve_coldstart_ms``
+    is the AOT-on time from exec to request-#1 response; the off run is
+    the JIT baseline the store exists to delete.  A zero cold compile
+    count across the full pow2 sweep is the tentpole's contract."""
+    import subprocess
+
+    import numpy as np
+    from lightgbm_tpu.serve import PredictorSession
+    aot_dir = os.path.join(workdir, "aot_store")
+    warm = PredictorSession(model_path, max_batch=k["max_batch"],
+                            max_wait_ms=1.0,
+                            config={"tpu_serve_aot_dir": aot_dir,
+                                    "verbose": -1})
+    warm.warmup()
+    warm_stats = (warm.stats().get("aot") or {})
+    warm.close()
+    xpath = os.path.join(workdir, "coldstart_X.npy")
+    np.save(xpath, np.ascontiguousarray(Xpool[:max(k["max_batch"], 16)]))
+
+    def boot(aot_on: bool) -> dict:
+        env = dict(os.environ)
+        env.pop("LGBM_TPU_SERVE_AOT_DIR", None)
+        if aot_on:
+            env["LGBM_TPU_SERVE_AOT_DIR"] = aot_dir
+        t0 = time.perf_counter()
+        proc = subprocess.run(
+            [sys.executable, "-c", _COLD_CHILD, REPO, model_path, xpath,
+             str(k["max_batch"])],
+            capture_output=True, text=True, env=env, timeout=600)
+        wall_ms = round((time.perf_counter() - t0) * 1e3, 1)
+        lines = [ln for ln in proc.stdout.splitlines()
+                 if ln.startswith("{")]
+        if proc.returncode != 0 or not lines:
+            return {"error": (proc.stderr or proc.stdout)[-500:],
+                    "wall_ms": wall_ms}
+        rec = json.loads(lines[-1])
+        rec["wall_ms"] = wall_ms
+        return rec
+
+    on, off = boot(True), boot(False)
+    probe_on, probe_off = on.pop("probe", None), off.pop("probe", None)
+    return {
+        "store_entries": warm_stats.get("entries"),
+        "aot_on": on, "aot_off": off,
+        # the headline numbers bench_history.py trends
+        "serve_coldstart_ms": on.get("boot_to_first_ms"),
+        "serve_coldstart_off_ms": off.get("boot_to_first_ms"),
+        "request1_ms": on.get("request1_ms"),
+        "request1_off_ms": off.get("request1_ms"),
+        "cold_compiles": on.get("compiles"),
+        "cold_compiles_off": off.get("compiles"),
+        # the AOT path must change WHEN, never WHAT: request #1 through
+        # a deserialized executable is bit-identical to the JIT path
+        "bit_identical": (probe_on == probe_off
+                          if probe_on is not None and probe_off is not None
+                          else None),
+    }
+
+
+def arena_leg(k: dict, workdir: str, Xpool) -> dict:
+    """Heavy-tail multi-tenant serving, arena vs per-model sessions
+    (ISSUE 19): SERVE_TENANTS models, request mix Zipf over tenants at
+    batch-starved sizes (1-4 rows), identical closed-loop work-list
+    through both data planes.  Per-model sessions each coalesce only
+    their own trickle; the arena coalesces the CROSS-model stream into
+    shared device dispatches — ``speedup`` is the throughput ratio
+    bench_history.py trends (>= 1.5x is the ISSUE 19 target)."""
+    import numpy as np
+    from lightgbm_tpu.serve import ForestArena, PredictorSession
+    T = max(k["tenants"], 2)
+    paths = [build_model(k, workdir, name=f"arena_tenant_{i}.txt",
+                         num_leaves=11 + 2 * (i % 5),
+                         trees=max(k["trees"] // 3, 5), seed=100 + i)
+             for i in range(T)]
+    # Zipf-ish tenant popularity: p(i) ~ 1/(i+1)^1.2 — one hot tenant,
+    # a long cold tail, the mix that starves per-model batches
+    w = (np.arange(T) + 1.0) ** -1.2
+    p = w / w.sum()
+    rng = np.random.default_rng(29)
+    reqs = []
+    for _ in range(max(k["arena_reqs"], 8)):
+        n = int(rng.integers(1, 5))
+        lo = int(rng.integers(0, max(Xpool.shape[0] - n, 1)))
+        reqs.append((int(rng.choice(T, p=p)), n, lo))
+
+    def run(call):
+        idx = [0]
+        lat, rows, failures = [], [0], [0]
+        lock = threading.Lock()
+
+        def client():
+            while True:
+                with lock:
+                    if idx[0] >= len(reqs):
+                        return
+                    ti, n, lo = reqs[idx[0]]
+                    idx[0] += 1
+                t0 = time.perf_counter()
+                try:
+                    call(ti, Xpool[lo:lo + n])
+                except Exception:  # noqa: BLE001 — counted below
+                    with lock:
+                        failures[0] += 1
+                    continue
+                with lock:
+                    lat.append((time.perf_counter() - t0) * 1e3)
+                    rows[0] += n
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=client)
+                   for _ in range(k["clients"])]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        p50, p99 = _percentiles(lat)
+        return {"wall_s": round(wall, 2),
+                "req_per_s": round(len(lat) / wall, 1),
+                "rows_per_s": round(rows[0] / wall, 1),
+                "p50_ms": p50, "p99_ms": p99, "failures": failures[0]}
+
+    # side A: one dedicated session per tenant (the per-model baseline)
+    solo = {i: PredictorSession(paths[i], max_batch=k["max_batch"],
+                                max_wait_ms=2.0) for i in range(T)}
+    for s in solo.values():
+        s.warmup()
+
+    def solo_call(ti, X):
+        sess = solo[ti]
+        sess.result(sess.submit(X), timeout=60.0)
+
+    solo_res = run(solo_call)
+
+    # side B: one arena, every tenant resident, one shared microbatcher
+    arena = ForestArena(max_batch=k["max_batch"], max_wait_ms=2.0)
+    for i in range(T):
+        arena.admit(f"t{i}", paths[i])
+    arena.warmup()
+
+    def arena_call(ti, X):
+        arena.result(arena.submit(X, model=f"t{ti}"), timeout=60.0)
+
+    arena_res = run(arena_call)
+
+    # per-tenant parity: one data plane, two routes, identical answers
+    probe = Xpool[:32]
+    parity = all(
+        np.array_equal(arena.predict(probe, model=f"t{i}"),
+                       solo[i].predict(probe)) for i in range(T))
+    st = arena.stats()
+    for s in solo.values():
+        s.close()
+    arena.close()
+    base = max(solo_res["rows_per_s"], 1e-9)
+    return {
+        "tenants": T, "requests": len(reqs), "zipf_exp": 1.2,
+        "solo": solo_res, "arena": arena_res,
+        "speedup": round(arena_res["rows_per_s"] / base, 3),
+        "parity": bool(parity),
+        "batches": st["batches"],
+        "cross_model_batches": st["cross_model_batches"],
+        "occupancy": st["occupancy"],
+    }
+
+
 def scrape_metrics(server) -> dict:
     """One end-of-run /metrics scrape, parsed (the server-side view
     embedded in SERVE_rN.json next to the client-observed numbers)."""
@@ -584,6 +805,15 @@ def main(argv=None) -> int:
             # accounting above (the fleet's packs/warmups must not
             # count against the session's pow2 bucket budget)
             record["swap"] = swap_leg(k, workdir, model_path)
+        if _env("SERVE_COLDSTART", int, 1):
+            # fresh-subprocess cold boot, AOT store on vs off — also
+            # after the compile accounting (the warm-up export pays
+            # compiles in THIS process on the store's behalf)
+            record["coldstart"] = coldstart_leg(k, workdir, model_path,
+                                                Xpool)
+        if _env("SERVE_ARENA", int, 1):
+            # multi-tenant Zipf mix: per-model sessions vs one arena
+            record["arena"] = arena_leg(k, workdir, Xpool)
 
     if args.smoke:
         checks = {
@@ -638,6 +868,33 @@ def main(argv=None) -> int:
                 "swap_steady_p99_recorded":
                     sw.get("steady_p99_ms") is not None,
             })
+        if record.get("coldstart"):
+            cs = record["coldstart"]
+            checks.update({
+                # the tentpole contract: a cold process with a warmed
+                # store serves the whole pow2 sweep with ZERO compiles…
+                "coldstart_zero_compiles": cs.get("cold_compiles") == 0,
+                # …the JIT baseline actually pays them (the A/B is live)…
+                "coldstart_off_pays_jit":
+                    (cs.get("cold_compiles_off") or 0) >= 1,
+                # …and the deserialized executables answer bit-identically
+                "coldstart_bit_identical": cs.get("bit_identical") is True,
+                "coldstart_measured":
+                    cs.get("serve_coldstart_ms") is not None,
+            })
+        if record.get("arena"):
+            ar = record["arena"]
+            checks.update({
+                "arena_parity": ar.get("parity") is True,
+                "arena_no_failures": ar["solo"]["failures"] == 0
+                and ar["arena"]["failures"] == 0,
+                # the whole point: requests for different tenants shared
+                # device dispatches (speedup itself is trended, not
+                # gated — CPU smoke boxes are too noisy to pin 1.5x)
+                "arena_cross_model_coalesced":
+                    ar.get("cross_model_batches", 0) >= 1,
+                "arena_speedup_recorded": ar.get("speedup") is not None,
+            })
         record["checks"] = checks
         record["ok"] = all(checks.values())
         print(json.dumps(record))
@@ -666,6 +923,14 @@ def main(argv=None) -> int:
                               "swap_blip_p99_ms"),
                       "rollbacks":
                           (record.get("swap") or {}).get("rollbacks"),
+                      "serve_coldstart_ms":
+                          (record.get("coldstart") or {}).get(
+                              "serve_coldstart_ms"),
+                      "cold_compiles":
+                          (record.get("coldstart") or {}).get(
+                              "cold_compiles"),
+                      "arena_speedup":
+                          (record.get("arena") or {}).get("speedup"),
                       "compiles": record["compiles"]}))
     return 0
 

@@ -330,6 +330,76 @@ def scenario_rollback_trigger(models, X, P, art_dir):
 
 
 # ---------------------------------------------------------------------------
+def scenario_replica_restart(models, X, P):
+    """Replica killed and cold-booted MID-STORM with the AOT store
+    armed: zero request loss (the survivor absorbs, the old batcher
+    drains on close), and the rebooted replica boots straight into the
+    persisted executables — its first request pays no JIT compile."""
+    from lightgbm_tpu import obs
+    from lightgbm_tpu.serve import ReplicaRouter
+    (m1, b1) = models[0]
+    with tempfile.TemporaryDirectory(prefix="chaos_aot_") as aot_dir:
+        cfg = _cfg(P, tpu_serve_aot_dir=aot_dir)
+        router = ReplicaRouter(m1, n_replicas=2, config=cfg)
+        ref = b1.predict(X[:8])
+        try:
+            # warm every pow2 bucket; with the store armed this also
+            # persists the executables the reboot will load
+            router.warmup()
+            aot_st = (router.stats() or {}).get("aot") or {}
+            check("restart.store_armed", aot_st.get("entries", 0) >= 1,
+                  aot_st)
+            stop = threading.Event()
+            served, failures, lock = [], [], threading.Lock()
+
+            def client(seed):
+                rng = np.random.default_rng(seed)
+                while not stop.is_set():
+                    n = int(rng.integers(1, 9))
+                    try:
+                        t = router.submit(X[:n])
+                        out = router.result(t, timeout=60)
+                        with lock:
+                            served.append((n, out))
+                    except Exception as exc:  # noqa: BLE001 — loss counter
+                        with lock:
+                            failures.append(repr(exc))
+                    time.sleep(0.005)
+
+            threads = [threading.Thread(target=client, args=(s,))
+                       for s in range(3)]
+            for t in threads:
+                t.start()
+            time.sleep(0.5)
+            boot = router.restart_replica(0)
+            time.sleep(0.5)
+            stop.set()
+            for t in threads:
+                t.join(30)
+            check("restart.zero_loss", not failures and len(served) >= 8,
+                  f"{len(failures)} failures / {len(served)} served: "
+                  f"{failures[:3]}")
+            check("restart.correct_answers",
+                  all(np.allclose(o, ref[:n], atol=1e-6)
+                      for n, o in served))
+            check("restart.boot_from_store",
+                  boot["boot_compiles"] == 0 and boot["aot"], boot)
+            # the rebooted replica's FIRST request: with the storm
+            # stopped, a predict on its session must ride the loaded
+            # executables — the process-global compile counter stays put
+            c0 = obs.compile_count()
+            first = router.replicas[0].session.predict(X[:5])
+            check("restart.first_request_no_compile",
+                  obs.compile_count() - c0 == 0
+                  and np.allclose(first, ref[:5], atol=1e-6),
+                  f"{obs.compile_count() - c0} compiles on request #1")
+            return {"restart_boot_ms": boot["boot_ms"],
+                    "restart_requests": len(served)}
+        finally:
+            router.close()
+
+
+# ---------------------------------------------------------------------------
 def scenario_shed_priority(models, X, P):
     """Saturated queue sheds low first; high still admitted; counters in
     /metrics; 503 carries Retry-After."""
@@ -466,6 +536,7 @@ def main(argv=None) -> int:
         extra.update(scenario_swap_mid_flight(models, X, P) or {})
         scenario_canary_fail(models, X, P)
         scenario_rollback_trigger(models, X, P, art)
+        extra.update(scenario_replica_restart(models, X, P) or {})
         scenario_shed_priority(models, X, P)
         scenario_drift(models, X, P, art)
 

@@ -20,7 +20,12 @@ mode in one process and emits a per-check verdict map, exactly like
   one;
 - a streamed-ingestion chunk fault (ISSUE 14, ``ingest_chunk``)
   retries to a bit-identical dataset, a fatal/corrupt chunk aborts
-  loudly before anything bins, and a stalled chunk read is stamped.
+  loudly before anything bins, and a stalled chunk read is stamped;
+- a truncated/corrupt persisted AOT executable (ISSUE 19) falls back
+  to JIT LOUDLY (``aot_fallback`` event + fallback counter) with
+  bit-identical predictions, and arena byte-budget pressure evicts a
+  tenant that is transparently re-admitted — bit-identical — on its
+  next request.
 
     python tools/fault_matrix.py --json      # one JSON verdict line
 """
@@ -385,6 +390,71 @@ def main(argv=None) -> int:
           srep is not None and srep.get("skipped") == "ingest_stall"
           and sloop.versions == 0, srep)
     check("online.ingest_stall_stamped", len(stall_events) >= 1)
+
+    # ---- AOT store (ISSUE 19): corrupt entry -> loud JIT fallback --
+    # a present-but-garbage persisted executable must never crash or
+    # poison output: the loader rejects it, stamps ``aot_fallback``,
+    # bumps the fallback counter, and the JIT path serves bit-identical
+    aotdir = os.path.join(art, "aot")
+    warm = PredictorSession(bst, config=dict(
+        P, tpu_serve_aot_dir=aotdir, tpu_serve_max_batch=64))
+    warm.warmup()
+    warm.close()
+    aot_files = glob.glob(os.path.join(aotdir, "*.aot"))
+    check("aot.store_written", len(aot_files) >= 1,
+          f"{len(aot_files)} entries in {aotdir}")
+    for p in aot_files:  # truncate every entry: present but garbage
+        with open(p, "r+b") as fh:
+            fh.truncate(max(1, os.path.getsize(p) // 3))
+    obs.enable_flight(64)  # fresh ring so the fallbacks stand out
+    s_cold = PredictorSession(bst, config=dict(
+        P, tpu_serve_aot_dir=aotdir, tpu_serve_max_batch=64))
+    try:
+        out_cold = s_cold.predict(X[:16])
+        aot_st = (s_cold.stats() or {}).get("aot") or {}
+        fb_events = [e for e in obs.flight_snapshot()
+                     if e.get("event") == "aot_fallback"]
+        check("aot.corrupt_falls_back_loudly",
+              aot_st.get("fallbacks", 0) >= 1 and len(fb_events) >= 1,
+              aot_st)
+        with PredictorSession(bst, config=dict(
+                P, tpu_serve_max_batch=64)) as s_ref:
+            check("aot.corrupt_bit_identical",
+                  np.array_equal(out_cold, s_ref.predict(X[:16])))
+    except Exception as exc:  # noqa: BLE001
+        check("aot.corrupt_falls_back_loudly", False, repr(exc))
+        CHECKS.setdefault("aot.corrupt_bit_identical", False)
+    finally:
+        s_cold.close()
+
+    # ---- arena (ISSUE 19): byte pressure -> evict, then re-admit ---
+    # an impossible budget forces LRU eviction on every admit; the
+    # evicted tenant's next request transparently re-admits it and the
+    # answer stays bit-identical to a dedicated per-model session
+    from lightgbm_tpu.serve import ForestArena
+    bst_b = lgb.train(dict(P), lgb.Dataset(X, label=y, params=dict(P)),
+                      num_boost_round=4, verbose_eval=False)
+    arena = ForestArena(budget_bytes=1, max_batch=64, max_wait_ms=1.0)
+    try:
+        arena.admit("ta", bst)
+        arena.admit("tb", bst_b)  # budget evicts the LRU tenant 'ta'
+        st_a = arena.stats()
+        check("arena.pressure_evicts",
+              st_a["evictions"] >= 1 and st_a["resident"] == 1, st_a)
+        out_a = arena.predict(X[:16], model="ta")  # re-admits 'ta'
+        st_b = arena.stats()
+        with PredictorSession(bst, config=dict(
+                P, tpu_serve_max_batch=64)) as s_ta:
+            check("arena.readmit_transparent_bit_identical",
+                  st_b["readmissions"] >= 1
+                  and np.array_equal(out_a, s_ta.predict(X[:16])),
+                  st_b)
+    except Exception as exc:  # noqa: BLE001
+        CHECKS.setdefault("arena.pressure_evicts", False)
+        check("arena.readmit_transparent_bit_identical", False,
+              repr(exc))
+    finally:
+        arena.close()
 
     record = {
         "kind": "fault_matrix",

@@ -141,6 +141,18 @@ ENV_VARS = [
      "measures against (over-target fraction of recent requests "
      "divided by the 1% budget a p99 objective allows; 1.0 = burning "
      "budget exactly at the allowed rate)."),
+    ("LGBM_TPU_SERVE_AOT_DIR",
+     "AOT executable store directory (overrides the "
+     "`tpu_serve_aot_dir` parameter; `serve/aot.py`).  When set, every "
+     "pow2-bucket executable a `PredictorSession` (or the arena) "
+     "compiles is serialized there, keyed by kind | backend platform | "
+     "jax version | row bucket | forest-content digest — a later "
+     "process with the same model boots from the store and serves "
+     "request #1 with zero JIT compiles (`serve_coldstart_ms` in "
+     "`SERVE_rN.json` measures the A/B).  Stale, corrupt, or "
+     "cross-backend entries fall back to JIT loudly (`aot_fallback` "
+     "flight event + `serve/aot_fallbacks` counter) with bit-identical "
+     "output.  `tpu_serve_aot=false` disarms the store entirely."),
     ("LGBM_TPU_COMPILE_CACHE",
      "directory for JAX's persistent XLA compilation cache (equivalent "
      "to the `tpu_compile_cache_dir` parameter; see "

@@ -110,6 +110,16 @@ JSON contract with a finite, converging ETA, ``/debug/flight`` serves
 the live ring, and the train-thread seconds spent inside the board
 hook stay under the 5% off-path overhead guard.
 
+The ``arena`` tier (ISSUE 19) runs ``tools/arena_smoke.py --json``:
+the zero-cold-start + multi-tenant plane — a warmed session exports
+every pow2 bucket executable to the AOT store, a fresh session
+deserializes and serves the full sweep with the compile counter pinned
+at 0 and bit-identical output; binary-NaN / multiclass / categorical
+tenants packed into one ``ForestArena`` predict bit-identically to
+dedicated sessions; interleaved mixed-tenant submits coalesce into
+shared device batches; and an impossible byte budget forces an LRU
+eviction whose victim is transparently re-admitted on its next request.
+
 The ``xprof`` tier (ISSUE 18) runs ``tools/xprof_smoke.py --json``:
 the measured-roofline smoke — a tiny CPU train with the windowed
 profiler capture armed (``LGBM_TPU_XPROF``) plus a cold persistent
@@ -239,6 +249,13 @@ _TOOL_TIERS = {
     # validating, compile walls + cache hit/miss on the board, and the
     # disarmed step() hook inside the same 5% off-path overhead guard
     "xprof": ["xprof_smoke.py", "--json"],
+    # zero-cold-start plane (ISSUE 19): AOT export -> deserialize ->
+    # serve round-trip with the compile counter pinned at 0 and
+    # bit-identical output, multi-tenant arena parity across the
+    # binning surface (NaN / multiclass / categorical), cross-model
+    # coalescing, and byte-budget eviction with transparent
+    # re-admission — re-proved on CPU each suite round
+    "arena": ["arena_smoke.py", "--json"],
 }
 
 
@@ -293,15 +310,17 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="Run the quick/slow test tiers and write SUITE_rN.json")
     ap.add_argument("--tiers", default="quick,slow,serve,faults,chaos,"
-                                       "online,ingest,drift,board,xprof",
+                                       "online,ingest,drift,board,xprof,"
+                                       "arena",
                     help="comma list of tiers: pytest markers plus the "
                          "built-in 'serve' smoke, 'faults' matrix, "
                          "'chaos' serving-chaos, 'online' closed-loop, "
                          "'ingest' streaming-ingestion, 'drift' "
-                         "monitoring, 'board' train-introspection and "
-                         "'xprof' measured-roofline legs (default quick,"
+                         "monitoring, 'board' train-introspection, "
+                         "'xprof' measured-roofline and 'arena' "
+                         "zero-cold-start legs (default quick,"
                          "slow,serve,faults,chaos,online,ingest,drift,"
-                         "board,xprof)")
+                         "board,xprof,arena)")
     ap.add_argument("--select", default="",
                     help="pytest collection target (file or node id) "
                          "instead of the whole tests/ dir")

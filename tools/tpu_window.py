@@ -274,8 +274,13 @@ def checklist_legs(art_dir: str, dry_run: bool, py: str = sys.executable):
          "env": env_for("bench_serve",
                         # trace + flight capture: one good window leaves
                         # a Perfetto-exportable span stream AND a flight
-                        # record beside the bench numbers (ISSUE 6)
+                        # record beside the bench numbers (ISSUE 6).
+                        # SERVE_COLDSTART pinned on (ISSUE 19): the
+                        # window stamps serve_coldstart_ms — a real
+                        # on-TPU exec-to-request-#1 number with the AOT
+                        # store armed — beside the swap blip
                         {"LGBM_TPU_TRACE": "1",
+                         "SERVE_COLDSTART": "1",
                          "SERVE_FLIGHT_OUT": os.path.join(
                              art_dir, "FLIGHT_serve.json")},
                         dry_env=_DRY_SERVE_ENV),
@@ -286,9 +291,12 @@ def checklist_legs(art_dir: str, dry_run: bool, py: str = sys.executable):
         # streams separable
         {"name": "bench_explain",
          "argv": [py, serve, "--json", "--explain-frac", "0.5"],
-         # the hot-swap exercise belongs to the bench_serve leg; this
-         # one stays a pure explain-mix measurement
-         "env": env_for("bench_explain", {"SERVE_SWAP": "0"},
+         # the hot-swap / cold-start / arena exercises belong to the
+         # bench_serve leg; this one stays a pure explain-mix
+         # measurement
+         "env": env_for("bench_explain", {"SERVE_SWAP": "0",
+                                          "SERVE_COLDSTART": "0",
+                                          "SERVE_ARENA": "0"},
                         dry_env=_DRY_SERVE_ENV),
          "parse_json": True},
         # streaming-ingestion leg (ISSUE 14): the synthetic-stream
@@ -686,6 +694,14 @@ def run_checklist(out_dir: str, n: int, dry_run: bool,
         serve_parsed["swap_blip_p99_ms"] = sw.get("swap_blip_p99_ms")
         serve_parsed["swap_steady_p99_ms"] = sw.get("steady_p99_ms")
         serve_parsed["rollbacks"] = sw.get("rollbacks")
+        # the zero-cold-start + arena legs (ISSUE 19): stamp the boot
+        # and throughput-ratio numbers at top level too, so one window
+        # leaves trendable cold-start datapoints on the live backend
+        cs = serve_parsed.get("coldstart") or {}
+        serve_parsed["serve_coldstart_ms"] = cs.get("serve_coldstart_ms")
+        serve_parsed["cold_compiles"] = cs.get("cold_compiles")
+        serve_parsed["arena_speedup"] = (
+            serve_parsed.get("arena") or {}).get("speedup")
         serve_path = os.path.join(out_dir, f"SERVE_manual_r{n:02d}.json")
         with open(serve_path, "w") as fh:
             json.dump(serve_parsed, fh, indent=1)
