@@ -333,6 +333,16 @@ def main(argv=None) -> None:
         obs.enable(cfg.tpu_telemetry)
     task = cfg.task
     if task == "train":
+        # tpu_fleet=N: this invocation becomes the GANG LAUNCHER — it
+        # spawns N `python -m lightgbm_tpu.fleet` worker ranks, watches
+        # them, heals lost ones, and exits with the fleet's verdict
+        # (fleet/launch.py; a spawned rank re-enters main() with
+        # LGBM_TPU_FLEET_RANK set and falls through to run_train only
+        # on the jax transport)
+        from .fleet.launch import launch_fleet, should_gang_launch
+        if should_gang_launch(cfg):
+            res = launch_fleet(cfg, params)
+            raise SystemExit(0 if res["ok"] else (res["rc"] or 1))
         run_train(cfg, params)
     elif task in ("predict", "prediction", "test"):
         run_predict(cfg, params)

@@ -283,6 +283,36 @@ class Config:
     machine_list_filename: str = ""
     machines: str = ""
 
+    # ---- Elastic multi-host fleet (fleet/ subsystem) ----
+    tpu_fleet: int = 0                  # task=train gang size: launch
+                                        # this many training ranks with
+                                        # file/TCP rendezvous + elastic
+                                        # lost-host recovery; 0/1 = off
+    tpu_fleet_heartbeat_s: float = 30.0  # silence window (relative to
+                                        # the other ranks' heartbeat
+                                        # arrivals) before a rank is
+                                        # classified dead; heartbeats
+                                        # ride the fingerprint cadence —
+                                        # no new sync points
+    tpu_fleet_transport: str = "auto"   # auto = jax.distributed when the
+                                        # backend runs cross-process
+                                        # device collectives, else the
+                                        # host-TCP CI-twin transport;
+                                        # jax / host force one
+    tpu_fleet_dir: str = ""             # rendezvous + fleet artifact
+                                        # directory (rank logs, event
+                                        # trail, default checkpoints);
+                                        # empty = a fresh temp dir
+    tpu_fleet_port: int = 0             # coordinator TCP port
+                                        # (0 = ephemeral)
+    tpu_fleet_min_ranks: int = 1        # abort instead of resuming when
+                                        # survivors drop below this
+    tpu_fleet_heal: bool = True         # relaunch a lost rank and fold
+                                        # it back in at the next resize
+    tpu_fleet_max_recoveries: int = 2   # elastic recoveries tolerated
+                                        # per rank (and heals per
+                                        # launcher) before aborting
+
     # ---- Device (reference gpu_* kept for compat; tpu_* are new) ----
     gpu_platform_id: int = -1
     gpu_device_id: int = -1
@@ -1046,6 +1076,16 @@ class Config:
                 and self.tpu_ingest_shard_id >= self.tpu_ingest_shards):
             log.fatal("tpu_ingest_shard_id should be < tpu_ingest_shards "
                       "(or -1 for the process rank)")
+        if self.tpu_fleet < 0:
+            log.fatal("tpu_fleet should be >= 0")
+        if self.tpu_fleet_heartbeat_s <= 0:
+            log.fatal("tpu_fleet_heartbeat_s should be > 0 (seconds)")
+        if self.tpu_fleet_transport not in ("auto", "jax", "host"):
+            log.fatal("tpu_fleet_transport should be auto, jax or host")
+        if self.tpu_fleet_min_ranks < 1:
+            log.fatal("tpu_fleet_min_ranks should be >= 1")
+        if self.tpu_fleet_max_recoveries < 0:
+            log.fatal("tpu_fleet_max_recoveries should be >= 0")
         if not 0.0 <= self.tpu_drift_sample_rate <= 1.0:
             log.fatal("tpu_drift_sample_rate should be in [0, 1]")
         if self.tpu_drift_check_s <= 0:

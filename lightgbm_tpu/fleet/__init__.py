@@ -1,0 +1,25 @@
+"""Elastic multi-host training fleet (ISSUE 20).
+
+``task=train tpu_fleet=N`` gang-launches N worker ranks (launch.py),
+which rendezvous over a shared directory, exchange binned row shards
+over the host-TCP transport (transport.py) — or jax.distributed where
+the backend has real cross-process device collectives — heartbeat on
+the fingerprint cadence (health.py), and survive rank loss by rolling
+back to the newest common checkpoint and resuming at the shrunk (or
+healed) world size (elastic.py).
+"""
+from .health import FleetHeartbeatCallback, FleetSession, make_heartbeat
+from .launch import (FleetSettings, device_collective_support, launch_fleet,
+                     resolve_fleet, should_gang_launch)
+from .transport import (FleetClient, FleetCoordinatorLost, FleetError,
+                        FleetHub, FleetPeerLost, FleetResize,
+                        HostCollectives)
+from .elastic import run_host_rank, run_rank
+
+__all__ = [
+    "FleetClient", "FleetCoordinatorLost", "FleetError", "FleetHub",
+    "FleetHeartbeatCallback", "FleetPeerLost", "FleetResize",
+    "FleetSession", "FleetSettings", "HostCollectives",
+    "device_collective_support", "launch_fleet", "make_heartbeat",
+    "resolve_fleet", "run_host_rank", "run_rank", "should_gang_launch",
+]

@@ -1,0 +1,7 @@
+"""``python -m lightgbm_tpu.fleet <key=value ...>`` — one fleet rank."""
+import sys
+
+from .elastic import run_rank
+
+if __name__ == "__main__":
+    sys.exit(run_rank() or 0)

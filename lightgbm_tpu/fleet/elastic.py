@@ -1,0 +1,454 @@
+"""Per-rank elastic training loop: ingest-shard, assemble, train, and
+survive the fleet changing size underneath you.
+
+The CI-twin transport runs REPLICATE mode: every rank streams the same
+input file but bins only its row shard (ingest/stream.py two-pass
+loader with a query-aligned RowShardPlan), then a ONE-TIME ``assemble``
+gather exchanges the binned shards so every rank leaves holding the
+identical full dataset — after which each rank trains a full replica
+deterministically (serial tree learner).  That makes the trained model
+provably world-independent: a fleet of 3, a fleet shrunk to 2 mid-run,
+and a single-process oracle all grow bit-identical trees, which is what
+lets recovery promise bit-exactness instead of "approximately resumes".
+
+Failure handling, all anchored on the robust/ checkpoint stack:
+
+- a peer dies (``FleetPeerLost`` out of any gather) → survivors agree on
+  the newest COMMON checkpoint iteration, trim their local stacks to it
+  (``CheckpointManager.trim_to``), meet in the resize barrier at the
+  shrunk world, re-ingest their new shards and resume — the engine's
+  auto-resume lands every rank on the same iteration;
+- a healed rank wants in (``FleetResize`` out of the heartbeat) → same
+  rollback, and the joiner pulls the rolled-back common checkpoint from
+  rank 0 (``fetch``) before training alongside;
+- the coordinator dies (``FleetCoordinatorLost``) → recovery is
+  impossible; flight-dump and exit 143 loudly, never hang.
+
+On accelerator backends with real cross-process device collectives the
+``jax`` transport short-circuits all of this: jax.distributed comes up
+over the same rendezvous file and the standard sharded data-parallel
+path (parallel/distributed.py) runs unchanged.
+"""
+from __future__ import annotations
+
+import copy
+import os
+import sys
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..utils import log
+from .health import FleetSession, make_heartbeat, newest_ckpt_iter
+from .launch import (EVENTS, FleetSettings, device_collective_support,
+                     resolve_fleet, run_done, wait_rendezvous, write_done,
+                     write_rendezvous)
+from .transport import (FleetClient, FleetCoordinatorLost, FleetError,
+                        FleetHub, FleetPeerLost, FleetResize,
+                        HostCollectives)
+
+
+def run_rank(argv: Optional[List[str]] = None) -> int:
+    """``python -m lightgbm_tpu.fleet <key=value ...>`` — one rank."""
+    from ..app import _parse_args
+    from ..config import Config
+
+    argv = sys.argv[1:] if argv is None else argv
+    params = _parse_args(argv)
+    cfg = Config.from_params(params)
+    if cfg.tpu_telemetry:
+        from .. import obs
+        obs.enable(cfg.tpu_telemetry)
+    fs = resolve_fleet(cfg)
+    mid = int(os.environ.get("LGBM_TPU_FLEET_RANK", "0") or 0)
+    join = bool(os.environ.get("LGBM_TPU_FLEET_JOIN", "").strip())
+    transport = fs.transport
+    if transport == "auto":
+        transport = "jax" if device_collective_support() else "host"
+    log.info("fleet: rank %d starting (world %d, transport %s%s)",
+             mid, fs.world, transport, ", joiner" if join else "")
+    if transport == "jax":
+        return _run_jax_rank(cfg, params, fs, mid)
+    return run_host_rank(cfg, params, fs, mid, join=join)
+
+
+def _run_jax_rank(cfg, params: Dict[str, str], fs: FleetSettings,
+                  mid: int) -> int:
+    """Device-collective transport: bring up jax.distributed over the
+    same rendezvous file, then run the existing sharded data-parallel
+    path (bin-sample pooling over device collectives) unchanged."""
+    import socket
+
+    from ..app import run_train
+    from ..parallel.distributed import init_distributed
+
+    fleet_dir = fs.fleet_dir or os.getcwd()
+    os.makedirs(fleet_dir, exist_ok=True)
+    if mid == 0:
+        port = fs.port
+        if not port:
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+            s.close()
+        write_rendezvous(fleet_dir, ("127.0.0.1", port), fs.world)
+    else:
+        _, port = wait_rendezvous(
+            fleet_dir, timeout=max(2.0 * fs.heartbeat_s, 60.0))
+    machines = ",".join(f"127.0.0.1:{port + i}" for i in range(fs.world))
+    init_distributed(machines=machines, num_machines=fs.world, rank=mid)
+    cfg.tpu_ingest = True
+    cfg.tpu_ingest_shards = int(fs.world)
+    cfg.tpu_ingest_shard_id = int(mid)
+    params = dict(params)
+    params.update({"tpu_ingest": "true",
+                   "tpu_ingest_shards": str(fs.world),
+                   "tpu_ingest_shard_id": str(mid)})
+    run_train(cfg, params)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# host-transport rank
+# ---------------------------------------------------------------------------
+
+def run_host_rank(cfg, params: Dict[str, str], fs: FleetSettings,
+                  mid: int, join: bool = False) -> int:
+    """One rank of the host-TCP fleet: rendezvous, epoch loop, elastic
+    recovery.  Returns the process exit code."""
+    from .. import obs
+    from ..parallel.distributed import set_host_collectives
+
+    fleet_dir = fs.fleet_dir
+    if not fleet_dir:
+        log.fatal("fleet: the host transport needs tpu_fleet_dir "
+                  "(the gang launcher always sets it)")
+    os.makedirs(fleet_dir, exist_ok=True)
+    base_ckpt = (getattr(cfg, "tpu_checkpoint_dir", "")
+                 or os.path.join(fleet_dir, "ckpt"))
+
+    hub = None
+    if mid == 0 and not join:
+        # the hub lives INSIDE this worker: "coordinator killed" and
+        # "rank 0 killed" are the same failure, and this rank's
+        # checkpoint dir is directly servable to joiners
+        rank_ckpt = os.path.join(base_ckpt, "rank0")
+        os.makedirs(rank_ckpt, exist_ok=True)
+        hub = FleetHub(fs.world, heartbeat_s=fs.heartbeat_s, port=fs.port,
+                       ckpt_dir=rank_ckpt,
+                       events_path=os.path.join(fleet_dir, EVENTS))
+        addr = hub.start()
+        write_rendezvous(fleet_dir, addr, fs.world)
+    else:
+        if join and run_done(fleet_dir):
+            log.info("fleet: run already completed before this healed "
+                     "rank came up — nothing to rejoin")
+            return 0
+        addr = wait_rendezvous(
+            fleet_dir, timeout=max(2.0 * fs.heartbeat_s, 60.0))
+
+    deadline = time.time() + max(2.0 * fs.heartbeat_s, 60.0)
+    client = None
+    while client is None:
+        try:
+            # joiners connect in short bursts so the done marker is
+            # polled between attempts — a run that completed while this
+            # interpreter was starting must not be retried into a grace
+            # kill
+            client = FleetClient(addr, mid, heartbeat_s=fs.heartbeat_s,
+                                 join=join,
+                                 connect_timeout=2.0 if join else 60.0)
+        except FleetCoordinatorLost as exc:
+            if join and run_done(fleet_dir):
+                log.info("fleet: run completed while this healed rank "
+                         "was starting — exiting clean")
+                return 0
+            if time.time() >= deadline:
+                log.warning("%s", exc)
+                return 143
+    mid = client.mid                 # the hub assigns joiners a fresh id
+    rank_ckpt = os.path.join(base_ckpt, f"rank{mid}")
+    os.makedirs(rank_ckpt, exist_ok=True)
+    collectives = HostCollectives(client)
+    set_host_collectives(collectives)
+    session = FleetSession(client, collectives, fs, rank_ckpt, hub=hub)
+
+    rc = 0
+    try:
+        try:
+            if client.pending:
+                # joiner: meet the survivors in the resize barrier, pull
+                # the rolled-back common checkpoint, then train like
+                # everyone else
+                rep = client.resize()
+                if rep.get("done"):
+                    log.info("fleet: run completed while this healed "
+                             "rank was parked to join — exiting clean")
+                    client.bye()
+                    return 0
+                it = client.fetch_checkpoint(rank_ckpt)
+                log.info("fleet: joined as shard %d/%d at epoch %d "
+                         "(checkpoint iteration %d)", client.shard,
+                         client.world, client.epoch, it)
+            while True:
+                try:
+                    session.epoch_runs += 1
+                    _train_replica(cfg, params, session)
+                    break
+                except FleetResize as exc:
+                    log.warning("fleet: %s — meeting the resize barrier",
+                                exc)
+                    _recover(session)
+                except FleetPeerLost as exc:
+                    session.recoveries += 1
+                    survivors = client.world - len(exc.lost)
+                    log.warning("fleet: %s — recovery %d (max %d), %d "
+                                "survivor(s)", exc, session.recoveries,
+                                fs.max_recoveries, survivors)
+                    if obs.flight_enabled():
+                        obs.flight_dump("fleet_peer_lost")
+                    if survivors < fs.min_ranks:
+                        log.warning("fleet: %d survivor(s) below "
+                                    "tpu_fleet_min_ranks=%d — aborting",
+                                    survivors, fs.min_ranks)
+                        rc = 1
+                        break
+                    if session.recoveries > fs.max_recoveries:
+                        log.warning("fleet: recovery budget exhausted "
+                                    "(tpu_fleet_max_recoveries=%d) — "
+                                    "aborting", fs.max_recoveries)
+                        rc = 1
+                        break
+                    _recover(session)
+        except FleetCoordinatorLost as exc:
+            # no coordinator means no recovery: dump everything a
+            # post-mortem needs and exit LOUDLY — never hang
+            log.warning("fleet: %s — exiting 143", exc)
+            if obs.flight_enabled():
+                obs.flight_dump("fleet_coordinator_lost")
+            raise SystemExit(143)
+        client.bye()
+        if hub is not None:
+            # stamp completion BEFORE draining: a healed joiner still
+            # inside interpreter start must find the marker, not a
+            # silent socket
+            write_done(fleet_dir, rc)
+            hub.wait_drain(timeout=max(2.0 * fs.heartbeat_s, 30.0))
+            hub.stop()
+    finally:
+        set_host_collectives(None)
+    return rc
+
+
+# ---------------------------------------------------------------------------
+# one training epoch (between resizes)
+# ---------------------------------------------------------------------------
+
+def _replica_params(params: Dict[str, str], session: FleetSession) -> Dict:
+    """The booster param surface for the full-replica train: identical
+    on every rank AND identical to a fleet-less oracle invocation.
+    Fleet/launcher/shard keys are STRIPPED (not zeroed) so the model
+    file's parameters section cannot betray the world size."""
+    tp = dict(params)
+    for k in list(tp):
+        if (k.startswith("tpu_fleet")
+                or k in ("task", "tpu_ingest_shards", "tpu_ingest_shard_id",
+                         "num_machines", "num_machine", "machines",
+                         "machine_list_filename", "local_listen_port")):
+            tp.pop(k)
+    # every rank trains the SAME full replica — the data-parallel
+    # learner must not engage ("serial" is the default, so this never
+    # shows up in the saved parameters section)
+    tp["tree_learner"] = "serial"
+    tp["tpu_checkpoint_dir"] = session.ckpt_dir
+    return tp
+
+
+def _cat(parts: List[Optional[np.ndarray]]) -> Optional[np.ndarray]:
+    if all(p is None for p in parts):
+        return None
+    if any(p is None for p in parts):
+        raise FleetError("fleet: ranks disagree on metadata sidecars "
+                         "(some shards carry weights/queries, some not)")
+    return (np.asarray(parts[0]) if len(parts) == 1
+            else np.concatenate([np.asarray(p) for p in parts]))
+
+
+def _assemble(client: FleetClient, handle, label, weight, group):
+    """The one-time binned-shard exchange: every rank contributes its
+    ``[lo, hi)`` rows, every rank leaves holding the identical FULL
+    dataset (mappers are already identical — same file, same sample).
+    Returns ``(full_handle, label, weight, group)`` global arrays."""
+    from ..io.dataset import BinnedDataset, Metadata
+
+    lo, hi = getattr(handle, "ingest_row_range", (0, handle.num_data))
+    payload = {
+        "lo": int(lo), "hi": int(hi),
+        "rows": int(getattr(handle, "ingest_num_rows", handle.num_data)),
+        "xbin": np.ascontiguousarray(handle.X_bin),
+        "label": None if label is None else np.asarray(label),
+        "weight": None if weight is None else np.asarray(weight),
+        "qsizes": None if group is None else np.asarray(group),
+    }
+    if client.world <= 1:
+        parts = [payload]
+    else:
+        parts, _ = client.gather("assemble", payload)
+    n_global = int(parts[0]["rows"])
+    covered = sum(int(p["hi"]) - int(p["lo"]) for p in parts)
+    if (covered != n_global or int(parts[0]["lo"]) != 0
+            or any(int(a["hi"]) != int(b["lo"])
+                   for a, b in zip(parts, parts[1:]))):
+        raise FleetError(
+            f"fleet: assembled shards cover {covered} of {n_global} rows "
+            f"(ranges {[(int(p['lo']), int(p['hi'])) for p in parts]})")
+
+    full = BinnedDataset()
+    full.num_data = n_global
+    full.num_total_features = handle.num_total_features
+    full.X_bin = (parts[0]["xbin"] if len(parts) == 1 else
+                  np.concatenate([p["xbin"] for p in parts], axis=0))
+    full.bin_mappers = handle.bin_mappers
+    full.used_feature_map = handle.used_feature_map
+    full.real_feature_idx = handle.real_feature_idx
+    full.bin_offsets = handle.bin_offsets
+    full.feature_names = handle.feature_names
+    full.max_bin = handle.max_bin
+    full.bundle = handle.bundle
+    full.metadata = Metadata(n_global)
+    label_f = _cat([p["label"] for p in parts])
+    weight_f = _cat([p["weight"] for p in parts])
+    group_f = _cat([p["qsizes"] for p in parts])
+    if label_f is not None:
+        full.metadata.set_label(label_f)
+    if weight_f is not None:
+        full.metadata.set_weights(weight_f)
+    if group_f is not None:
+        full.metadata.set_query(group_f)
+    full.ingest_row_range = (0, n_global)
+    full.ingest_num_rows = n_global
+    return full, label_f, weight_f, group_f
+
+
+def _train_replica(cfg, params: Dict[str, str],
+                   session: FleetSession) -> None:
+    """One epoch: sharded ingest → assemble → full-replica train (the
+    engine auto-resumes from this rank's newest checkpoint)."""
+    from .. import callback
+    from ..app import (_dataset_from_file, _load_init_scores,
+                       _resolve_cli_categoricals)
+    from ..basic import Dataset
+    from ..engine import train as train_api
+    from ..ingest.stream import ingest_file
+
+    client = session.client
+    world, shard = client.world, client.shard
+    log.info("fleet: epoch %d — ingesting shard %d/%d of %s",
+             client.epoch, shard, world, cfg.data)
+
+    # two-pass sharded ingest: this rank streams the whole file but bins
+    # only its [lo, hi) rows (query-aligned RowShardPlan).  The whole-
+    # stream reservoir sample is already global and identical on every
+    # rank (same file, same seed), so the pre-sharded-source pooling
+    # must stand down for the duration
+    icfg = copy.copy(cfg)
+    icfg.tpu_ingest = True
+    icfg.tpu_ingest_shards = int(world)
+    icfg.tpu_ingest_shard_id = int(shard)
+    with session.collectives.pause():
+        handle, label, weight, group, names = ingest_file(
+            cfg.data, icfg,
+            categorical_features=_resolve_cli_categoricals(cfg))
+
+    full, label_f, weight_f, group_f = _assemble(
+        client, handle, label, weight, group)
+
+    tp = _replica_params(params, session)
+    ds = Dataset(None, params=tp, feature_name=names)
+    ds._handle = full
+    if label_f is not None:
+        ds.label = label_f
+    if weight_f is not None:
+        ds.weight = weight_f
+    if group_f is not None:
+        ds.group = group_f
+    init_score = _load_init_scores(cfg.data,
+                                   getattr(cfg, "initscore_filename", ""))
+    if init_score is not None:
+        ds.set_init_score(init_score)
+
+    # valid sets load FULL on every rank (eval parity must hold however
+    # the world shrinks) — shard knobs off, bin space from the train ref
+    vcfg = copy.copy(cfg)
+    vcfg.tpu_ingest_shards = 0
+    vcfg.tpu_ingest_shard_id = 0
+    valid_sets, valid_names = [], []
+    with session.collectives.pause():
+        for i, vpath in enumerate(cfg.valid):
+            vinit = (cfg.valid_data_initscores[i]
+                     if i < len(getattr(cfg, "valid_data_initscores", []))
+                     else "")
+            valid_sets.append(_dataset_from_file(
+                vpath, vcfg, tp, reference=ds, initscore_path=vinit))
+            valid_names.append(f"valid_{i + 1}" if len(cfg.valid) > 1
+                               else "valid")
+
+    cbs: list = []
+    if cfg.metric_freq > 0 and (valid_sets
+                                or cfg.is_provide_training_metric):
+        cbs.append(callback.print_evaluation(period=cfg.metric_freq))
+    cbs.append(make_heartbeat(session, cfg))
+    if cfg.is_provide_training_metric:
+        valid_sets = [ds] + valid_sets
+        valid_names = ["training"] + valid_names
+
+    bst = train_api(tp, ds,
+                    num_boost_round=int(cfg.num_iterations),
+                    valid_sets=valid_sets or None,
+                    valid_names=valid_names or None,
+                    init_model=cfg.input_model or None,
+                    early_stopping_rounds=(cfg.early_stopping_round
+                                           if cfg.early_stopping_round > 0
+                                           else None),
+                    verbose_eval=False,
+                    callbacks=cbs)
+    # every rank writes its own copy (the bit-exactness witnesses the
+    # smoke/fault suites byte-compare); shard 0 owns the canonical path
+    bst.save_model(f"{cfg.output_model}.rank{client.mid}")
+    if client.shard == 0:
+        bst.save_model(cfg.output_model)
+    log.info("fleet: rank %d (shard %d) finished training; model saved "
+             "to %s", client.mid, client.shard, cfg.output_model)
+
+
+# ---------------------------------------------------------------------------
+# coordinated recovery
+# ---------------------------------------------------------------------------
+
+def _recover(session: FleetSession) -> int:
+    """Coordinated rollback + re-rendezvous: survivors agree on the
+    newest COMMON checkpoint iteration, trim their local stacks to it,
+    and meet (with any pending joiners) in the resize barrier.  Returns
+    the common iteration every rank will auto-resume from."""
+    from .. import obs
+    from ..robust.checkpoint import CheckpointManager
+
+    client = session.client
+    mine = newest_ckpt_iter(session.ckpt_dir)
+    parts, _ = client.gather("recover_ckpt", {"ckpt_iter": mine},
+                             phase="recover")
+    common = min(int(p["ckpt_iter"]) for p in parts)
+    CheckpointManager(session.ckpt_dir).trim_to(common)
+    if session.hub is not None:
+        # what a joiner's ``fetch`` serves — stamped BEFORE the barrier
+        # admits it
+        session.hub.serve_iteration = common
+    client.resize()
+    log.warning("fleet: recovered — rolled back to iteration %d, "
+                "resuming as shard %d/%d (epoch %d)", common,
+                client.shard, client.world, client.epoch)
+    obs.event("fleet_recover", iteration=int(common),
+              world=int(client.world), epoch=int(client.epoch),
+              member=int(client.mid))
+    return common

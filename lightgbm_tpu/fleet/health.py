@@ -1,0 +1,132 @@
+"""Fleet liveness, piggybacked on the fingerprint cadence.
+
+The healthy path gets ZERO new sync points: the heartbeat is ONE
+combined gather per ``tpu_fingerprint_freq`` tick — the cadence
+``obs/health.py`` fingerprints and ``obs/ranks.py`` straggler stats
+already synchronize on — carrying this rank's iteration + newest
+checkpoint, and bringing back the coordinator's fleet view (per-rank
+progress, pending joiners, stall stamps).  Detection is the transport's
+gather deadline itself: a rank that misses the collective its peers are
+standing in is dead (relative staleness — a fleet-wide compile stall
+delays everyone equally and kills no one); a rank that arrives late but
+inside the deadline is stamped ``fleet_stall``.
+
+The view feeds the train board (obs/board.py ``fleet`` provider:
+world/rank/epoch gauges + per-rank last-seen ages on every rank, the
+coordinator's full member table on rank 0).
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional
+
+from ..utils import log
+from .transport import FleetClient, FleetResize
+
+_HB_KEY = "hb"
+
+
+def newest_ckpt_iter(ckpt_dir: str) -> int:
+    """Newest checkpoint iteration under ``ckpt_dir`` (0 = none) —
+    what the heartbeat advertises and recovery takes the min over."""
+    if not ckpt_dir or not os.path.isdir(ckpt_dir):
+        return 0
+    from ..robust.checkpoint import CheckpointManager, _CKPT_RE
+    newest = CheckpointManager(ckpt_dir).list_checkpoints()
+    if not newest:
+        return 0
+    m = _CKPT_RE.search(os.path.basename(newest[0]))
+    return int(m.group(1)) if m else 0
+
+
+class FleetSession:
+    """Per-rank fleet state shared by the heartbeat callback and the
+    elastic loop: the transport client, the host-collective adapter,
+    this rank's checkpoint directory, and the last coordinator view."""
+
+    def __init__(self, client: FleetClient, collectives, settings,
+                 ckpt_dir: str, hub=None):
+        self.client = client
+        self.collectives = collectives
+        self.settings = settings
+        self.ckpt_dir = ckpt_dir
+        self.hub = hub                   # rank 0 only
+        self.view: dict = {}
+        self.recoveries = 0
+        self.epoch_runs = 0
+
+    def snapshot(self) -> dict:
+        """Board provider payload (obs/board.py ``fleet`` section)."""
+        v = dict(self.view)
+        return {
+            "world": self.client.world,
+            "rank": self.client.shard,
+            "member": self.client.mid,
+            "epoch": self.client.epoch,
+            "recoveries": self.recoveries,
+            "dead": v.get("dead", []),
+            "pending_join": v.get("pending_join", 0),
+            "members": v.get("members", {}),
+        }
+
+
+class FleetHeartbeatCallback:
+    """After-iteration callback: fault hooks + the fp-cadence gather."""
+
+    order = 35                   # after eval recording, before snapshots
+    before_iteration = False
+
+    def __init__(self, session: FleetSession, fp_freq: int):
+        self.session = session
+        # freq 0 would silence liveness entirely — clamp to every
+        # iteration rather than ship a fleet with no failure detection
+        self.fp_freq = max(int(fp_freq), 1)
+        self._provider_armed = False
+
+    def _arm_board(self) -> None:
+        if self._provider_armed:
+            return
+        from ..obs import board
+        b = board.current()
+        if b is not None:
+            b.set_provider("fleet", self.session.snapshot)
+            if self.session.hub is not None:
+                b.set_provider("fleet_hub", self.session.hub.snapshot)
+            self._provider_armed = True
+
+    def __call__(self, env) -> None:
+        from ..robust import faults
+
+        it = int(env.iteration) + 1
+        # chaos hooks (tools/fault_matrix.py): ``fleet_die`` hard-kills
+        # this rank mid-iteration the way a preempted host dies — no
+        # cleanup, no goodbye; ``fleet_hb`` (sleep action) delays this
+        # rank's heartbeat into the stall window
+        try:
+            faults.check("fleet_die", iteration=int(env.iteration))
+        except faults.FaultInjected:
+            log.warning("fleet: injected death at iteration %d "
+                        "(exiting 137)", it)
+            os._exit(137)
+        faults.check("fleet_hb", iteration=int(env.iteration))
+
+        if it % self.fp_freq != 0:
+            return
+        self._arm_board()
+        s = self.session
+        payload = {"iteration": it,
+                   "ckpt_iter": newest_ckpt_iter(s.ckpt_dir),
+                   "t": round(time.time(), 3)}
+        _, view = s.client.gather(_HB_KEY, payload)
+        s.view = view
+        pending = int(view.get("pending_join", 0) or 0)
+        if pending:
+            # every live rank sees the same view at the same heartbeat
+            # seq, so every rank raises here and meets in the barrier
+            raise FleetResize(pending)
+
+
+def make_heartbeat(session: FleetSession, config) -> FleetHeartbeatCallback:
+    return FleetHeartbeatCallback(
+        session, int(getattr(config, "tpu_fingerprint_freq", 1) or 1))

@@ -1,0 +1,295 @@
+"""Gang launcher + rendezvous for the elastic training fleet.
+
+``task=train tpu_fleet=N`` in the CLI driver (app.py) routes here: the
+launcher spawns N per-rank worker processes (``python -m
+lightgbm_tpu.fleet <same key=value args>``), watches them, and — with
+``tpu_fleet_heal`` — relaunches a lost rank as a JOINER the survivors
+fold back in at their next resize.  Rendezvous is file-then-TCP: rank 0
+starts the coordinator hub (fleet/transport.FleetHub) on an ephemeral
+port and atomically writes ``<fleet_dir>/rendezvous.json`` with the
+address; every other rank polls the file and connects.  The same flow
+the reference drives from its machine list (Network::Init,
+network.cpp:24-74) — except the list is discovered, not configured, so
+a healed joiner needs no config edits.
+
+Env overrides (``LGBM_TPU_FLEET_*``) win over the config knobs so a CI
+wrapper can fleet-ify an existing invocation without touching its
+params; ``LGBM_TPU_FLEET_RANK`` is the internal per-worker rank stamp
+and doubles as the gang-launch recursion guard.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+from ..utils import log
+
+RENDEZVOUS = "rendezvous.json"
+EVENTS = "fleet_events.jsonl"
+DONE = "done.json"
+
+
+def write_done(fleet_dir: str, rc: int = 0) -> None:
+    """Completion marker: a healed joiner that arrives AFTER the fleet
+    finished (spawn + interpreter start can outlast a short run's tail)
+    must find this and exit clean instead of retrying a dead hub."""
+    path = os.path.join(fleet_dir, DONE)
+    tmp = path + f".tmp-{os.getpid()}"
+    with open(tmp, "w") as fh:
+        json.dump({"rc": int(rc), "t": round(time.time(), 3)}, fh)
+    os.replace(tmp, path)
+
+
+def run_done(fleet_dir: str) -> bool:
+    return os.path.exists(os.path.join(fleet_dir, DONE))
+
+
+@dataclass
+class FleetSettings:
+    world: int
+    heartbeat_s: float
+    transport: str
+    fleet_dir: str
+    port: int
+    min_ranks: int
+    heal: bool
+    max_recoveries: int
+
+
+def _env_float(name: str, fallback: float) -> float:
+    v = os.environ.get(name, "").strip()
+    try:
+        return float(v) if v else fallback
+    except ValueError:
+        log.warning("ignoring non-numeric %s=%r", name, v)
+        return fallback
+
+
+def _env_int(name: str, fallback: int) -> int:
+    v = os.environ.get(name, "").strip()
+    try:
+        return int(v) if v else fallback
+    except ValueError:
+        log.warning("ignoring non-numeric %s=%r", name, v)
+        return fallback
+
+
+def resolve_fleet(config) -> FleetSettings:
+    """The effective fleet surface: ``LGBM_TPU_FLEET_*`` env overrides
+    win over the ``tpu_fleet_*`` config family."""
+    transport = (os.environ.get("LGBM_TPU_FLEET_TRANSPORT", "").strip()
+                 or str(getattr(config, "tpu_fleet_transport", "auto")))
+    if transport not in ("auto", "jax", "host"):
+        log.warning("unknown fleet transport %r; using auto", transport)
+        transport = "auto"
+    return FleetSettings(
+        world=_env_int("LGBM_TPU_FLEET",
+                       int(getattr(config, "tpu_fleet", 0) or 0)),
+        heartbeat_s=_env_float(
+            "LGBM_TPU_FLEET_HEARTBEAT_S",
+            float(getattr(config, "tpu_fleet_heartbeat_s", 30.0))),
+        transport=transport,
+        fleet_dir=(os.environ.get("LGBM_TPU_FLEET_DIR", "").strip()
+                   or str(getattr(config, "tpu_fleet_dir", "") or "")),
+        port=int(getattr(config, "tpu_fleet_port", 0) or 0),
+        min_ranks=int(getattr(config, "tpu_fleet_min_ranks", 1) or 1),
+        heal=bool(getattr(config, "tpu_fleet_heal", True)),
+        max_recoveries=int(getattr(config, "tpu_fleet_max_recoveries", 2)),
+    )
+
+
+def device_collective_support(probe: bool = False) -> bool:
+    """Can this jax backend run CROSS-PROCESS device collectives?
+
+    Non-CPU backends (TPU/GPU) can; the CPU backend in the vetted jax
+    range cannot (``multihost_utils.process_allgather`` fails across
+    processes — the PR 14 note on tests/dist_worker.py).  With
+    ``probe=True`` and an initialized multi-process runtime, runs a
+    1-int32 allgather to measure the truth instead of assuming it —
+    the startup probe dist_worker.py self-classifies with."""
+    try:
+        import jax
+        backend = jax.default_backend()
+    except Exception:  # noqa: BLE001 — no usable jax, no collectives
+        return False
+    if backend != "cpu":
+        return True
+    if not probe:
+        return False
+    try:
+        import jax.numpy as jnp
+        import numpy as np
+        from jax.experimental import multihost_utils
+        if jax.process_count() <= 1:
+            return False
+        out = np.asarray(
+            multihost_utils.process_allgather(jnp.ones((1,), jnp.int32)))
+        return int(out.size) == int(jax.process_count())
+    except Exception:  # noqa: BLE001 — the probe IS the question
+        return False
+
+
+def should_gang_launch(config) -> bool:
+    """True in the PARENT invocation of a fleet run: a fleet is asked
+    for and this process is not already a spawned rank."""
+    return (resolve_fleet(config).world > 1
+            and not os.environ.get("LGBM_TPU_FLEET_RANK"))
+
+
+# ---------------------------------------------------------------------------
+# rendezvous file
+# ---------------------------------------------------------------------------
+
+def write_rendezvous(fleet_dir: str, addr, world: int) -> str:
+    path = os.path.join(fleet_dir, RENDEZVOUS)
+    tmp = path + f".tmp-{os.getpid()}"
+    with open(tmp, "w") as fh:
+        json.dump({"addr": [addr[0], int(addr[1])], "world": int(world),
+                   "t": round(time.time(), 3)}, fh)
+    os.replace(tmp, path)
+    return path
+
+
+def wait_rendezvous(fleet_dir: str, timeout: float = 60.0):
+    """Poll for rank 0's rendezvous file; returns ``(host, port)``."""
+    path = os.path.join(fleet_dir, RENDEZVOUS)
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            with open(path) as fh:
+                rec = json.load(fh)
+            return rec["addr"][0], int(rec["addr"][1])
+        except (OSError, ValueError, KeyError, IndexError):
+            time.sleep(0.05)
+    from .transport import FleetCoordinatorLost
+    raise FleetCoordinatorLost(
+        f"fleet: no rendezvous file at {path} after {timeout:.0f}s")
+
+
+# ---------------------------------------------------------------------------
+# gang launcher
+# ---------------------------------------------------------------------------
+
+def _worker_argv(params: Dict[str, str], overrides: Dict[str, str]):
+    merged = dict(params)
+    merged.update(overrides)
+    return [sys.executable, "-m", "lightgbm_tpu.fleet",
+            *[f"{k}={v}" for k, v in merged.items()]]
+
+
+def launch_fleet(config, params: Dict[str, str],
+                 per_rank_env: Optional[Dict[int, Dict[str, str]]] = None,
+                 poll_s: float = 0.2) -> dict:
+    """Spawn, watch, and (optionally) heal an N-rank training fleet.
+
+    Returns a summary dict: ``rc`` (rank 0's exit code), per-member
+    ``rcs``, ``heals`` performed, ``fleet_dir`` and ``ok`` — ok means
+    rank 0 finished clean AND every seat was ultimately filled by a
+    member that exited 0 (a killed-and-healed rank does not spoil it).
+    ``per_rank_env`` injects env per LAUNCH member id (fault specs for
+    the chaos tests)."""
+    fs = resolve_fleet(config)
+    n = int(fs.world)
+    if n <= 1:
+        raise ValueError("launch_fleet needs tpu_fleet >= 2")
+    fleet_dir = fs.fleet_dir or tempfile.mkdtemp(prefix="lgbm_tpu_fleet_")
+    os.makedirs(fleet_dir, exist_ok=True)
+    for name in (RENDEZVOUS, DONE):
+        stale = os.path.join(fleet_dir, name)
+        if os.path.exists(stale):
+            os.unlink(stale)
+
+    overrides = {"tpu_fleet": str(n), "tpu_fleet_dir": fleet_dir,
+                 "task": "train"}
+    argv = _worker_argv(params, overrides)
+
+    # the workers re-import the package by name (`-m lightgbm_tpu.fleet`);
+    # when the parent found it via sys.path surgery (the tools/ pattern)
+    # rather than an install, the children need the same root
+    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    child_pp = os.pathsep.join(
+        p for p in [pkg_root, os.environ.get("PYTHONPATH", "")] if p)
+
+    def spawn(mid: int, join: bool):
+        env = os.environ.copy()
+        env["PYTHONPATH"] = child_pp
+        # rank logs must survive a SIGKILL mid-write (the whole point of
+        # the chaos suite is reading them post-mortem)
+        env["PYTHONUNBUFFERED"] = "1"
+        env.update({
+            "LGBM_TPU_FLEET": str(n),
+            "LGBM_TPU_FLEET_RANK": str(mid),
+            "LGBM_TPU_FLEET_DIR": fleet_dir,
+            # telemetry / board / shard identity all key off the rank
+            # env (obs/core._process_index) — stamp it here so per-rank
+            # artifact names never collide
+            "LGBM_TPU_RANK": str(mid),
+            "LGBM_TPU_FLEET_JOIN": "1" if join else "",
+        })
+        env.update((per_rank_env or {}).get(mid, {}))
+        logf = open(os.path.join(fleet_dir, f"rank{mid}.log"), "ab")
+        proc = subprocess.Popen(argv, env=env, stdout=logf, stderr=logf)
+        logf.close()
+        log.info("fleet: %s rank %d (pid %d)",
+                 "healed" if join else "launched", mid, proc.pid)
+        return proc
+
+    members = {mid: {"proc": spawn(mid, False), "rc": None,
+                     "healed_by": None} for mid in range(n)}
+    next_mid, heals = n, 0
+    rc0 = None
+    while True:
+        running = 0
+        for mid, m in list(members.items()):
+            if m["rc"] is not None:
+                continue
+            rc = m["proc"].poll()
+            if rc is None:
+                running += 1
+                continue
+            m["rc"] = rc
+            if mid == 0:
+                rc0 = rc
+            elif rc != 0 and rc0 is None:
+                log.warning("fleet: rank %d exited %d", mid, rc)
+                if fs.heal and heals < fs.max_recoveries:
+                    heals += 1
+                    m["healed_by"] = next_mid
+                    members[next_mid] = {"proc": spawn(next_mid, True),
+                                         "rc": None, "healed_by": None}
+                    next_mid += 1
+        if rc0 is not None:
+            # the coordinator is done (or dead): give the others a
+            # bounded grace to drain, then stop waiting
+            deadline = time.time() + max(4.0 * fs.heartbeat_s, 30.0)
+            for mid, m in members.items():
+                if m["rc"] is None:
+                    try:
+                        m["rc"] = m["proc"].wait(
+                            timeout=max(deadline - time.time(), 1.0))
+                    except subprocess.TimeoutExpired:
+                        m["proc"].kill()
+                        m["rc"] = m["proc"].wait()
+            break
+        if running == 0:
+            break
+        time.sleep(poll_s)
+
+    rcs = {mid: m["rc"] for mid, m in members.items()}
+    seats_ok = all(
+        m["rc"] == 0 or (m["healed_by"] is not None
+                         and rcs.get(m["healed_by"]) == 0)
+        for mid, m in members.items())
+    out = {"rc": int(rc0 or 0), "rcs": rcs, "heals": heals,
+           "fleet_dir": fleet_dir,
+           "ok": bool(rc0 == 0 and seats_ok)}
+    log.info("fleet: run finished rc=%s heals=%d rcs=%s",
+             out["rc"], heals, rcs)
+    return out

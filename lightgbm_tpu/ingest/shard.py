@@ -46,6 +46,16 @@ class RowShardPlan:
     def query_aligned(self) -> bool:
         return self.query_cuts is not None
 
+    def replan(self, num_shards: int,
+               query_boundaries=None) -> "RowShardPlan":
+        """The same row stream re-cut for a DIFFERENT world size — what
+        the elastic fleet (fleet/elastic.py) does after it shrinks or
+        heals: ``n_rows`` is invariant, only the cuts move.  Pass the
+        original ``query_boundaries`` again to keep the new cuts
+        query-aligned (alignment is derived from boundaries, not
+        carried over — the old cuts are for the old world)."""
+        return plan_row_shards(self.n_rows, num_shards, query_boundaries)
+
 
 def plan_row_shards(n_rows: int, num_shards: int,
                     query_boundaries=None) -> RowShardPlan:

@@ -290,6 +290,12 @@ class TrainBoard:
         wd = self._provider("watchdog")
         if wd:
             out["watchdog"] = wd
+        fl = self._provider("fleet")
+        if fl:
+            out["fleet"] = fl
+        hub = self._provider("fleet_hub")
+        if hub:
+            out["fleet_hub"] = hub
         return out
 
     def metrics_text(self) -> str:
@@ -431,6 +437,44 @@ class TrainBoard:
                   "Current per-call watchdog deadline.")
             out.append("tpu_train_watchdog_deadline_seconds "
                        + _fmt(wd.get("deadline_s")))
+        fl = self._provider("fleet")
+        if fl:
+            _head(out, "tpu_train_fleet_world_size", "gauge",
+                  "Live ranks in the elastic training fleet.")
+            out.append("tpu_train_fleet_world_size "
+                       + _fmt(fl.get("world")))
+            _head(out, "tpu_train_fleet_rank", "gauge",
+                  "This process's current shard rank (member id as "
+                  "label — stable across resizes).")
+            out.append('tpu_train_fleet_rank{member="%s"} %s'
+                       % (fl.get("member"), _fmt(fl.get("rank"))))
+            _head(out, "tpu_train_fleet_epoch", "gauge",
+                  "Fleet epoch (bumped by every resize).")
+            out.append("tpu_train_fleet_epoch " + _fmt(fl.get("epoch")))
+            _head(out, "tpu_train_fleet_dead_ranks", "gauge",
+                  "Members classified dead since launch.")
+            out.append("tpu_train_fleet_dead_ranks "
+                       + _fmt(len(fl.get("dead") or ())))
+            _head(out, "tpu_train_fleet_recoveries_total", "counter",
+                  "Elastic recoveries (rollback + resize) this rank "
+                  "has run.")
+            out.append("tpu_train_fleet_recoveries_total "
+                       + _fmt(fl.get("recoveries")))
+            _head(out, "tpu_train_fleet_pending_join", "gauge",
+                  "Healed ranks parked at the hub awaiting a resize.")
+            out.append("tpu_train_fleet_pending_join "
+                       + _fmt(fl.get("pending_join")))
+            members = fl.get("members") or {}
+            if members:
+                _head(out, "tpu_train_fleet_member_age_seconds", "gauge",
+                      "Seconds since each live member's last heartbeat "
+                      "(coordinator view).")
+                for m in sorted(members):
+                    out.append(
+                        'tpu_train_fleet_member_age_seconds{member="%s",'
+                        'shard="%s"} %s'
+                        % (m, members[m].get("shard"),
+                           _fmt(members[m].get("age_s"))))
         _head(out, "tpu_train_stragglers_total", "counter",
               "Straggler breaches detected (rank 0 only).")
         out.append("tpu_train_stragglers_total " + _fmt(stragglers))

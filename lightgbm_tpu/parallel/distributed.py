@@ -36,6 +36,46 @@ from . import mesh as _mesh
 
 _initialized = False
 
+# host-TCP collective backend (fleet/transport.HostCollectives): when a
+# jax build cannot run cross-process device collectives (CPU CI, the
+# fleet's CI-twin transport), the fleet installs an adapter here and
+# every collective in this module — bin-sample pooling, the divergence
+# audit, the straggler stats exchange — rides its ordered TCP gathers
+# instead of ``multihost_utils.process_allgather``, bit-exactly (the
+# payloads move as pickled numpy arrays, no dtype truncation at all)
+_HOST_COLLECTIVES = None
+
+
+def set_host_collectives(handle) -> None:
+    """Install (or clear, with None) the host-collective backend.  The
+    handle needs ``world_size``/``rank`` properties, ``active()`` and
+    ``allgather(arr) -> [world, *arr.shape]`` in rank order."""
+    global _HOST_COLLECTIVES
+    _HOST_COLLECTIVES = handle
+
+
+def host_collectives():
+    """The ACTIVE host-collective backend, or None (inactive counts as
+    none: the fleet pauses it around replicate-mode ingest, whose
+    whole-stream sample must not be pooled)."""
+    h = _HOST_COLLECTIVES
+    if h is not None and h.active():
+        return h
+    return None
+
+
+def world_size() -> int:
+    """Process count of whichever multi-host runtime is up: the host
+    transport's world when installed, else jax's.  1 single-process —
+    without touching a (possibly wedged) accelerator backend."""
+    h = host_collectives()
+    if h is not None:
+        return int(h.world_size)
+    if not _runtime_active():
+        return 1
+    import jax
+    return jax.process_count()
+
 
 def parse_machine_list(machines: str = "",
                        machine_list_filename: str = "",
@@ -191,6 +231,8 @@ def _runtime_active() -> bool:
     external jax.distributed.initialize (an embedding launcher).  Reads
     jax's distributed state directly so a wedged accelerator backend is
     never touched on the single-host fast path."""
+    if host_collectives() is not None:
+        return True
     if _initialized:
         return True
     state = jax_distributed_state()
@@ -210,9 +252,6 @@ def _allgather_exact(arr):
     Returns a numpy array with a leading process axis."""
     import numpy as np
 
-    import jax.numpy as jnp
-    from jax.experimental import multihost_utils
-
     from .. import obs
 
     a = np.ascontiguousarray(arr)
@@ -221,6 +260,18 @@ def _allgather_exact(arr):
     # site is THE place a real cross-host gather fails — bin-sample
     # pooling and the divergence audit both route through here
     from ..robust.watchdog import guarded_call
+
+    host = host_collectives()
+    if host is not None:
+        # fleet CI-twin transport: ordered TCP gather, already bit-exact
+        # for any width (payloads ride as pickled numpy — no 32-bit
+        # truncation to dodge)
+        g = guarded_call(lambda: host.allgather(a), point="collective")
+        obs.record_collective_host("host_allgather", g.nbytes)
+        return g
+
+    import jax.numpy as jnp
+    from jax.experimental import multihost_utils
 
     def _gather():
         if a.dtype.itemsize == 8:
@@ -256,11 +307,7 @@ def global_bin_sample(sample, num_local_rows=None):
 
     if num_local_rows is None:
         num_local_rows = len(sample)
-    if not _runtime_active():
-        return sample, int(num_local_rows)
-    import jax
-
-    if jax.process_count() <= 1:
+    if not _runtime_active() or world_size() <= 1:
         return sample, int(num_local_rows)
 
     n, f = sample.shape
@@ -287,11 +334,7 @@ def global_bin_sample_sparse(sample_csc, num_local_rows: int):
     runtime.  Returns ``(pooled_csc, global_num_rows)``."""
     import numpy as np
 
-    if not _runtime_active():
-        return sample_csc, int(num_local_rows)
-    import jax
-
-    if jax.process_count() <= 1:
+    if not _runtime_active() or world_size() <= 1:
         return sample_csc, int(num_local_rows)
     import scipy.sparse as sp
 
@@ -339,12 +382,11 @@ def rank_allgather_stats(vec):
 
     if not _runtime_active():
         return None
-    import jax
-
-    if jax.process_count() <= 1:
+    w = world_size()
+    if w <= 1:
         return None
     v = np.ascontiguousarray(np.asarray(vec, np.float64).reshape(-1))
-    return _allgather_exact(v).reshape(jax.process_count(), -1)
+    return _allgather_exact(v).reshape(w, -1)
 
 
 def train_stats_exchange(vec):

@@ -79,15 +79,38 @@ _DIGEST_SKIP = frozenset((
     "tpu_ingest", "tpu_ingest_chunk_rows", "tpu_ingest_memmap",
 ))
 
+# world-shape knobs, additionally skipped in FLEET mode (tpu_fleet set):
+# the elastic fleet trains a full replica on every rank (fleet/elastic.py
+# replicate mode — provably world-independent), so a resume after the
+# world shrank or healed must not be refused just because the shard
+# count changed.  Outside fleet mode these knobs keep refusing a resume:
+# they change the local rows, hence the trees.
+_DIGEST_SKIP_FLEET_WORLD = frozenset((
+    "tpu_ingest_shards", "tpu_ingest_shard_id",
+    "num_machines", "machines", "machine_list_filename",
+    "local_listen_port", "time_out",
+))
+
 
 def config_digest(config) -> str:
     """Stable hash of the training-relevant config surface."""
     import dataclasses
+    fleet = bool(getattr(config, "tpu_fleet", 0))
     items = {}
     for f in dataclasses.fields(config):
         if f.name in _DIGEST_SKIP or f.name == "is_parallel":
             continue
+        # the tpu_fleet_* family is always operational (heartbeat cadence,
+        # heal policy, rendezvous dir) — never training-relevant
+        if f.name.startswith("tpu_fleet"):
+            continue
         v = getattr(config, f.name)
+        if fleet and f.name in _DIGEST_SKIP_FLEET_WORLD:
+            # neutralize (don't drop) the world-geometry knobs: the
+            # keyset stays identical, so a fleet checkpoint resumes at
+            # ANY world size — including world 1, the single-process
+            # digest an elastic shrink-to-one lands on
+            v = f.default
         if isinstance(v, (list, tuple)):
             v = list(v)
         if f.name == "tpu_hist_dtype":
@@ -101,6 +124,17 @@ def config_digest(config) -> str:
         items[f.name] = v
     blob = json.dumps(items, sort_keys=True, default=str)
     return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+def _world_size() -> int:
+    """Informational world size stamped into checkpoint meta (fleet
+    post-mortems read it); never part of the digest — a shrunk-world
+    resume is exactly the point of the elastic fleet."""
+    try:
+        from ..parallel.distributed import world_size
+        return int(world_size())
+    except Exception:  # noqa: BLE001 — meta decoration only
+        return 1
 
 
 def _sha256_file(path: str) -> str:
@@ -175,6 +209,21 @@ class CheckpointManager:
                 out.append((int(m.group(1)), d))
         return [d for _, d in sorted(out, reverse=True)]
 
+    def trim_to(self, iteration: int) -> int:
+        """Drop every checkpoint NEWER than ``iteration`` — the elastic
+        rollback: survivors agree on the fleet-wide common iteration and
+        trim so the auto-resume lands exactly there on every rank.
+        Returns the number of checkpoints removed."""
+        removed = 0
+        for d in self.list_checkpoints():
+            m = _CKPT_RE.search(os.path.basename(d))
+            if m and int(m.group(1)) > int(iteration):
+                shutil.rmtree(d, ignore_errors=True)
+                removed += 1
+                log.info("checkpoint: trimmed %s (rollback to iteration "
+                         "%d)", d, iteration)
+        return removed
+
     def _sweep_orphans(self) -> None:
         for d in glob.glob(os.path.join(self.dir, ".tmp-*")):
             shutil.rmtree(d, ignore_errors=True)
@@ -210,6 +259,7 @@ class CheckpointManager:
                 "config_digest": (self.digest
                                   or config_digest(booster.config)),
                 "num_data": int(gbdt.train_ds.num_data),
+                "world_size": _world_size(),
                 "num_class": int(gbdt.num_tpi),
                 "best_iteration": int(booster.best_iteration),
                 "eval_history": [[int(it), [list(e) for e in entries]]

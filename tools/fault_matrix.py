@@ -25,7 +25,14 @@ mode in one process and emits a per-check verdict map, exactly like
   to JIT LOUDLY (``aot_fallback`` event + fallback counter) with
   bit-identical predictions, and arena byte-budget pressure evicts a
   tenant that is transparently re-admitted — bit-identical — on its
-  next request.
+  next request;
+- the elastic fleet (ISSUE 20): a rank killed mid-iteration is
+  detected, survivors roll back to the common checkpoint and resume to
+  a bit-exact model; a killed COORDINATOR makes every surviving rank
+  exit loudly (143) with a flight dump — never hang; an injected
+  heartbeat stall is stamped ``fleet_stall`` without killing anyone;
+  and a healed joiner folds back in mid-run to a final model bit-exact
+  vs the never-failed oracle.
 
     python tools/fault_matrix.py --json      # one JSON verdict line
 """
@@ -455,6 +462,135 @@ def main(argv=None) -> int:
               repr(exc))
     finally:
         arena.close()
+
+    # ---- elastic fleet (ISSUE 20): kill / coordinator / stall / rejoin
+    from lightgbm_tpu.config import Config as _FCfg
+    from lightgbm_tpu.fleet.launch import EVENTS, launch_fleet
+
+    fdata = os.path.join(art, "fleet_train.tsv")
+    frng = np.random.default_rng(3)
+    FX = frng.normal(size=(120, 5))
+    Fy = FX[:, 0] * 2.0 + np.sin(FX[:, 1]) \
+        + frng.normal(scale=0.1, size=120)
+    np.savetxt(fdata, np.column_stack([Fy, FX]), delimiter="\t",
+               fmt="%.8f")
+
+    def fleet_params(tag, **extra):
+        p = {"task": "train", "objective": "regression", "data": fdata,
+             "label_column": "0", "num_iterations": "12",
+             "num_leaves": "7", "min_data_in_leaf": "5",
+             "learning_rate": "0.1", "tpu_ingest": "true",
+             "verbosity": "-1", "tpu_fleet": "3",
+             "tpu_fleet_heartbeat_s": "3", "tpu_checkpoint_freq": "4",
+             "tpu_fleet_dir": os.path.join(art, f"fleet_{tag}"),
+             "output_model": os.path.join(art, f"fleet_{tag}.txt")}
+        p.update({k: str(v) for k, v in extra.items()})
+        return p
+
+    def fleet_oracle(tag, p):
+        """Never-failed single-process run of the same training args."""
+        import subprocess
+        single = {k: v for k, v in p.items()
+                  if not k.startswith("tpu_fleet")}
+        single["output_model"] = os.path.join(art, f"oracle_{tag}.txt")
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   PYTHONPATH=REPO + os.pathsep
+                   + os.environ.get("PYTHONPATH", ""))
+        env.pop("LGBM_TPU_FAULTS", None)
+        subprocess.run([sys.executable, "-m", "lightgbm_tpu",
+                        *[f"{k}={v}" for k, v in single.items()]],
+                       check=True, env=env, capture_output=True,
+                       timeout=240)
+        return open(single["output_model"]).read().split(
+            "\nparameters:\n")[0]
+
+    def tree_text(path):
+        return open(path).read().split("\nparameters:\n")[0]
+
+    def fleet_events(p):
+        path = os.path.join(p["tpu_fleet_dir"], EVENTS)
+        if not os.path.exists(path):
+            return []
+        return [json.loads(line) for line in open(path)]
+
+    # rank killed mid-iteration -> survivors detect, roll back to the
+    # common checkpoint, resume at the shrunk world, and the finished
+    # model is bit-exact (heal off: the pure-shrink branch)
+    p = fleet_params("kill", tpu_fleet_heal="false")
+    try:
+        res = launch_fleet(_FCfg.from_params(p), p, per_rank_env={
+            1: {"LGBM_TPU_FAULTS": "fleet_die:raise@iter=6"}})
+        ev = [e["name"] for e in fleet_events(p)]
+        check("fleet.rank_killed.survivors_recover",
+              res["rc"] == 0 and res["rcs"].get(1) == 137
+              and "member_dead" in ev and "resize" in ev, res)
+        check("fleet.rank_killed.bit_exact",
+              tree_text(p["output_model"]) == fleet_oracle("kill", p))
+    except Exception as exc:  # noqa: BLE001
+        check("fleet.rank_killed.survivors_recover", False, repr(exc))
+        CHECKS.setdefault("fleet.rank_killed.bit_exact", False)
+
+    # coordinator killed -> every surviving rank exits 143 with a
+    # flight dump, never hangs (recovery without the hub is impossible)
+    p = fleet_params("coord", tpu_fleet_heal="false")
+    fldir = os.path.join(art, "fleet_coord_flight")
+    try:
+        t_coord = time.time()
+        res = launch_fleet(_FCfg.from_params(p), p, per_rank_env={
+            0: {"LGBM_TPU_FAULTS": "fleet_die:raise@iter=6"},
+            1: {"LGBM_TPU_FLIGHT": "64", "LGBM_TPU_FLIGHT_DIR": fldir},
+            2: {"LGBM_TPU_FLIGHT": "64", "LGBM_TPU_FLIGHT_DIR": fldir}})
+        wall = time.time() - t_coord
+        check("fleet.coordinator_killed.loud_exit",
+              res["rcs"].get(0) == 137
+              and res["rcs"].get(1) == 143 and res["rcs"].get(2) == 143
+              and wall < 60, res)
+        dumps = glob.glob(os.path.join(fldir, "FLIGHT_*.json"))
+        check("fleet.coordinator_killed.flight_dumped", len(dumps) >= 2,
+              f"{len(dumps)} dumps in {fldir}")
+    except Exception as exc:  # noqa: BLE001
+        check("fleet.coordinator_killed.loud_exit", False, repr(exc))
+        CHECKS.setdefault("fleet.coordinator_killed.flight_dumped", False)
+
+    # heartbeat stall: one rank sleeps past stall_frac x heartbeat on
+    # every iteration — stamped ``fleet_stall``, NOT killed, run clean
+    p = fleet_params("stall", tpu_fleet_heartbeat_s="3",
+                     tpu_fingerprint_freq="1", num_iterations="6")
+    try:
+        res = launch_fleet(_FCfg.from_params(p), p, per_rank_env={
+            2: {"LGBM_TPU_FAULTS": "fleet_hb:sleep=2.0@n=-1"}})
+        ev = fleet_events(p)
+        stalls = [e for e in ev if e["name"] == "fleet_stall"]
+        deaths = [e for e in ev if e["name"] == "member_dead"]
+        check("fleet.stall.stamped_not_killed",
+              res["ok"] and len(stalls) >= 1 and not deaths,
+              {"res": res, "stalls": len(stalls), "deaths": deaths})
+    except Exception as exc:  # noqa: BLE001
+        check("fleet.stall.stamped_not_killed", False, repr(exc))
+
+    # re-join after heal: iterations slowed fleet-wide so the healed
+    # joiner's startup fits inside the remaining run — it must fold in
+    # mid-run (a resize with joiners=1) and the final model must still
+    # bit-match the never-failed oracle
+    p = fleet_params("rejoin", num_iterations="40",
+                     tpu_fleet_heartbeat_s="4", tpu_checkpoint_freq="5")
+    slow = "fleet_hb:sleep=0.5@n=-1"
+    try:
+        res = launch_fleet(_FCfg.from_params(p), p, per_rank_env={
+            0: {"LGBM_TPU_FAULTS": slow},
+            1: {"LGBM_TPU_FAULTS": slow + ";fleet_die:raise@iter=6"},
+            2: {"LGBM_TPU_FAULTS": slow}})
+        ev = fleet_events(p)
+        joins = [e for e in ev if e["name"] == "member_join_pending"]
+        grows = [e for e in ev if e["name"] == "resize"
+                 and e.get("joiners")]
+        check("fleet.rejoin.folds_in_mid_run",
+              res["ok"] and res["heals"] == 1 and joins and grows, res)
+        check("fleet.rejoin.bit_exact_vs_never_failed",
+              tree_text(p["output_model"]) == fleet_oracle("rejoin", p))
+    except Exception as exc:  # noqa: BLE001
+        check("fleet.rejoin.folds_in_mid_run", False, repr(exc))
+        CHECKS.setdefault("fleet.rejoin.bit_exact_vs_never_failed", False)
 
     record = {
         "kind": "fault_matrix",

@@ -1,0 +1,228 @@
+"""Elastic-fleet smoke — the ``fleet`` suite tier (ISSUE 20).
+
+Gang-launches REAL 3-process training fleets over the host-TCP
+transport (the CI twin of ``jax.distributed``) and proves the elastic
+plane end to end on CPU:
+
+- **plain / bagging / ranking bit-exact**: a 3-rank fleet trains
+  bit-identically (tree sections) to the single-process oracle on a
+  plain regression fixture, a bagging binary fixture, and a lambdarank
+  fixture with a ``.query`` sidecar (query-aligned row shards);
+- **healthy path is quiet**: the plain run's event trail carries no
+  deaths, resizes, or stall stamps — zero new sync points;
+- **kill-one-rank recovery**: a rank hard-killed mid-iteration
+  (``fleet_die`` injection) is detected via the heartbeat transport,
+  survivors roll back to the last common checkpoint and resume, the
+  healed joiner folds in, the run completes, and the final model still
+  bit-matches the never-failed oracle.
+
+Writes ``FLEET_rN.json`` (fleet_ranks / fleet_recoveries series for
+``tools/bench_history.py``).  Last stdout line is the
+``{"ok": ..., "checks": ...}`` verdict map (the tools/run_suite.py
+tool-tier contract).  Exit 0 iff all pass.
+
+    python tools/fleet_smoke.py --json
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+CHECKS = {}
+RANKS = 3
+
+
+def check(name, ok, detail=""):
+    CHECKS[name] = bool(ok)
+    print(f"# {'ok ' if ok else 'FAIL'} {name}"
+          + (f" — {detail}" if detail and not ok else ""), flush=True)
+
+
+def _next_round(out_dir):
+    n = 0
+    for f in glob.glob(os.path.join(out_dir, "FLEET_r*.json")):
+        m = re.search(r"FLEET_r(\d+)\.json$", os.path.basename(f))
+        if m:
+            n = max(n, int(m.group(1)))
+    return n + 1
+
+
+def _tree_text(path):
+    with open(path) as fh:
+        return fh.read().split("\nparameters:\n")[0]
+
+
+def _write_fixtures(art):
+    """Three fixtures: plain regression, bagging binary, lambdarank
+    with a ``.query`` sidecar (the query-aligned shard path)."""
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(120, 5))
+    y = X[:, 0] * 2.0 + np.sin(X[:, 1]) + rng.normal(scale=0.1, size=120)
+    plain = os.path.join(art, "plain.tsv")
+    np.savetxt(plain, np.column_stack([y, X]), delimiter="\t", fmt="%.8f")
+
+    yb = (X[:, 0] + 0.4 * X[:, 1] > 0).astype(np.float64)
+    bag = os.path.join(art, "bag.tsv")
+    np.savetxt(bag, np.column_stack([yb, X]), delimiter="\t", fmt="%.8f")
+
+    qsizes = rng.integers(5, 12, size=14)
+    n = int(qsizes.sum())
+    Xr = rng.normal(size=(n, 5))
+    yr = rng.integers(0, 4, size=n).astype(np.float64)
+    rank = os.path.join(art, "rank.tsv")
+    np.savetxt(rank, np.column_stack([yr, Xr]), delimiter="\t", fmt="%.8f")
+    np.savetxt(rank + ".query", qsizes, fmt="%d")
+    return {"plain": plain, "bag": bag, "rank": rank}
+
+
+def _oracle(params, out_path):
+    """Never-failed single-process run of the same training args (own
+    process, so its jax state cannot leak into the fleet ranks')."""
+    p = {k: v for k, v in params.items() if not k.startswith("tpu_fleet")}
+    p["output_model"] = out_path
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+    env.pop("LGBM_TPU_FAULTS", None)
+    subprocess.run([sys.executable, "-m", "lightgbm_tpu",
+                    *[f"{k}={v}" for k, v in p.items()]],
+                   check=True, env=env, capture_output=True, timeout=300)
+    return _tree_text(out_path)
+
+
+def _events(fleet_dir):
+    from lightgbm_tpu.fleet.launch import EVENTS
+    path = os.path.join(fleet_dir, EVENTS)
+    if not os.path.exists(path):
+        return []
+    return [json.loads(line) for line in open(path)]
+
+
+def run_smoke(out_dir=REPO, write=True) -> dict:
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.fleet.launch import launch_fleet
+
+    t0 = time.time()
+    art = tempfile.mkdtemp(prefix="fleet_smoke_")
+    data = _write_fixtures(art)
+    recoveries = 0
+
+    def fleet_params(tag, data_path, **extra):
+        p = {"task": "train", "objective": "regression",
+             "data": data_path, "label_column": "0",
+             "num_iterations": "10", "num_leaves": "7",
+             "min_data_in_leaf": "5", "learning_rate": "0.1",
+             "tpu_ingest": "true", "verbosity": "-1",
+             "tpu_fleet": str(RANKS), "tpu_fleet_heartbeat_s": "15",
+             "tpu_fleet_dir": os.path.join(art, f"fd_{tag}"),
+             "output_model": os.path.join(art, f"{tag}.txt")}
+        p.update({k: str(v) for k, v in extra.items()})
+        return p
+
+    def bitmatch_leg(tag, p, per_rank_env=None):
+        res = launch_fleet(Config.from_params(p), p,
+                           per_rank_env=per_rank_env)
+        oracle = _oracle(p, os.path.join(art, f"oracle_{tag}.txt"))
+        exact = (res["rc"] == 0
+                 and _tree_text(p["output_model"]) == oracle)
+        return res, exact
+
+    # ---- plain regression: bit-exact AND a quiet event trail --------
+    p = fleet_params("plain", data["plain"])
+    try:
+        res, exact = bitmatch_leg("plain", p)
+        check("fleet.plain.bit_exact", res["ok"] and exact, res)
+        noisy = [e for e in _events(p["tpu_fleet_dir"])
+                 if e["name"] in ("member_dead", "resize", "fleet_stall")]
+        check("fleet.plain.healthy_path_quiet", not noisy, noisy)
+    except Exception as exc:  # noqa: BLE001
+        check("fleet.plain.bit_exact", False, repr(exc))
+        CHECKS.setdefault("fleet.plain.healthy_path_quiet", False)
+
+    # ---- bagging: the seeded row subsampling replays identically ----
+    p = fleet_params("bag", data["bag"], objective="binary",
+                     bagging_fraction="0.8", bagging_freq="2", seed="7")
+    try:
+        res, exact = bitmatch_leg("bag", p)
+        check("fleet.bagging.bit_exact", res["ok"] and exact, res)
+    except Exception as exc:  # noqa: BLE001
+        check("fleet.bagging.bit_exact", False, repr(exc))
+
+    # ---- lambdarank: .query sidecar -> query-aligned shards ---------
+    p = fleet_params("rank", data["rank"], objective="lambdarank")
+    try:
+        res, exact = bitmatch_leg("rank", p)
+        check("fleet.ranking.bit_exact", res["ok"] and exact, res)
+    except Exception as exc:  # noqa: BLE001
+        check("fleet.ranking.bit_exact", False, repr(exc))
+
+    # ---- kill one rank mid-iteration: detect, roll back, heal, finish
+    p = fleet_params("kill", data["plain"], num_iterations="12",
+                     tpu_fleet_heartbeat_s="3", tpu_checkpoint_freq="4")
+    try:
+        res, exact = bitmatch_leg("kill", p, per_rank_env={
+            1: {"LGBM_TPU_FAULTS": "fleet_die:raise@iter=6"}})
+        ev = [e["name"] for e in _events(p["tpu_fleet_dir"])]
+        recoveries = res["heals"]
+        check("fleet.kill.recovers_and_completes",
+              res["ok"] and res["rcs"].get(1) == 137
+              and "member_dead" in ev and "resize" in ev, res)
+        check("fleet.kill.bit_exact_vs_never_failed", exact)
+    except Exception as exc:  # noqa: BLE001
+        check("fleet.kill.recovers_and_completes", False, repr(exc))
+        CHECKS.setdefault("fleet.kill.bit_exact_vs_never_failed", False)
+
+    record = {
+        "kind": "fleet",
+        "t": round(time.time(), 1),
+        "wall_s": round(time.time() - t0, 1),
+        "fleet_ranks": RANKS,
+        "fleet_recoveries": int(recoveries),
+        "checks": CHECKS,
+        "ok": all(CHECKS.values()),
+        "artifacts_dir": art,
+    }
+    if write:
+        n = _next_round(out_dir)
+        path = os.path.join(out_dir, f"FLEET_r{n:02d}.json")
+        with open(path, "w") as fh:
+            json.dump(record, fh, indent=1)
+        print(f"# wrote {path}")
+    return record
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="3-process elastic-fleet smoke (fleet suite tier)")
+    ap.add_argument("--json", action="store_true",
+                    help="print a machine-readable verdict line")
+    ap.add_argument("--out", default=REPO,
+                    help="FLEET_rN.json artifact dir (default: repo root)")
+    ap.add_argument("--no-write", action="store_true",
+                    help="skip writing the FLEET_rN.json artifact")
+    args = ap.parse_args(argv)
+    record = run_smoke(out_dir=args.out, write=not args.no_write)
+    if args.json:
+        print(json.dumps(record))
+    else:
+        print(f"# {sum(CHECKS.values())}/{len(CHECKS)} checks passed "
+              f"({record['wall_s']}s)")
+    return 0 if record["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -336,6 +336,41 @@ ENV_VARS = [
      "health report and dump the flight recorder, but never gate — "
      "drift is a property of TRAFFIC, and rolling back a good model "
      "because the world changed is usually wrong."),
+    ("LGBM_TPU_FLEET",
+     "elastic multi-host gang size (overrides the `tpu_fleet` "
+     "parameter; `lightgbm_tpu/fleet/`).  `task=train` with a value "
+     "N > 1 gang-launches N single-rank worker processes, rendezvoused "
+     "through `rendezvous.json` in the fleet dir, and supervises them: "
+     "liveness rides the fingerprint-gather cadence (zero extra sync "
+     "points on the healthy path), a silent or dead rank is rolled "
+     "back to the last common checkpoint and the survivors resume at "
+     "the shrunk world, and (with `tpu_fleet_heal`) a replacement "
+     "rank is relaunched and folds back in mid-run.  In the "
+     "replicate-mode CI twin the final model is bit-identical to a "
+     "single-process run at any world size.  Env overrides win over "
+     "the config knobs so a CI wrapper can gang an unmodified "
+     "params file."),
+    ("LGBM_TPU_FLEET_HEARTBEAT_S",
+     "override for `tpu_fleet_heartbeat_s` — the silence window "
+     "(seconds, relative to each gather's first arrival) after which "
+     "the coordinator classifies a rank dead and starts elastic "
+     "recovery.  A rank merely lagging past half the window is "
+     "stamped as a `fleet_stall` event but NOT killed."),
+    ("LGBM_TPU_FLEET_TRANSPORT",
+     "override for `tpu_fleet_transport`: `jax` forces "
+     "`jax.distributed` device collectives, `host` forces the "
+     "host-TCP coordinator (the CI twin that runs on CPU-only "
+     "containers), `auto` (default) probes for cross-process device "
+     "collective support and picks accordingly."),
+    ("LGBM_TPU_FLEET_DIR",
+     "override for `tpu_fleet_dir` — the rendezvous + fleet artifact "
+     "directory (rendezvous address file, `fleet_events.jsonl` "
+     "lifecycle trail, per-rank checkpoints, the `done.json` "
+     "completion marker late joiners consult).  Default: a fresh "
+     "`lgbm_tpu_fleet_*` temp directory per launch.  "
+     "`LGBM_TPU_FLEET_RANK` / `LGBM_TPU_FLEET_JOIN` are internal "
+     "per-worker stamps the launcher sets — setting them by hand "
+     "makes a process act as a worker instead of the launcher."),
     ("LGBM_TPU_PEAK_FLOPS",
      "override the profile mode's device peak FLOP/s (used with "
      "`LGBM_TPU_PEAK_BW`) when the built-in per-chip table "

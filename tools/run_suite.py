@@ -256,6 +256,13 @@ _TOOL_TIERS = {
     # coalescing, and byte-budget eviction with transparent
     # re-admission — re-proved on CPU each suite round
     "arena": ["arena_smoke.py", "--json"],
+    # elastic multi-host fleet (ISSUE 20): 3-process gang launches over
+    # the host-TCP transport — plain/bagging/ranking bit-exact vs the
+    # single-process oracle, quiet healthy-path event trail, and the
+    # kill-one-rank detect/rollback/heal recovery completing bit-exact;
+    # its FLEET_rN.json carries fleet_ranks / fleet_recoveries for
+    # bench_history
+    "fleet": ["fleet_smoke.py", "--json"],
 }
 
 
@@ -311,16 +318,16 @@ def main(argv=None) -> int:
         description="Run the quick/slow test tiers and write SUITE_rN.json")
     ap.add_argument("--tiers", default="quick,slow,serve,faults,chaos,"
                                        "online,ingest,drift,board,xprof,"
-                                       "arena",
+                                       "arena,fleet",
                     help="comma list of tiers: pytest markers plus the "
                          "built-in 'serve' smoke, 'faults' matrix, "
                          "'chaos' serving-chaos, 'online' closed-loop, "
                          "'ingest' streaming-ingestion, 'drift' "
                          "monitoring, 'board' train-introspection, "
-                         "'xprof' measured-roofline and 'arena' "
-                         "zero-cold-start legs (default quick,"
-                         "slow,serve,faults,chaos,online,ingest,drift,"
-                         "board,xprof,arena)")
+                         "'xprof' measured-roofline, 'arena' "
+                         "zero-cold-start and 'fleet' elastic-fleet "
+                         "legs (default quick,slow,serve,faults,chaos,"
+                         "online,ingest,drift,board,xprof,arena,fleet)")
     ap.add_argument("--select", default="",
                     help="pytest collection target (file or node id) "
                          "instead of the whole tests/ dir")

@@ -63,7 +63,12 @@ capture checklist with health monitoring enabled:
    (``ingest_rows_per_s``) + the bounded-memory proof on the window's
    host, written as ``INGEST_manual_r{N}.json`` (pass the file to
    ``bench_history.py`` explicitly to fold it into the trend beside
-   the auto-globbed CI ``INGEST_r*`` rounds, like ``SERVE_manual``).
+   the auto-globbed CI ``INGEST_r*`` rounds, like ``SERVE_manual``);
+9. ``tools/fleet_smoke.py --json`` — the elastic-fleet leg (ISSUE 20):
+   3-process gang launch over the host-TCP transport, bit-exactness vs
+   the single-process oracle on plain/bagging/ranking, and the
+   kill-one-rank recovery, written as ``FLEET_manual_r{N}.json`` (same
+   pass-explicitly convention as the other manual records).
 
 Artifacts (``--out``, default repo root):
 
@@ -196,6 +201,7 @@ def checklist_legs(art_dir: str, dry_run: bool, py: str = sys.executable):
     prof = os.path.join(REPO, "tools", "prof_kernels.py")
     serve = os.path.join(REPO, "tools", "bench_serve.py")
     ingest = os.path.join(REPO, "tools", "ingest_bench.py")
+    fleet = os.path.join(REPO, "tools", "fleet_smoke.py")
     trace_dir = os.path.join(art_dir, "trace")
 
     def env_for(tag, extra=None, dry_env=None):
@@ -306,6 +312,15 @@ def checklist_legs(art_dir: str, dry_run: bool, py: str = sys.executable):
         {"name": "bench_ingest",
          "argv": [py, ingest, "--json", "--no-write"],
          "env": env_for("bench_ingest", dry_env=_DRY_INGEST_ENV),
+         "parse_json": True},
+        # elastic-fleet leg (ISSUE 20): a real 3-process gang launch
+        # over the host-TCP transport — bit-exactness vs the
+        # single-process oracle plus the kill-one-rank recovery, on
+        # whatever host backs this window; artifact written by the
+        # window itself (FLEET_manual_rN) so the repo root stays clean
+        {"name": "bench_fleet",
+         "argv": [py, fleet, "--json", "--no-write"],
+         "env": env_for("bench_fleet", dry_env={"JAX_PLATFORMS": "cpu"}),
          "parse_json": True},
         {"name": "trace",
          "argv": [py, "-c", _TRACE_CODE, trace_rows, trace_dir],
@@ -719,6 +734,18 @@ def run_checklist(out_dir: str, n: int, dry_run: bool,
             json.dump(ingest_parsed, fh, indent=1)
         record["ingest_path"] = ingest_path
         print(f"# wrote {ingest_path}")
+    fleet_parsed = (results.get("bench_fleet") or {}).get("parsed")
+    if fleet_parsed:
+        # the fleet leg runs --no-write; the window owns the artifact.
+        # Same convention as INGEST_manual_rN: not auto-globbed by
+        # bench_history (that scan takes the CI FLEET_r* rounds) — pass
+        # the file explicitly to fold a window point into the trend
+        fleet_parsed = dict(fleet_parsed, n=n, dry_run=dry_run)
+        fleet_path = os.path.join(out_dir, f"FLEET_manual_r{n:02d}.json")
+        with open(fleet_path, "w") as fh:
+            json.dump(fleet_parsed, fh, indent=1)
+        record["fleet_path"] = fleet_path
+        print(f"# wrote {fleet_path}")
     explain_parsed = (results.get("bench_explain") or {}).get("parsed")
     if explain_parsed:
         explain_parsed = dict(explain_parsed, n=n, dry_run=dry_run)
@@ -791,7 +818,8 @@ def main(argv=None) -> int:
                          "run (bench,bench_profile,bench_maxbin63,"
                          "bench_unfused,bench_quant,bench_nofusedgrad,"
                          "bench_rank,prof_kernels,bench_serve,"
-                         "bench_explain,bench_ingest,trace); default all")
+                         "bench_explain,bench_ingest,bench_fleet,trace); "
+                         "default all")
     ap.add_argument("--wedge-retries", type=int, default=1,
                     help="times a wedge-shaped leg failure (timeout / "
                          "transient runtime error) is retried with "
